@@ -1,0 +1,12 @@
+"""tempi_tpu — a TPU-native communication framework with TEMPI's capabilities.
+
+A brand-new design (not a port) of zhangjie119/tempi for JAX/XLA/Pallas on TPU:
+derived-datatype canonicalization to strided blocks, fast on-device pack/unpack,
+model-driven send-strategy selection, async request machinery, alltoallv and
+neighbor collectives over ICI, and graph-partitioned rank placement on the ICI
+torus. See SURVEY.md for the structural map of the reference this build follows.
+"""
+
+__version__ = "0.1.0"
+
+from .utils import counters, env, logging, numeric, statistics  # noqa: F401

@@ -1,0 +1,7 @@
+from . import dtypes, tree, canonicalize, strided_block, pack_xla, packer, type_cache  # noqa: F401
+from .dtypes import (  # noqa: F401
+    BYTE, CHAR, DOUBLE, FLOAT, INT32, INT64,
+    contiguous, hindexed, hindexed_block, hvector, indexed_block, named,
+    pack_size, struct, subarray, vector,
+)
+from .strided_block import StridedBlock  # noqa: F401

@@ -1,0 +1,120 @@
+"""Canonicalization passes over the TypeTree.
+
+Re-design of the reference's fixed-point rewrite pipeline
+(/root/reference/src/internal/types.cpp:368-604): four passes run until no
+change, so that equivalent spellings of a datatype (vector-of-hvector vs
+subarray, etc.) reduce to the same canonical chain of streams over one dense
+leaf — which then flattens to a StridedBlock. Pass semantics mirror the
+reference exactly, including the quirk that a root-level dense fold leaves the
+leaf's extent on the node (harmless: only the root extent of non-contiguous
+types is consumed downstream).
+"""
+
+from __future__ import annotations
+
+from .tree import DenseData, StreamData, TypeTree
+
+
+def stream_swap(node: TypeTree) -> bool:
+    """Of two nested streams, keep the larger stride on top
+    (types.cpp:368-394)."""
+    if not isinstance(node.data, StreamData):
+        return False
+    assert len(node.children) == 1
+    child = node.children[0]
+    if not isinstance(child.data, StreamData):
+        return False
+    changed = False
+    if node.data.stride < child.data.stride:
+        node.data, child.data = child.data, node.data
+        changed = True
+    return stream_swap(child) or changed
+
+
+def stream_dense_fold(node: TypeTree) -> bool:
+    """A stream whose dense child's extent equals the stream's stride is
+    itself dense (types.cpp:399-439)."""
+    changed = False
+    for c in node.children:
+        changed |= stream_dense_fold(c)
+    if not isinstance(node.data, StreamData):
+        return changed
+    assert len(node.children) == 1
+    child = node.children[0]
+    if not isinstance(child.data, DenseData):
+        return changed
+    if child.data.extent == node.data.stride:
+        new = DenseData(off=child.data.off + node.data.off,
+                        extent=node.data.count * node.data.stride)
+        node.data = new
+        # Deviation from the reference: types.cpp:427-434 replaces the node
+        # with its child *including the extent field*, so a root-level fold
+        # (fully contiguous type) leaves the leaf's extent on the root. We
+        # keep the node's own extent, which to_strided_block consumes —
+        # this makes padded 1-D types with incount > 1 pack correctly.
+        node.children = list(child.children)
+        changed = True
+    return changed
+
+
+def stream_flatten(node: TypeTree) -> bool:
+    """Nested streams where parent.stride == child.count * child.stride merge
+    into one longer stream (types.cpp:519-553)."""
+    changed = False
+    for c in node.children:
+        changed |= stream_flatten(c)
+    if not isinstance(node.data, StreamData):
+        return changed
+    assert len(node.children) == 1
+    child = node.children[0]
+    if not isinstance(child.data, StreamData):
+        return changed
+    if node.data.stride == child.data.count * child.data.stride:
+        node.data = StreamData(off=node.data.off + child.data.off,
+                               stride=child.data.stride,
+                               count=node.data.count * child.data.count)
+        node.children = list(child.children)
+        changed = True
+    return changed
+
+
+def stream_elision(node: TypeTree) -> bool:
+    """A stream with count 1 is just its child (types.cpp:480-506,
+    stream_elision2 in the reference)."""
+    changed = False
+    for c in node.children:
+        changed |= stream_elision(c)
+    if not isinstance(node.data, StreamData):
+        return changed
+    assert len(node.children) == 1
+    if node.data.count == 1:
+        child = node.children[0]
+        off = node.data.off
+        node.data = _with_off(child.data, off)
+        node.children = list(child.children)
+        changed = True
+    return changed
+
+
+def _with_off(data, parent_off: int):
+    """Preserve the elided count-1 stream's offset by pushing it into the
+    child (the reference drops it; its count-1 streams always have off 0)."""
+    if parent_off == 0:
+        return data
+    if isinstance(data, DenseData):
+        return DenseData(off=data.off + parent_off, extent=data.extent)
+    return StreamData(off=data.off + parent_off, stride=data.stride,
+                      count=data.count)
+
+
+def simplify(root: TypeTree) -> TypeTree:
+    """Run all passes to a fixed point (types.cpp:557-604)."""
+    simp = root.clone()
+    changed = True
+    while changed:
+        changed = False
+        changed |= stream_swap(simp)
+        changed |= stream_dense_fold(simp)
+        changed |= stream_flatten(simp)
+        changed |= stream_elision(simp)
+    return simp

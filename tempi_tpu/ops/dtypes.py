@@ -1,0 +1,255 @@
+"""Derived-datatype descriptors (the framework's MPI_Datatype analog).
+
+The reference interposes real MPI datatypes and introspects them with
+MPI_Type_get_envelope/_contents (/root/reference/src/internal/types.cpp:42-344).
+This framework is standalone, so datatypes are first-class descriptor objects
+built by the same constructor family MPI offers: named, contiguous, vector,
+hvector, subarray (supported by the canonicalizer) and indexed_block,
+hindexed_block, hindexed, struct (unsupported by the canonicalizer, handled by
+a generic typemap fallback — the analog of the reference bailing to the
+underlying library for those combiners, types.cpp:182-194,230-233).
+
+Every datatype can produce its byte *typemap* — the ordered list of
+(offset, length) contiguous runs one object covers. The typemap is the ground
+truth for pack/unpack (used by the fallback packer and as the differential-test
+oracle, standing in for the underlying MPI library of the reference's tier-2
+tests, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# combiner tags (MPI_COMBINER_* analogs)
+NAMED = "named"
+CONTIGUOUS = "contiguous"
+VECTOR = "vector"
+HVECTOR = "hvector"
+SUBARRAY = "subarray"
+INDEXED_BLOCK = "indexed_block"
+HINDEXED_BLOCK = "hindexed_block"
+HINDEXED = "hindexed"
+STRUCT = "struct"
+
+
+class Datatype:
+    """Immutable datatype descriptor. Hash/eq by identity (like MPI handles)."""
+
+    __slots__ = ("combiner", "extent", "size", "params", "_typemap", "committed")
+
+    def __init__(self, combiner: str, extent: int, size: int, params: dict):
+        self.combiner = combiner
+        self.extent = int(extent)
+        self.size = int(size)
+        self.params = params
+        self._typemap: Optional[np.ndarray] = None
+        self.committed = False
+
+    # -- introspection (MPI_Type_get_envelope/_contents analog) --------------
+
+    @property
+    def oldtype(self) -> Optional["Datatype"]:
+        return self.params.get("oldtype")
+
+    def __repr__(self) -> str:
+        return f"Datatype({self.combiner}, extent={self.extent}, size={self.size})"
+
+    # -- typemap --------------------------------------------------------------
+
+    def typemap(self) -> np.ndarray:
+        """(n, 2) int64 array of (byte offset, byte length) runs, in pack
+        order, with adjacent-contiguous runs merged."""
+        if self._typemap is None:
+            self._typemap = _merge_runs(self._raw_typemap())
+        return self._typemap
+
+    def _raw_typemap(self) -> np.ndarray:
+        c = self.combiner
+        if c == NAMED:
+            return np.array([[0, self.size]], dtype=np.int64)
+        if c == STRUCT:
+            parts = []
+            for bl, disp, ty in zip(self.params["blocklengths"],
+                                    self.params["displacements"],
+                                    self.params["oldtypes"]):
+                inst = np.arange(bl, dtype=np.int64) * ty.extent + disp
+                parts.append(_shift_concat(inst, ty.typemap()))
+            return np.concatenate(parts, axis=0)
+        offs = self._instance_offsets()
+        return _shift_concat(offs, self.oldtype.typemap())
+
+    def _instance_offsets(self) -> np.ndarray:
+        """Byte offsets of each oldtype instance, in pack order."""
+        c, p = self.combiner, self.params
+        oe = self.oldtype.extent
+        if c == CONTIGUOUS:
+            return np.arange(p["count"], dtype=np.int64) * oe
+        if c == VECTOR:
+            blk = np.arange(p["count"], dtype=np.int64) * (p["stride"] * oe)
+            elem = np.arange(p["blocklength"], dtype=np.int64) * oe
+            return (blk[:, None] + elem[None, :]).reshape(-1)
+        if c == HVECTOR:
+            blk = np.arange(p["count"], dtype=np.int64) * p["stride"]
+            elem = np.arange(p["blocklength"], dtype=np.int64) * oe
+            return (blk[:, None] + elem[None, :]).reshape(-1)
+        if c == SUBARRAY:
+            sizes, subsizes, starts = p["sizes"], p["subsizes"], p["starts"]
+            ndims = len(sizes)
+            # C order: dim 0 slowest. offset = sum_i (start_i+k_i)*oe*prod(sizes[j>i])
+            mults = [oe] * ndims
+            for i in range(ndims - 2, -1, -1):
+                mults[i] = mults[i + 1] * sizes[i + 1]
+            grids = np.meshgrid(
+                *[(np.arange(subsizes[i], dtype=np.int64) + starts[i]) * mults[i]
+                  for i in range(ndims)],
+                indexing="ij")
+            return sum(grids).reshape(-1)
+        if c == INDEXED_BLOCK:
+            disp = np.asarray(p["displacements"], dtype=np.int64) * oe
+            elem = np.arange(p["blocklength"], dtype=np.int64) * oe
+            return (disp[:, None] + elem[None, :]).reshape(-1)
+        if c == HINDEXED_BLOCK:
+            disp = np.asarray(p["displacements"], dtype=np.int64)
+            elem = np.arange(p["blocklength"], dtype=np.int64) * oe
+            return (disp[:, None] + elem[None, :]).reshape(-1)
+        if c == HINDEXED:
+            parts = []
+            for bl, d in zip(p["blocklengths"], p["displacements"]):
+                parts.append(np.arange(bl, dtype=np.int64) * oe + d)
+            return np.concatenate(parts)
+        raise AssertionError(f"unhandled combiner {c}")
+
+
+def _shift_concat(offsets: np.ndarray, base: np.ndarray) -> np.ndarray:
+    """Replicate typemap ``base`` at each byte offset, preserving order."""
+    out = np.empty((offsets.size * base.shape[0], 2), dtype=np.int64)
+    out[:, 0] = (offsets[:, None] + base[None, :, 0]).reshape(-1)
+    out[:, 1] = np.tile(base[:, 1], offsets.size)
+    return out
+
+
+def _merge_runs(runs: np.ndarray) -> np.ndarray:
+    """Merge runs that are adjacent both in pack order and in memory."""
+    if runs.shape[0] <= 1:
+        return runs
+    ends = runs[:-1, 0] + runs[:-1, 1]
+    brk = np.nonzero(ends != runs[1:, 0])[0] + 1
+    starts = np.concatenate([[0], brk])
+    stops = np.concatenate([brk, [runs.shape[0]]])
+    out = np.empty((starts.size, 2), dtype=np.int64)
+    out[:, 0] = runs[starts, 0]
+    seg_end = runs[stops - 1, 0] + runs[stops - 1, 1]
+    out[:, 1] = seg_end - runs[starts, 0]
+    return out
+
+
+# -- constructors (MPI_Type_* analogs) ---------------------------------------
+
+
+def named(nbytes: int) -> Datatype:
+    return Datatype(NAMED, nbytes, nbytes, {})
+
+
+BYTE = named(1)
+CHAR = named(1)
+INT32 = named(4)
+FLOAT = named(4)
+DOUBLE = named(8)
+INT64 = named(8)
+
+
+def contiguous(count: int, oldtype: Datatype) -> Datatype:
+    assert count >= 0
+    return Datatype(CONTIGUOUS, count * oldtype.extent, count * oldtype.size,
+                    {"count": count, "oldtype": oldtype})
+
+
+def vector(count: int, blocklength: int, stride: int,
+           oldtype: Datatype) -> Datatype:
+    """stride in elements of oldtype (MPI_Type_vector)."""
+    assert count >= 1 and blocklength >= 0 and stride >= blocklength, \
+        "only non-overlapping forward vectors are supported"
+    extent = ((count - 1) * stride + blocklength) * oldtype.extent
+    return Datatype(VECTOR, extent, count * blocklength * oldtype.size,
+                    {"count": count, "blocklength": blocklength,
+                     "stride": stride, "oldtype": oldtype})
+
+
+def hvector(count: int, blocklength: int, stride: int,
+            oldtype: Datatype) -> Datatype:
+    """stride in bytes (MPI_Type_create_hvector)."""
+    assert count >= 1 and blocklength >= 0
+    assert stride >= blocklength * oldtype.extent, \
+        "only non-overlapping forward hvectors are supported"
+    extent = (count - 1) * stride + blocklength * oldtype.extent
+    return Datatype(HVECTOR, extent, count * blocklength * oldtype.size,
+                    {"count": count, "blocklength": blocklength,
+                     "stride": stride, "oldtype": oldtype})
+
+
+def subarray(sizes: Sequence[int], subsizes: Sequence[int],
+             starts: Sequence[int], oldtype: Datatype,
+             order: str = "C") -> Datatype:
+    assert len(sizes) == len(subsizes) == len(starts)
+    assert order == "C", "only C-order subarrays are supported"
+    for sz, ss, st in zip(sizes, subsizes, starts):
+        assert 0 <= st and 0 <= ss and st + ss <= sz
+    extent = int(np.prod(sizes)) * oldtype.extent if sizes else 0
+    size = int(np.prod(subsizes)) * oldtype.size if subsizes else 0
+    return Datatype(SUBARRAY, extent, size,
+                    {"sizes": list(sizes), "subsizes": list(subsizes),
+                     "starts": list(starts), "order": order,
+                     "oldtype": oldtype})
+
+
+def indexed_block(blocklength: int, displacements: Sequence[int],
+                  oldtype: Datatype) -> Datatype:
+    disp = list(displacements)
+    ends = [(d + blocklength) * oldtype.extent for d in disp]
+    extent = max(ends) if ends else 0
+    return Datatype(INDEXED_BLOCK, extent,
+                    len(disp) * blocklength * oldtype.size,
+                    {"blocklength": blocklength, "displacements": disp,
+                     "oldtype": oldtype})
+
+
+def hindexed_block(blocklength: int, displacements: Sequence[int],
+                   oldtype: Datatype) -> Datatype:
+    disp = list(displacements)
+    ends = [d + blocklength * oldtype.extent for d in disp]
+    extent = max(ends) if ends else 0
+    return Datatype(HINDEXED_BLOCK, extent,
+                    len(disp) * blocklength * oldtype.size,
+                    {"blocklength": blocklength, "displacements": disp,
+                     "oldtype": oldtype})
+
+
+def hindexed(blocklengths: Sequence[int], displacements: Sequence[int],
+             oldtype: Datatype) -> Datatype:
+    bls, disp = list(blocklengths), list(displacements)
+    assert len(bls) == len(disp)
+    ends = [d + bl * oldtype.extent for bl, d in zip(bls, disp)]
+    extent = max(ends) if ends else 0
+    return Datatype(HINDEXED, extent, sum(bls) * oldtype.size,
+                    {"blocklengths": bls, "displacements": disp,
+                     "oldtype": oldtype})
+
+
+def struct(blocklengths: Sequence[int], displacements: Sequence[int],
+           oldtypes: Sequence[Datatype]) -> Datatype:
+    bls, disp, tys = list(blocklengths), list(displacements), list(oldtypes)
+    assert len(bls) == len(disp) == len(tys)
+    ends = [d + bl * t.extent for bl, d, t in zip(bls, disp, tys)]
+    extent = max(ends) if ends else 0
+    size = sum(bl * t.size for bl, t in zip(bls, tys))
+    return Datatype(STRUCT, extent, size,
+                    {"blocklengths": bls, "displacements": disp,
+                     "oldtypes": tys})
+
+
+def pack_size(incount: int, datatype: Datatype) -> int:
+    """MPI_Pack_size analog: packed bytes for ``incount`` objects."""
+    return incount * datatype.size

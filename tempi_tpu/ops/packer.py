@@ -1,0 +1,186 @@
+"""Packer objects: per-datatype pack/unpack strategy.
+
+Re-design of the reference's Packer hierarchy (/root/reference/include/
+packer.hpp, packer_1d/2d/3d) for TPU: Packer1D is a contiguous slice (the
+cudaMemcpyAsync analog, packer_1d.cu:16-50), PackerND drives the XLA
+slice/reshape pack (pack_xla.py) or the Pallas kernel (pack_pallas.py) for
+2-D/3-D strided blocks, and PackerFallback packs any combiner through its
+typemap — the standalone stand-in for the reference's "bail to the underlying
+MPI library" path for indexed/struct types.
+
+Packers are functional: pack returns the packed bytes; unpack returns a new
+destination buffer (gap bytes preserved).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from ..utils.env import PackKernel
+from . import pack_xla
+from .dtypes import Datatype
+from .strided_block import StridedBlock
+
+
+class Packer:
+    """pack(src, incount) -> uint8[incount*packed_size];
+    unpack(dst, packed, outcount) -> new dst."""
+
+    packed_size: int  # bytes per object
+
+    def pack(self, src_u8: jax.Array, incount: int) -> jax.Array:
+        raise NotImplementedError
+
+    def unpack(self, dst_u8: jax.Array, packed_u8: jax.Array,
+               outcount: int) -> jax.Array:
+        raise NotImplementedError
+
+
+class Packer1D(Packer):
+    """Contiguous blocks; objects tightly packed (packer_1d.cu semantics:
+    object stride == block length when extent == size)."""
+
+    def __init__(self, start: int, blocklength: int, extent: int = 0):
+        self.start = start
+        self.blocklength = blocklength
+        # honor trailing padding when the type has any (see canonicalize.py
+        # dense-fold note); extent == blocklength means one plain slice
+        self.extent = extent if extent and extent > blocklength else blocklength
+        self.packed_size = blocklength
+
+    def pack(self, src_u8, incount):
+        ctr.counters.pack1d.num_packs += 1
+        ctr.counters.pack1d.bytes_packed += incount * self.blocklength
+        return pack_xla.pack(src_u8, self.start, (self.blocklength,), (1,),
+                             self.extent, incount)
+
+    def unpack(self, dst_u8, packed_u8, outcount):
+        ctr.counters.pack1d.num_unpacks += 1
+        ctr.counters.pack1d.bytes_unpacked += outcount * self.blocklength
+        return pack_xla.unpack(dst_u8, packed_u8, self.start,
+                               (self.blocklength,), (1,), self.extent, outcount)
+
+
+class PackerND(Packer):
+    """2-D/3-D strided blocks (packer_2d.cu / packer_3d.cu analog)."""
+
+    def __init__(self, sb: StridedBlock):
+        assert sb.ndims in (2, 3)
+        self.sb = sb
+        self.packed_size = sb.packed_size
+
+    @property
+    def _group(self):
+        # resolved per call: counters.init() rebinds the global Counters
+        return (ctr.counters.pack2d if self.sb.ndims == 2
+                else ctr.counters.pack3d)
+
+    def _backend(self):
+        kernel = envmod.env.pack_kernel
+        if kernel in (PackKernel.PALLAS, PackKernel.AUTO):
+            try:
+                from . import pack_pallas
+                if pack_pallas.supports(self.sb):
+                    return pack_pallas
+                if kernel is PackKernel.PALLAS:
+                    log.warn(f"TEMPI_PACK_KERNEL=pallas but {self.sb} "
+                             "unsupported by the pallas backend; using XLA")
+            except ImportError:
+                if kernel is PackKernel.PALLAS:
+                    log.warn("TEMPI_PACK_KERNEL=pallas but the pallas backend "
+                             "is unavailable; using XLA")
+        return pack_xla
+
+    def pack(self, src_u8, incount):
+        self._group.num_packs += 1
+        self._group.bytes_packed += incount * self.packed_size
+        b = self._backend()
+        return b.pack(src_u8, self.sb.start, tuple(self.sb.counts),
+                      tuple(self.sb.strides), self.sb.extent, incount)
+
+    def unpack(self, dst_u8, packed_u8, outcount):
+        self._group.num_unpacks += 1
+        self._group.bytes_unpacked += outcount * self.packed_size
+        b = self._backend()
+        return b.unpack(dst_u8, packed_u8, self.sb.start,
+                        tuple(self.sb.counts), tuple(self.sb.strides),
+                        self.sb.extent, outcount)
+
+
+class PackerFallback(Packer):
+    """Generic typemap gather/scatter for combiners without a StridedBlock
+    (indexed/hindexed/struct) or when TEMPI_NO_PACK forces the slow path."""
+
+    def __init__(self, datatype: Datatype):
+        self.datatype = datatype
+        self.packed_size = datatype.size
+        tm = datatype.typemap()
+        # byte gather indices of one object, in pack order
+        idx = np.concatenate(
+            [np.arange(off, off + ln, dtype=np.int64) for off, ln in tm]
+        ) if tm.size else np.zeros((0,), np.int64)
+        self._idx = idx
+        self._cache = {}  # (nbytes, incount) -> (pack_fn, unpack_fn)
+
+    def _fns(self, nbytes: int, incount: int):
+        key = (nbytes, incount)
+        fns = self._cache.get(key)
+        if fns is not None:
+            return fns
+        # indices built in numpy int64: JAX default config would silently
+        # truncate int64 -> int32; instead check the range and error out
+        all_idx = (np.arange(incount, dtype=np.int64)[:, None]
+                   * self.datatype.extent + self._idx[None, :]).reshape(-1)
+        if all_idx.size:
+            lo, hi = int(all_idx.min()), int(all_idx.max())
+            if lo < 0 or hi >= nbytes:
+                raise ValueError(
+                    f"buffer too small for typemap: indices span [{lo},{hi}]"
+                    f", buffer has {nbytes} bytes")
+            if hi > np.iinfo(np.int32).max:
+                raise ValueError("typemap offsets exceed int32 range")
+        idx32 = jnp.asarray(all_idx.astype(np.int32))
+
+        @jax.jit
+        def pk(u8):
+            return jnp.take(u8, idx32, axis=0)
+
+        @jax.jit
+        def up(u8, packed):
+            return u8.at[idx32].set(packed)
+
+        self._cache[key] = (pk, up)
+        return pk, up
+
+    def pack(self, src_u8, incount):
+        if incount == 0 or self._idx.size == 0:
+            return jnp.zeros((0,), dtype=jnp.uint8)
+        pk, _ = self._fns(src_u8.shape[0], incount)
+        return pk(src_u8)
+
+    def unpack(self, dst_u8, packed_u8, outcount):
+        if outcount == 0 or self._idx.size == 0:
+            return dst_u8
+        _, up = self._fns(dst_u8.shape[0], outcount)
+        return up(dst_u8, packed_u8)
+
+
+def plan_pack(sb: StridedBlock) -> Optional[Packer]:
+    """Select a packer for a canonical strided block (types.cpp:609-636)."""
+    if not sb:
+        log.warn("couldn't plan_pack strategy for unknown type")
+        return None
+    if sb.ndims == 1:
+        return Packer1D(sb.start, sb.counts[0], sb.extent)
+    if sb.ndims in (2, 3):
+        return PackerND(sb)
+    log.debug(f"no packer for {sb}")
+    return None

@@ -1,0 +1,87 @@
+"""StridedBlock: the canonical strided-ND description of a datatype.
+
+Re-design of /root/reference/include/strided_block.hpp and to_strided_block
+(/root/reference/src/internal/types.cpp:644-705): a canonical TypeTree (a chain
+of streams over one dense leaf) flattens into per-dimension counts/strides plus
+an accumulated start offset. counts[0] is the contiguous block length in bytes
+(stride 1); higher dims are the stream counts/strides from innermost out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .tree import DenseData, StreamData, TypeTree
+
+
+@dataclass
+class StridedBlock:
+    start: int = 0
+    extent: int = 0
+    counts: List[int] = field(default_factory=list)
+    strides: List[int] = field(default_factory=list)
+
+    @property
+    def ndims(self) -> int:
+        return len(self.counts)
+
+    def add_dim(self, start: int, count: int, stride: int) -> None:
+        self.start += start
+        self.counts.append(count)
+        self.strides.append(stride)
+
+    def __eq__(self, other):
+        return (isinstance(other, StridedBlock) and self.start == other.start
+                and self.counts == other.counts
+                and self.strides == other.strides)
+
+    def __bool__(self) -> bool:
+        return bool(self.counts)
+
+    def __str__(self):
+        return (f"StridedBlock{{start:{self.start},counts:{self.counts},"
+                f"strides:{self.strides}}}")
+
+    @property
+    def packed_size(self) -> int:
+        """Packed bytes of one object: product of counts (counts[0] is bytes)."""
+        n = 1
+        for c in self.counts:
+            n *= c
+        return n
+
+
+def to_strided_block(root: Optional[TypeTree]) -> StridedBlock:
+    """Flatten a canonical tree. Returns a falsy StridedBlock when the tree is
+    not a pure stream chain over a dense leaf (types.cpp:644-705)."""
+    if root is None:
+        return StridedBlock()
+
+    chain = []
+    cur = root
+    while True:
+        chain.append(cur.data)
+        if len(cur.children) == 1:
+            cur = cur.children[0]
+        elif not cur.children:
+            break
+        else:
+            return StridedBlock()  # too many children
+
+    ret = StridedBlock()
+    ret.extent = root.extent
+    if ret.extent <= 0:
+        # zero-size or malformed type: route to the fallback packer
+        return StridedBlock()
+
+    leaf = chain[-1]
+    if not isinstance(leaf, DenseData):
+        return StridedBlock()
+    ret.add_dim(leaf.off, leaf.extent, 1)
+
+    for data in reversed(chain[:-1]):
+        if not isinstance(data, StreamData):
+            return StridedBlock()
+        ret.add_dim(data.off, data.count, data.stride)
+    return ret

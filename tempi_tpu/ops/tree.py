@@ -1,0 +1,149 @@
+"""Type tree: the canonicalizable intermediate form of a datatype.
+
+Re-design of the reference's Type/DenseData/StreamData
+(/root/reference/include/types.hpp:21-128) and the decoder
+Type::from_mpi_datatype (/root/reference/src/internal/types.cpp:42-344).
+A datatype decodes into a chain of StreamData nodes over a DenseData leaf;
+combiners the canonicalizer can't express (indexed/hindexed/struct) decode to
+``None`` (the reference's empty Type), which routes them to the typemap
+fallback packer instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from . import dtypes
+from ..utils import logging as log
+
+
+@dataclass
+class DenseData:
+    off: int
+    extent: int
+
+    def __eq__(self, other):
+        # reference semantics: dense blocks compare by extent only
+        # (types.hpp:25-27)
+        return isinstance(other, DenseData) and self.extent == other.extent
+
+    def __str__(self):
+        return f"DenseData{{off:{self.off},extent:{self.extent}}}"
+
+
+@dataclass
+class StreamData:
+    off: int     # byte offset of the first element
+    stride: int  # bytes between element starts
+    count: int   # number of elements
+
+    def __eq__(self, other):
+        return (isinstance(other, StreamData) and self.off == other.off
+                and self.stride == other.stride and self.count == other.count
+                and self.count != 0)
+
+    def __str__(self):
+        return f"StreamData{{off:{self.off},count:{self.count},stride:{self.stride}}}"
+
+
+@dataclass
+class TypeTree:
+    data: object  # DenseData | StreamData
+    extent: int = -1
+    children: List["TypeTree"] = field(default_factory=list)
+
+    def height(self) -> int:
+        if not self.children:
+            return 0
+        return 1 + max(c.height() for c in self.children)
+
+    def __eq__(self, other):
+        return (isinstance(other, TypeTree) and self.data == other.data
+                and self.children == other.children)
+
+    def clone(self) -> "TypeTree":
+        return TypeTree(data=_clone_data(self.data), extent=self.extent,
+                        children=[c.clone() for c in self.children])
+
+    def __str__(self):
+        lines = []
+        self._str_helper(lines, 0)
+        return "\n".join(lines)
+
+    def _str_helper(self, lines, indent):
+        lines.append(" " * indent + str(self.data))
+        for c in self.children:
+            c._str_helper(lines, indent + 1)
+
+
+def _clone_data(d):
+    if isinstance(d, DenseData):
+        return DenseData(d.off, d.extent)
+    return StreamData(d.off, d.stride, d.count)
+
+
+def traverse(datatype: dtypes.Datatype) -> Optional[TypeTree]:
+    """Decode a datatype into a TypeTree, or None if its combiner has no
+    structured form (reference: traverse()/from_mpi_datatype)."""
+    c = datatype.combiner
+    p = datatype.params
+
+    if c == dtypes.NAMED:
+        return TypeTree(DenseData(off=0, extent=datatype.extent),
+                        extent=datatype.extent)
+
+    if c == dtypes.CONTIGUOUS:
+        child = traverse(p["oldtype"])
+        if child is None:
+            return None
+        node = TypeTree(
+            StreamData(off=0, stride=p["oldtype"].extent, count=p["count"]),
+            extent=datatype.extent, children=[child])
+        return node
+
+    if c in (dtypes.VECTOR, dtypes.HVECTOR):
+        old = p["oldtype"]
+        gchild = traverse(old)
+        if gchild is None:
+            return None
+        # parent stream = the repeated blocks, child stream = elements in a
+        # block (types.cpp:56-111 for vector, :113-167 for hvector)
+        stride_bytes = (p["stride"] * old.extent if c == dtypes.VECTOR
+                        else p["stride"])
+        child = TypeTree(
+            StreamData(off=0, stride=old.extent, count=p["blocklength"]),
+            children=[gchild])
+        parent = TypeTree(
+            StreamData(off=0, stride=stride_bytes, count=p["count"]),
+            extent=datatype.extent, children=[child])
+        return parent
+
+    if c == dtypes.SUBARRAY:
+        if p["order"] != "C":
+            log.error("unhandled order in subarray type")
+            return None
+        old = p["oldtype"]
+        child = traverse(old)
+        if child is None:
+            return None
+        sizes, subsizes, starts = p["sizes"], p["subsizes"], p["starts"]
+        ndims = len(sizes)
+        # dim i (C order, 0 slowest): stride = old.extent * prod(sizes[j>i]),
+        # off = start[i] * that stride (types.cpp:268-283)
+        streams = []
+        for i in range(ndims):
+            mult = old.extent
+            for j in range(i + 1, ndims):
+                mult *= sizes[j]
+            streams.append(StreamData(off=starts[i] * mult, stride=mult,
+                                      count=subsizes[i]))
+        # innermost (last) dim is deepest; build bottom-up
+        for sd in reversed(streams):
+            child = TypeTree(sd, children=[child])
+        child.extent = datatype.extent
+        return child
+
+    # indexed_block / hindexed_block / hindexed / struct: no structured form
+    log.debug(f"couldn't convert {c} to structured type")
+    return None

@@ -1,0 +1,85 @@
+"""Type cache: commit-time analysis results per datatype.
+
+Re-design of the reference's typeCache + MPI_Type_commit interposer
+(/root/reference/include/type_cache.hpp, src/type_commit.cpp): committing a
+datatype runs decode -> simplify -> to_strided_block -> plan_pack and caches a
+TypeRecord {strided block, packer, sender, recver}. Sender/recver strategy
+objects are attached by the parallel layer (type_commit.cpp:52-108 analog in
+parallel/p2p.py) the first time the type is used for communication.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..utils import env as envmod
+from ..utils import logging as log
+from . import canonicalize, tree
+from .dtypes import Datatype
+from .packer import Packer, PackerFallback, plan_pack
+from .strided_block import StridedBlock, to_strided_block
+
+
+@dataclass
+class TypeRecord:
+    desc: StridedBlock = field(default_factory=StridedBlock)
+    packer: Optional[Packer] = None      # fast strided packer, if plannable
+    fallback: Optional[Packer] = None    # typemap packer, always available
+    sender: object = None                # attached by parallel/p2p.py
+    recver: object = None
+
+    def best_packer(self) -> Packer:
+        if self.packer is not None and not envmod.env.no_pack:
+            return self.packer
+        return self.fallback
+
+
+_cache: Dict[Datatype, TypeRecord] = {}
+
+
+def commit(datatype: Datatype) -> TypeRecord:
+    """MPI_Type_commit analog."""
+    if datatype in _cache:
+        datatype.committed = True
+        return _cache[datatype]
+
+    record = TypeRecord()
+    if not envmod.env.no_type_commit:
+        t = tree.traverse(datatype)
+        if t is not None:
+            t = canonicalize.simplify(t)
+            record.desc = to_strided_block(t)
+            if record.desc:
+                record.packer = plan_pack(record.desc)
+    record.fallback = PackerFallback(datatype)
+    _cache[datatype] = record
+    datatype.committed = True
+    log.spew(f"committed {datatype}: {record.desc}")
+    return record
+
+
+def lookup(datatype: Datatype) -> Optional[TypeRecord]:
+    return _cache.get(datatype)
+
+
+def get_or_commit(datatype: Datatype) -> TypeRecord:
+    rec = _cache.get(datatype)
+    return rec if rec is not None else commit(datatype)
+
+
+def free(datatype: Datatype) -> None:
+    """MPI_Type_free analog (reference: release(), types.cpp:707-711)."""
+    _cache.pop(datatype, None)
+    datatype.committed = False
+
+
+def clear() -> None:
+    _cache.clear()
+
+
+def init() -> None:
+    """Pre-commit common named types (types.cpp:713-749 types_init analog)."""
+    from . import dtypes
+    for dt in (dtypes.BYTE, dtypes.FLOAT, dtypes.DOUBLE):
+        commit(dt)

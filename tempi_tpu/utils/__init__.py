@@ -1,0 +1,1 @@
+from . import counters, env, logging, numeric, statistics  # noqa: F401

@@ -1,0 +1,120 @@
+"""Global performance counters.
+
+Re-design of the reference's counter subsystem
+(/root/reference/include/counters.hpp:12-115, src/internal/counters.cpp:30-121):
+grouped global counters incremented on hot paths and dumped per-rank at
+finalize when the output level is DEBUG or lower. Python version keeps the
+same groups, keyed by plain attributes so call sites read like the macros.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, fields
+
+from . import logging as log
+
+
+@dataclass
+class AllocatorCounters:
+    num_allocs: int = 0
+    num_deallocs: int = 0
+    num_requests: int = 0
+    num_releases: int = 0
+    current_usage: int = 0
+    max_usage: int = 0
+
+
+@dataclass
+class DeviceCounters:
+    # analogous to the cudart group: time spent in device API calls
+    launch_time: float = 0.0
+    transfer_time: float = 0.0
+    sync_time: float = 0.0
+    num_launches: int = 0
+    num_transfers: int = 0
+    num_syncs: int = 0
+
+
+@dataclass
+class ModelingCounters:
+    cache_miss: int = 0
+    cache_hit: int = 0
+    wall_time: float = 0.0
+
+
+@dataclass
+class PackCounters:
+    num_packs: int = 0
+    num_unpacks: int = 0
+    bytes_packed: int = 0
+    bytes_unpacked: int = 0
+
+
+@dataclass
+class P2PCounters:
+    num_oneshot: int = 0
+    num_device: int = 0
+    num_staged: int = 0
+    num_fallback: int = 0
+
+
+@dataclass
+class LibCallCounters:
+    num_calls: int = 0
+    wall_time: float = 0.0
+
+
+@dataclass
+class Counters:
+    allocator: AllocatorCounters = field(default_factory=AllocatorCounters)
+    device: DeviceCounters = field(default_factory=DeviceCounters)
+    modeling: ModelingCounters = field(default_factory=ModelingCounters)
+    pack1d: PackCounters = field(default_factory=PackCounters)
+    pack2d: PackCounters = field(default_factory=PackCounters)
+    pack3d: PackCounters = field(default_factory=PackCounters)
+    send: P2PCounters = field(default_factory=P2PCounters)
+    recv: P2PCounters = field(default_factory=P2PCounters)
+    isend: P2PCounters = field(default_factory=P2PCounters)
+    irecv: P2PCounters = field(default_factory=P2PCounters)
+    lib: LibCallCounters = field(default_factory=LibCallCounters)
+
+    def as_dict(self) -> dict:
+        out = {}
+        for group in fields(self):
+            g = getattr(self, group.name)
+            out[group.name] = {f.name: getattr(g, f.name) for f in fields(g)}
+        return out
+
+
+counters = Counters()
+
+
+def init() -> None:
+    global counters
+    counters = Counters()
+
+
+def finalize() -> None:
+    """Dump all counters at DEBUG level, like counters.cpp:30-121."""
+    if log.get_level() <= log.DEBUG:
+        for group, vals in counters.as_dict().items():
+            for name, v in vals.items():
+                if v:
+                    log.debug(f"counter {group}.{name} = {v}")
+
+
+class timed:
+    """Context manager adding elapsed wall time to ``obj.attr``."""
+
+    def __init__(self, obj, attr: str):
+        self.obj, self.attr = obj, attr
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        setattr(self.obj, self.attr,
+                getattr(self.obj, self.attr) + time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,160 @@
+"""TEMPI-compatible environment knob system.
+
+TPU-native re-design of the reference's env subsystem
+(/root/reference/src/internal/env.cpp:23-107, include/env.hpp:10-48): the same
+`TEMPI_*` names gate the same behaviors, parsed once into a module-level
+``Environment`` object that the rest of the framework consults.
+
+Extra knobs with no reference analog (documented where used):
+  TEMPI_PACK_KERNEL   = pallas | xla | auto   (packer backend selection)
+  TEMPI_RANKS_PER_NODE                        (simulated node size on a CPU mesh)
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+
+
+class PlacementMethod(enum.Enum):
+    """Reference: include/env.hpp PlacementMethod (NONE/RANDOM/METIS/KAHIP)."""
+
+    NONE = "none"
+    RANDOM = "random"
+    METIS = "metis"
+    KAHIP = "kahip"
+
+
+class AlltoallvMethod(enum.Enum):
+    """Reference: include/env.hpp AlltoallvMethod."""
+
+    NONE = "none"
+    AUTO = "auto"
+    REMOTE_FIRST = "remote_first"
+    STAGED = "staged"
+    ISIR_STAGED = "isir_staged"
+    ISIR_REMOTE_STAGED = "isir_remote_staged"
+
+
+class DatatypeMethod(enum.Enum):
+    """Reference: include/env.hpp DatatypeMethod (ONESHOT/DEVICE/AUTO).
+
+    On TPU, DEVICE = pack in HBM and move over ICI; ONESHOT's pinned-mapped-host
+    trick maps to packing straight into a ``pinned_host`` buffer (DCN/host path);
+    AUTO consults the measured system model.
+    """
+
+    ONESHOT = "oneshot"
+    DEVICE = "device"
+    AUTO = "auto"
+
+
+class ContiguousMethod(enum.Enum):
+    """Reference: include/env.hpp ContiguousMethod (NONE/AUTO/STAGED)."""
+
+    NONE = "none"
+    AUTO = "auto"
+    STAGED = "staged"
+
+
+class PackKernel(enum.Enum):
+    """TPU-only: which pack backend to use (no reference analog)."""
+
+    AUTO = "auto"
+    PALLAS = "pallas"
+    XLA = "xla"
+
+
+def _cache_dir_fallback(getenv) -> str:
+    # Mirrors the reference's fallback chain (env.cpp:87-106):
+    # TEMPI_CACHE_DIR > XDG_CACHE_HOME/tempi > HOME/.tempi > /var/tmp
+    cd = getenv("TEMPI_CACHE_DIR")
+    if cd:
+        return cd
+    cd = getenv("XDG_CACHE_HOME")
+    if cd:
+        return os.path.join(cd, "tempi")
+    cd = getenv("HOME")
+    if cd:
+        return os.path.join(cd, ".tempi")
+    return "/var/tmp"
+
+
+@dataclass
+class Environment:
+    no_tempi: bool = False
+    no_pack: bool = False
+    no_type_commit: bool = False
+    alltoallv: AlltoallvMethod = AlltoallvMethod.AUTO
+    placement: PlacementMethod = PlacementMethod.NONE
+    datatype: DatatypeMethod = DatatypeMethod.AUTO
+    contiguous: ContiguousMethod = ContiguousMethod.NONE
+    cache_dir: str = ""
+    pack_kernel: PackKernel = PackKernel.AUTO
+    ranks_per_node: int = 0  # 0 = discover from the platform
+
+    @staticmethod
+    def from_environ(environ=None) -> "Environment":
+        getenv = (environ if environ is not None else os.environ).get
+        e = Environment()
+        e.no_tempi = getenv("TEMPI_DISABLE") is not None
+        e.no_pack = getenv("TEMPI_NO_PACK") is not None
+        e.no_type_commit = getenv("TEMPI_NO_TYPE_COMMIT") is not None
+
+        # Later settings override earlier ones, same precedence order as
+        # env.cpp:35-50 (NONE last so TEMPI_NO_ALLTOALLV wins).
+        if getenv("TEMPI_ALLTOALLV_REMOTE_FIRST") is not None:
+            e.alltoallv = AlltoallvMethod.REMOTE_FIRST
+        if getenv("TEMPI_ALLTOALLV_STAGED") is not None:
+            e.alltoallv = AlltoallvMethod.STAGED
+        if getenv("TEMPI_ALLTOALLV_ISIR_STAGED") is not None:
+            e.alltoallv = AlltoallvMethod.ISIR_STAGED
+        if getenv("TEMPI_ALLTOALLV_ISIR_REMOTE_STAGED") is not None:
+            e.alltoallv = AlltoallvMethod.ISIR_REMOTE_STAGED
+        if getenv("TEMPI_NO_ALLTOALLV") is not None:
+            e.alltoallv = AlltoallvMethod.NONE
+
+        if getenv("TEMPI_PLACEMENT_METIS") is not None:
+            e.placement = PlacementMethod.METIS
+        if getenv("TEMPI_PLACEMENT_KAHIP") is not None:
+            e.placement = PlacementMethod.KAHIP
+        if getenv("TEMPI_PLACEMENT_RANDOM") is not None:
+            e.placement = PlacementMethod.RANDOM
+
+        if getenv("TEMPI_DATATYPE_ONESHOT") is not None:
+            e.datatype = DatatypeMethod.ONESHOT
+        if getenv("TEMPI_DATATYPE_DEVICE") is not None:
+            e.datatype = DatatypeMethod.DEVICE
+        if getenv("TEMPI_DATATYPE_AUTO") is not None:
+            e.datatype = DatatypeMethod.AUTO
+
+        if getenv("TEMPI_CONTIGUOUS_STAGED") is not None:
+            e.contiguous = ContiguousMethod.STAGED
+        if getenv("TEMPI_CONTIGUOUS_AUTO") is not None:
+            e.contiguous = ContiguousMethod.AUTO
+
+        e.cache_dir = _cache_dir_fallback(getenv)
+
+        pk = (getenv("TEMPI_PACK_KERNEL") or "auto").lower()
+        try:
+            e.pack_kernel = PackKernel(pk)
+        except ValueError:
+            e.pack_kernel = PackKernel.AUTO
+
+        try:
+            e.ranks_per_node = int(getenv("TEMPI_RANKS_PER_NODE") or 0)
+        except ValueError:
+            e.ranks_per_node = 0
+        return e
+
+
+# Global, (re)read at tempi.init() like read_environment() at MPI_Init.
+env: Environment = Environment.from_environ()
+
+
+def read_environment(environ=None) -> Environment:
+    """Re-parse knobs into the module-global. Called by ``tempi.init()``."""
+    global env
+    env = Environment.from_environ(environ)
+    return env

@@ -1,0 +1,74 @@
+"""Leveled stderr logging with file:line and rank prefix.
+
+Re-design of the reference's compile-time logging macros
+(/root/reference/include/logging.hpp:29-78). Python has no compile-time
+gating, so the level is read once from TEMPI_OUTPUT_LEVEL (SPEW, DEBUG, INFO,
+WARN, ERROR, FATAL; default INFO) and checked per call. FATAL raises instead
+of exit(1) so callers/tests can observe it.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+SPEW, DEBUG, INFO, WARN, ERROR, FATAL = 0, 1, 2, 3, 4, 5
+_NAMES = {"SPEW": SPEW, "DEBUG": DEBUG, "INFO": INFO, "WARN": WARN,
+          "ERROR": ERROR, "FATAL": FATAL}
+_LABELS = {v: k for k, v in _NAMES.items()}
+
+_level = _NAMES.get(os.environ.get("TEMPI_OUTPUT_LEVEL", "INFO").upper(), INFO)
+
+# set by tempi.init(); -1 = not initialized
+world_rank: int = -1
+
+
+class TempiFatal(RuntimeError):
+    pass
+
+
+def set_level(level) -> None:
+    global _level
+    _level = _NAMES[level.upper()] if isinstance(level, str) else int(level)
+
+
+def get_level() -> int:
+    return _level
+
+
+def _emit(level: int, msg: str) -> None:
+    frame = inspect.stack()[2]
+    loc = f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    print(f"[{_LABELS[level]}] [{loc}] [rank {world_rank}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def spew(msg: str) -> None:
+    if _level <= SPEW:
+        _emit(SPEW, msg)
+
+
+def debug(msg: str) -> None:
+    if _level <= DEBUG:
+        _emit(DEBUG, msg)
+
+
+def info(msg: str) -> None:
+    if _level <= INFO:
+        _emit(INFO, msg)
+
+
+def warn(msg: str) -> None:
+    if _level <= WARN:
+        _emit(WARN, msg)
+
+
+def error(msg: str) -> None:
+    if _level <= ERROR:
+        _emit(ERROR, msg)
+
+
+def fatal(msg: str) -> None:
+    _emit(FATAL, msg)
+    raise TempiFatal(msg)

@@ -1,0 +1,37 @@
+"""Numeric helpers (reference: /root/reference/include/numeric.hpp)."""
+
+from __future__ import annotations
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def log2_floor(x: int) -> int:
+    if x <= 0:
+        raise ValueError("log2_floor requires x > 0")
+    return x.bit_length() - 1
+
+
+def log2_ceil(x: int) -> int:
+    if x <= 0:
+        raise ValueError("log2_ceil requires x > 0")
+    return (x - 1).bit_length() if x > 1 else 0
+
+
+def next_pow2(x: int) -> int:
+    return 1 << log2_ceil(x) if x > 1 else 1
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, mult: int) -> int:
+    return cdiv(x, mult) * mult
+
+
+def gcd(a: int, b: int) -> int:
+    while b:
+        a, b = b, a % b
+    return a

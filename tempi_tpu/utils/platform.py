@@ -1,0 +1,44 @@
+"""Platform selection helpers.
+
+This environment may register a remote-TPU JAX backend plugin at interpreter
+boot and force ``jax_platforms`` to prefer it. Tests and multi-chip dry runs
+need a hermetic CPU-only JAX (with ``xla_force_host_platform_device_count``
+virtual devices); benchmarks want the real accelerator. ``force_cpu()`` makes
+the current process CPU-only regardless of what a site hook configured.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(device_count: int = 8) -> None:
+    """Restrict JAX to the host CPU platform with ``device_count`` virtual
+    devices. Must run before the first JAX computation; safe to call even if
+    a plugin backend was registered at interpreter start."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as xb
+
+        # drop any non-CPU plugin factories so backends() cannot try to
+        # initialize them (a remote plugin may block on a dead tunnel)
+        for name in [n for n in xb._backend_factories if n not in ("cpu",)]:
+            xb._backend_factories.pop(name, None)
+        if xb._backends:
+            jax.clear_backends()
+    except Exception:
+        pass
+
+
+def want_cpu() -> bool:
+    """True when the caller's environment asked for CPU execution."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"

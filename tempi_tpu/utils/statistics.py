@@ -1,0 +1,72 @@
+"""Sample statistics with trimean.
+
+Re-design of the reference's Statistics class
+(/root/reference/src/internal/statistics.cpp, include/statistics.hpp): an
+accumulator over inserted samples reporting avg/min/max/med/stddev and the
+trimean (the reference's preferred robust benchmark statistic,
+statistics.cpp:30-39).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List
+
+
+def _quantile(sorted_xs: List[float], q: float) -> float:
+    """Linear-interpolated quantile of an ascending list."""
+    n = len(sorted_xs)
+    if n == 0:
+        raise ValueError("no samples")
+    if n == 1:
+        return sorted_xs[0]
+    pos = q * (n - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_xs[lo] * (1 - frac) + sorted_xs[hi] * frac
+
+
+class Statistics:
+    def __init__(self, xs: Iterable[float] = ()):  # noqa: D401
+        self._xs: List[float] = []
+        for x in xs:
+            self.insert(x)
+
+    def insert(self, x: float) -> None:
+        self._xs.append(float(x))
+
+    def __len__(self) -> int:
+        return len(self._xs)
+
+    @property
+    def count(self) -> int:
+        return len(self._xs)
+
+    def min(self) -> float:
+        return min(self._xs)
+
+    def max(self) -> float:
+        return max(self._xs)
+
+    def avg(self) -> float:
+        return sum(self._xs) / len(self._xs)
+
+    def med(self) -> float:
+        return _quantile(sorted(self._xs), 0.5)
+
+    def stddev(self) -> float:
+        n = len(self._xs)
+        if n < 2:
+            return 0.0
+        mu = self.avg()
+        return math.sqrt(sum((x - mu) ** 2 for x in self._xs) / (n - 1))
+
+    def trimean(self) -> float:
+        """(Q1 + 2*Q2 + Q3) / 4 — the robust location estimate the reference
+        reports for every benchmark (statistics.cpp:30-39)."""
+        s = sorted(self._xs)
+        return (_quantile(s, 0.25) + 2 * _quantile(s, 0.5) + _quantile(s, 0.75)) / 4
+
+    def raw(self) -> List[float]:
+        return list(self._xs)

@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Multi-chip code is tested on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count), mirroring how the reference tests with
+single-node `mpiexec -n {1,2,4}` (reference: test/CMakeLists.txt). Set
+TEMPI_TEST_TPU=1 to run tests against the real TPU instead.
+"""
+
+import os
+
+if os.environ.get("TEMPI_TEST_TPU") != "1":
+    from tempi_tpu.utils.platform import force_cpu
+
+    force_cpu(device_count=8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    """Each test sees freshly-parsed env knobs and zeroed counters."""
+    from tempi_tpu.utils import counters, env
+
+    env.read_environment()
+    counters.init()
+    yield

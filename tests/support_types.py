@@ -1,0 +1,168 @@
+"""Datatype factory zoo for tests and benchmarks.
+
+Analog of the reference's support library (/root/reference/support/type.cpp):
+many spellings of the same 1-D/2-D/3-D objects, used for equivalence and
+differential pack tests. Like the reference ("support/ is code only used by
+tests and benchmarks"), the library itself never imports this.
+
+Dim3 is (x, y, z) in bytes; x is the fastest-varying dimension.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tempi_tpu.ops import dtypes as dt
+
+
+def make_byte_vn_hv_hv(copy, alloc):
+    """vector of n 1-byte blocks + hvector + hvector (type.cpp:3-32)."""
+    row = dt.vector(copy[0], 1, 1, dt.BYTE)
+    plane = dt.hvector(copy[1], 1, alloc[0], row)
+    return dt.hvector(copy[2], 1, alloc[0] * alloc[1], plane)
+
+
+def make_byte_v1_hv_hv(copy, alloc):
+    """vector of 1 n-byte block + hvector + hvector (type.cpp:34-65)."""
+    row = dt.vector(1, copy[0], alloc[0], dt.BYTE)
+    plane = dt.hvector(copy[1], 1, alloc[0], row)
+    return dt.hvector(copy[2], 1, alloc[0] * alloc[1], plane)
+
+
+def make_byte_v_hv(copy, alloc):
+    """byte + vector + hvector (type.cpp:67-88)."""
+    plane = dt.vector(copy[1], copy[0], alloc[0], dt.BYTE)
+    return dt.hvector(copy[2], 1, alloc[0] * alloc[1], plane)
+
+
+def make_float_v_hv(copy, alloc):
+    """float + vector + hvector (type.cpp:90-111)."""
+    assert copy[0] % 4 == 0 and alloc[0] % 4 == 0
+    plane = dt.vector(copy[1], copy[0] // 4, alloc[0] // 4, dt.FLOAT)
+    return dt.hvector(copy[2], 1, alloc[0] * alloc[1], plane)
+
+
+def make_hi(copy, alloc):
+    """hindexed, each block is a row (type.cpp:113-136)."""
+    disp = [z * alloc[0] * alloc[1] + y * alloc[0]
+            for z in range(copy[2]) for y in range(copy[1])]
+    return dt.hindexed([copy[0]] * len(disp), disp, dt.BYTE)
+
+
+def make_hib(copy, alloc):
+    """hindexed_block, each block is a row (type.cpp:138-156)."""
+    disp = [z * alloc[0] * alloc[1] + y * alloc[0]
+            for z in range(copy[2]) for y in range(copy[1])]
+    return dt.hindexed_block(copy[0], disp, dt.BYTE)
+
+
+def make_subarray(copy, alloc):
+    """3-D cube via subarray (type.cpp:158-170). C order: z slowest."""
+    return dt.subarray([alloc[2], alloc[1], alloc[0]],
+                       [copy[2], copy[1], copy[0]], [0, 0, 0], dt.BYTE)
+
+
+def make_subarray_v(copy, alloc):
+    """3-D cube as hvector of 2-D subarray planes (type.cpp:172-197)."""
+    plane = dt.subarray([alloc[1], alloc[0]], [copy[1], copy[0]], [0, 0],
+                        dt.BYTE)
+    return dt.hvector(copy[2], 1, alloc[0] * alloc[1], plane)
+
+
+def make_off_subarray(copy, alloc, off):
+    """3-D cube via subarray with a start offset (type.cpp:199-214)."""
+    return dt.subarray([alloc[2], alloc[1], alloc[0]],
+                       [copy[2], copy[1], copy[0]],
+                       [off[2], off[1], off[0]], dt.BYTE)
+
+
+FACTORIES_3D = {
+    "byte_vn_hv_hv": make_byte_vn_hv_hv,
+    "byte_v1_hv_hv": make_byte_v1_hv_hv,
+    "byte_v_hv": make_byte_v_hv,
+    "float_v_hv": make_float_v_hv,
+    "hi": make_hi,
+    "hib": make_hib,
+    "subarray": make_subarray,
+    "subarray_v": make_subarray_v,
+}
+
+
+def make_2d_byte_vector(num_blocks, block_length, stride):
+    return dt.vector(num_blocks, block_length, stride, dt.BYTE)
+
+
+def make_2d_byte_hvector(num_blocks, block_length, stride):
+    return dt.hvector(num_blocks, block_length, stride, dt.BYTE)
+
+
+def make_2d_byte_subarray(num_blocks, block_length, stride):
+    return dt.subarray([num_blocks, stride], [num_blocks, block_length],
+                       [0, 0], dt.BYTE)
+
+
+FACTORIES_2D = {
+    "2d_byte_vector": make_2d_byte_vector,
+    "2d_byte_hvector": make_2d_byte_hvector,
+    "2d_byte_subarray": make_2d_byte_subarray,
+}
+
+
+def make_2d_hv_by_rows(block_size, c1, s1, c2, s2):
+    """rows of blocks, then a stack of rows (type.cpp:245-259)."""
+    block = dt.contiguous(block_size, dt.BYTE)
+    row = dt.hvector(c1, 1, s1, block)
+    return dt.hvector(c2, 1, s2, row)
+
+
+def make_contiguous_byte_v1(n):
+    return dt.vector(1, n, n, dt.BYTE)
+
+
+def make_contiguous_byte_vn(n):
+    return dt.vector(n, 1, 1, dt.BYTE)
+
+
+def make_contiguous_subarray(n):
+    return dt.subarray([n], [n], [0], dt.BYTE)
+
+
+def make_contiguous_contiguous(n):
+    return dt.contiguous(n, dt.BYTE)
+
+
+FACTORIES_1D = {
+    "contiguous_byte_v1": make_contiguous_byte_v1,
+    "contiguous_byte_vn": make_contiguous_byte_vn,
+    "contiguous_subarray": make_contiguous_subarray,
+    "contiguous_contiguous": make_contiguous_contiguous,
+}
+
+
+# -- numpy oracle (the reference's "underlying library" stand-in) ------------
+
+
+def oracle_pack(buf: np.ndarray, datatype, incount: int) -> np.ndarray:
+    """Element-wise typemap pack: ground truth for differential tests."""
+    tm = datatype.typemap()
+    idx = np.concatenate(
+        [np.arange(o, o + l, dtype=np.int64) for o, l in tm]
+    ) if tm.size else np.zeros(0, np.int64)
+    out = np.empty(incount * datatype.size, dtype=np.uint8)
+    for i in range(incount):
+        out[i * datatype.size:(i + 1) * datatype.size] = \
+            buf[idx + i * datatype.extent]
+    return out
+
+
+def oracle_unpack(buf: np.ndarray, packed: np.ndarray, datatype,
+                  outcount: int) -> np.ndarray:
+    out = buf.copy()
+    tm = datatype.typemap()
+    idx = np.concatenate(
+        [np.arange(o, o + l, dtype=np.int64) for o, l in tm]
+    ) if tm.size else np.zeros(0, np.int64)
+    for i in range(outcount):
+        out[idx + i * datatype.extent] = \
+            packed[i * datatype.size:(i + 1) * datatype.size]
+    return out

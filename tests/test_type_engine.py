@@ -1,0 +1,133 @@
+"""Datatype engine tests.
+
+Mirrors the reference's test strategy (test/type_equivalence.cpp,
+test/type_commit.cpp): equivalent spellings of an object canonicalize to the
+same StridedBlock, and every factory type commits cleanly.
+"""
+
+import pytest
+
+import support_types as st
+from tempi_tpu.ops import canonicalize, dtypes as dt, tree, type_cache
+from tempi_tpu.ops.strided_block import to_strided_block
+from tempi_tpu.ops.tree import DenseData, StreamData
+
+
+def canon_sb(datatype):
+    t = tree.traverse(datatype)
+    if t is None:
+        return None
+    return to_strided_block(canonicalize.simplify(t))
+
+
+def test_named_is_dense():
+    t = tree.traverse(dt.DOUBLE)
+    assert isinstance(t.data, DenseData) and t.data.extent == 8
+    sb = canon_sb(dt.DOUBLE)
+    assert sb.ndims == 1 and sb.counts == [8] and sb.start == 0
+
+
+def test_vector_decodes_to_two_streams():
+    v = dt.vector(3, 2, 5, dt.FLOAT)
+    t = tree.traverse(v)
+    assert isinstance(t.data, StreamData)
+    assert t.data.count == 3 and t.data.stride == 20
+    c = t.children[0]
+    assert c.data.count == 2 and c.data.stride == 4
+
+
+def test_contiguous_collapses_to_1d():
+    for name, f in st.FACTORIES_1D.items():
+        sb = canon_sb(f(64))
+        assert sb is not None and sb.ndims == 1, name
+        assert sb.counts == [64] and sb.strides == [1] and sb.start == 0, name
+
+
+def test_2d_spellings_equivalent():
+    """vector / hvector / subarray spellings of the same 2-D object produce
+    identical StridedBlocks (reference test/type_equivalence.cpp:58-118)."""
+    sbs = {name: canon_sb(f(7, 3, 16)) for name, f in st.FACTORIES_2D.items()}
+    ref = sbs["2d_byte_vector"]
+    assert ref.ndims == 2
+    assert ref.counts == [3, 7] and ref.strides == [1, 16]
+    for name, sb in sbs.items():
+        assert sb == ref, f"{name}: {sb} != {ref}"
+
+
+def test_3d_spellings_equivalent():
+    copy, alloc = (4, 3, 5), (16, 8, 10)
+    ref = canon_sb(st.make_subarray(copy, alloc))
+    assert ref.ndims == 3
+    assert ref.counts == [4, 3, 5]
+    assert ref.strides == [1, 16, 16 * 8]
+    for name in ("byte_vn_hv_hv", "byte_v1_hv_hv", "byte_v_hv", "float_v_hv",
+                 "subarray_v"):
+        sb = canon_sb(st.FACTORIES_3D[name]((4, 3, 5), (16, 8, 10)))
+        assert sb == ref, f"{name}: {sb} != {ref}"
+
+
+def test_full_width_3d_collapses():
+    """When copy extent equals alloc extent in x (and y), dims fold away."""
+    sb = canon_sb(st.make_subarray((16, 8, 4), (16, 8, 10)))
+    assert sb.ndims == 1 and sb.counts == [16 * 8 * 4]
+    sb = canon_sb(st.make_subarray((16, 4, 4), (16, 8, 10)))
+    assert sb.ndims == 2
+    assert sb.counts == [16 * 4, 4] and sb.strides == [1, 16 * 8]
+
+
+def test_off_subarray_start():
+    sb = canon_sb(st.make_off_subarray((4, 3, 2), (16, 8, 10), (2, 1, 3)))
+    assert sb.start == 3 * 16 * 8 + 1 * 16 + 2
+    assert sb.counts == [4, 3, 2]
+
+
+def test_unsupported_combiners_decode_to_none():
+    assert tree.traverse(st.make_hi((4, 3, 2), (16, 8, 4))) is None
+    assert tree.traverse(st.make_hib((4, 3, 2), (16, 8, 4))) is None
+    s = dt.struct([1, 1], [0, 8], [dt.FLOAT, dt.DOUBLE])
+    assert tree.traverse(s) is None
+
+
+def test_typemap_merges_contiguous():
+    v = dt.vector(2, 4, 8, dt.BYTE)
+    tm = v.typemap()
+    assert tm.tolist() == [[0, 4], [8, 4]]
+    c = dt.contiguous(4, dt.FLOAT)
+    assert c.typemap().tolist() == [[0, 16]]
+
+
+def test_extent_and_size():
+    v = dt.vector(3, 2, 5, dt.FLOAT)
+    assert v.size == 24 and v.extent == (2 * 5 + 2) * 4
+    hv = dt.hvector(3, 2, 20, dt.FLOAT)
+    assert hv.size == 24 and hv.extent == 2 * 20 + 8
+    sa = dt.subarray([4, 6], [2, 3], [1, 2], dt.DOUBLE)
+    assert sa.size == 6 * 8 and sa.extent == 24 * 8
+    assert dt.pack_size(3, v) == 72
+
+
+def test_commit_type_zoo():
+    """Commit smoke over every factory (reference test/type_commit.cpp)."""
+    type_cache.clear()
+    for f in st.FACTORIES_1D.values():
+        rec = type_cache.commit(f(128))
+        assert rec.desc.ndims == 1 and rec.packer is not None
+    for f in st.FACTORIES_2D.values():
+        rec = type_cache.commit(f(4, 16, 64))
+        assert rec.desc.ndims == 2 and rec.packer is not None
+    for name, f in st.FACTORIES_3D.items():
+        rec = type_cache.commit(f((8, 4, 2), (16, 8, 4)))
+        if name in ("hi", "hib"):
+            assert rec.packer is None and rec.fallback is not None
+        else:
+            assert rec.packer is not None, name
+    type_cache.clear()
+
+
+def test_commit_respects_no_type_commit(monkeypatch):
+    from tempi_tpu.utils import env as env_mod
+    monkeypatch.setattr(env_mod.env, "no_type_commit", True)
+    type_cache.clear()
+    rec = type_cache.commit(st.make_2d_byte_vector(4, 8, 32))
+    assert rec.packer is None and rec.fallback is not None
+    type_cache.clear()

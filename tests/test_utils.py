@@ -1,0 +1,89 @@
+"""Unit tests for utils (reference analog: test/numeric.cpp)."""
+
+import math
+
+import pytest
+
+from tempi_tpu.utils import numeric
+from tempi_tpu.utils.env import (
+    AlltoallvMethod,
+    ContiguousMethod,
+    DatatypeMethod,
+    Environment,
+    PlacementMethod,
+)
+from tempi_tpu.utils.statistics import Statistics
+
+
+def test_pow2_log2():
+    assert numeric.is_pow2(1)
+    assert numeric.is_pow2(1024)
+    assert not numeric.is_pow2(0)
+    assert not numeric.is_pow2(3)
+    assert numeric.log2_floor(1) == 0
+    assert numeric.log2_floor(2) == 1
+    assert numeric.log2_floor(3) == 1
+    assert numeric.log2_floor(1024) == 10
+    assert numeric.log2_ceil(1) == 0
+    assert numeric.log2_ceil(3) == 2
+    assert numeric.log2_ceil(1024) == 10
+    assert numeric.next_pow2(3) == 4
+    assert numeric.cdiv(7, 2) == 4
+    assert numeric.round_up(7, 4) == 8
+
+
+def test_env_defaults():
+    e = Environment.from_environ({})
+    assert not e.no_tempi and not e.no_pack and not e.no_type_commit
+    assert e.alltoallv is AlltoallvMethod.AUTO
+    assert e.placement is PlacementMethod.NONE
+    assert e.datatype is DatatypeMethod.AUTO
+    assert e.contiguous is ContiguousMethod.NONE
+    assert e.cache_dir == "/var/tmp"
+
+
+def test_env_knobs():
+    e = Environment.from_environ({
+        "TEMPI_DISABLE": "", "TEMPI_NO_PACK": "",
+        "TEMPI_ALLTOALLV_STAGED": "", "TEMPI_PLACEMENT_KAHIP": "",
+        "TEMPI_DATATYPE_ONESHOT": "", "TEMPI_CONTIGUOUS_AUTO": "",
+        "TEMPI_CACHE_DIR": "/tmp/tc",
+    })
+    assert e.no_tempi and e.no_pack
+    assert e.alltoallv is AlltoallvMethod.STAGED
+    assert e.placement is PlacementMethod.KAHIP
+    assert e.datatype is DatatypeMethod.ONESHOT
+    assert e.contiguous is ContiguousMethod.AUTO
+    assert e.cache_dir == "/tmp/tc"
+
+
+def test_env_no_alltoallv_wins():
+    e = Environment.from_environ({
+        "TEMPI_ALLTOALLV_STAGED": "", "TEMPI_NO_ALLTOALLV": "",
+    })
+    assert e.alltoallv is AlltoallvMethod.NONE
+
+
+def test_env_cache_fallbacks():
+    e = Environment.from_environ({"XDG_CACHE_HOME": "/xdg"})
+    assert e.cache_dir == "/xdg/tempi"
+    e = Environment.from_environ({"HOME": "/home/u"})
+    assert e.cache_dir == "/home/u/.tempi"
+
+
+def test_statistics_basic():
+    s = Statistics([1, 2, 3, 4, 5])
+    assert s.min() == 1 and s.max() == 5
+    assert s.avg() == 3 and s.med() == 3
+    assert math.isclose(s.stddev(), math.sqrt(2.5))
+    assert s.trimean() == 3.0
+
+
+def test_trimean_robust_to_outlier():
+    s = Statistics([1, 1, 1, 1, 100])
+    assert s.trimean() < s.avg()
+
+
+def test_statistics_empty_raises():
+    with pytest.raises(ValueError):
+        Statistics().med()
