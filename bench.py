@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Driver benchmark: one JSON line with the headline metric.
+
+Headline: 2-D subarray MPI_Pack bandwidth on the accelerator (BASELINE.json
+metric #1, reference workload /root/reference/bin/bench_mpi_pack.cpp at the
+4 MiB target). ``vs_baseline`` compares against the reference's CUDA pack on
+a Summit V100 at the same shape; the repo publishes charts, not tables
+(BASELINE.md), so the denominator is a documented estimate from the TEMPI
+paper's pack-bandwidth chart scale: ~50 GB/s for large 2-D objects with
+512 B block length.
+"""
+
+import json
+import sys
+import time
+
+REFERENCE_V100_PACK_GBS = 50.0
+
+
+def _accelerator_usable(timeout_s: int = 120) -> bool:
+    """Probe jax.devices() in a child process with a hard kill: a wedged
+    remote-TPU tunnel blocks in PJRT C code where even SIGALRM can't fire,
+    so an in-process guard cannot work."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('cpu' if all(x.platform=='cpu' for x in d) else 'acc')"],
+            capture_output=True, timeout=timeout_s, text=True)
+        return r.returncode == 0 and "acc" in r.stdout
+    except Exception:
+        return False
+
+
+def main() -> int:
+    platform = "tpu"
+    if not _accelerator_usable():
+        print("accelerator unavailable (tunnel down or wedged); "
+              "falling back to CPU", file=sys.stderr)
+        from tempi_tpu.utils.platform import force_cpu
+
+        force_cpu(device_count=1)
+        platform = "cpu-fallback"
+    import jax
+
+    devices = jax.devices()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.ops import type_cache
+
+    # 4 MiB packed object: 8192 rows x 512 B at 1024 B stride
+    nblocks, bl, stride = 8192, 512, 1024
+    ty = dt.subarray([nblocks, stride], [nblocks, bl], [0, 0], dt.BYTE)
+    rec = type_cache.get_or_commit(ty)
+    packer = rec.best_packer()
+    buf = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(0, 256, ty.extent,
+                                                      np.uint8)),
+        devices[0])
+    packer.pack(buf, 1).block_until_ready()  # compile
+    r = benchmark(lambda: packer.pack(buf, 1).block_until_ready())
+    gbs = ty.size / r.trimean / 1e9
+    print(json.dumps({
+        "metric": f"bench-mpi-pack 2D subarray pack bandwidth ({platform})",
+        "value": round(gbs, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / REFERENCE_V100_PACK_GBS, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Shared helpers for the benchmark CLIs.
+
+Analog of the reference's bin/ support glue (bin/benchmark.cpp, support/):
+platform selection, CSV emission, and the random communication matrices.
+Benchmarks default to the real accelerator; pass --cpu for the virtual CPU
+mesh (multi-rank benches need it on a single-chip machine).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, _REPO)
+
+
+def base_parser(desc: str, multirank: bool = False) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--cpu", action="store_true",
+                   help="run on a virtual CPU mesh instead of the accelerator")
+    p.add_argument("--cpu-devices", type=int, default=8)
+    p.add_argument("--quick", action="store_true",
+                   help="short sampling budgets")
+    return p
+
+
+def setup_platform(args) -> None:
+    if args.cpu:
+        from tempi_tpu.utils.platform import force_cpu
+        force_cpu(device_count=args.cpu_devices)
+
+
+def accelerator_usable(timeout_s: int = 120) -> bool:
+    """Probe jax.devices() in a child process with a hard kill: a wedged
+    remote-TPU tunnel blocks in PJRT C code where even SIGALRM can't fire,
+    so an in-process guard cannot work."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('cpu' if all(x.platform=='cpu' for x in d) else 'acc')"],
+            capture_output=True, timeout=timeout_s, text=True)
+        return r.returncode == 0 and "acc" in r.stdout
+    except Exception:
+        return False
+
+
+def devices_or_die(min_devices: int = 1):
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and not accelerator_usable():
+        print("accelerator unavailable (tunnel down or wedged); "
+              "re-run with --cpu", file=sys.stderr)
+        sys.exit(2)
+    devs = jax.devices()
+    if len(devs) < min_devices:
+        print(f"need {min_devices} devices, have {len(devs)} "
+              f"({devs}); re-run with --cpu", file=sys.stderr)
+        sys.exit(2)
+    return devs
+
+
+def bench_kwargs(quick: bool) -> dict:
+    if quick:
+        return dict(min_sample_secs=50e-6, max_trial_secs=0.1,
+                    max_samples=20, max_trials=2)
+    return {}
+
+
+def emit_csv(header, rows) -> None:
+    print(",".join(str(h) for h in header))
+    for r in rows:
+        print(",".join(f"{v:.6e}" if isinstance(v, float) else str(v)
+                       for v in r))

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""3-D halo exchange — BASELINE config 3.
+
+Re-design of /root/reference/bin/bench_halo_exchange.cpp: X^3 float grid over
+N ranks (recursive bisection), radius-1 26-neighbor exchange via packed
+isend/irecv each iteration, optional placement reorder, CSV of per-iteration
+time and iters/s. The default 512^3 over 8 ranks matches BASELINE.json.
+"""
+
+import sys
+import time
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("3-D halo exchange")
+    p.add_argument("-x", "--grid", type=int, default=512)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--reorder", action="store_true")
+    p.add_argument("--compute", action="store_true",
+                   help="include the stencil update each iteration")
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.models import halo3d
+
+    devices_or_die(1)
+    comm = api.init()
+    ex = halo3d.HaloExchange(comm, X=args.grid, reorder=args.reorder)
+    buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
+    stencil = ex.stencil_fn() if args.compute else None
+
+    # warmup/compile
+    ex.exchange(buf)
+    if stencil is not None:
+        buf.data = stencil(buf.data)
+    buf.data.block_until_ready()
+
+    iters = max(1, args.iters // 10) if args.quick else args.iters
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ex.exchange(buf)
+        if stencil is not None:
+            buf.data = stencil(buf.data)
+    buf.data.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    halo_bytes = sum(e.cells for e in ex.edges) * 4
+    emit_csv(("grid", "ranks", "iters", "total_s", "iter_s", "iters_per_s",
+              "halo_MB_per_iter"),
+             [(args.grid, comm.size, iters, dt, dt / iters, iters / dt,
+               halo_bytes / 1e6)])
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Pack/unpack bandwidth over the 2-D/3-D datatype zoo — BASELINE config 1.
+
+Re-design of /root/reference/bin/bench_mpi_pack.cpp: one rank, MPI_Pack and
+MPI_Unpack of 2-D (numBlocks x blockLength, stride 512) and 3-D objects at
+target total sizes {1 KiB, 1 MiB, 4 MiB}, reporting trimean seconds and
+bytes/s per spelling. Run on the accelerator by default.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("pack/unpack bandwidth")
+    p.add_argument("--targets", type=int, nargs="*",
+                   default=[1 << 10, 1 << 20, 4 << 20])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import support_types as st
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import type_cache
+
+    devices_or_die(1)
+    kw = bench_kwargs(args.quick)
+
+    rows = []
+    for target in args.targets:
+        cases = {}
+        stride = 512
+        bl = 256
+        nblocks = max(1, target // bl)
+        for name, f in st.FACTORIES_2D.items():
+            cases[name] = f(nblocks, bl, stride)
+        side = max(4, round(target ** (1 / 3)) // 4 * 4)
+        alloc = (side * 2, side * 2, side * 2)
+        for name in ("subarray", "byte_v_hv", "byte_vn_hv_hv"):
+            cases[name] = st.FACTORIES_3D[name]((side, side, side), alloc)
+        for name, ty in cases.items():
+            rec = type_cache.get_or_commit(ty)
+            packer = rec.best_packer()
+            buf = jax.device_put(
+                jnp.asarray(np.random.default_rng(0).integers(
+                    0, 256, ty.extent, np.uint8)))
+            packer.pack(buf, 1).block_until_ready()  # compile
+            r = benchmark(lambda: packer.pack(buf, 1).block_until_ready(),
+                          **kw)
+            packed = packer.pack(buf, 1)
+            ru = benchmark(
+                lambda: packer.unpack(buf, packed, 1).block_until_ready(),
+                **kw)
+            rows.append((name, target, ty.size, r.trimean,
+                         ty.size / r.trimean, ru.trimean,
+                         ty.size / ru.trimean))
+    emit_csv(("type", "target_B", "size_B", "pack_s", "pack_Bps",
+              "unpack_s", "unpack_Bps"), rows)
+    best = max(r[4] for r in rows)
+    print(f"# best pack bandwidth: {best / 1e9:.2f} GB/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Contiguous pingpong (re-design of
+/root/reference/bin/bench_mpi_pingpong_1d.cpp): two ranks bounce a
+contiguous buffer; trimean one-way latency per size."""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("contiguous pingpong")
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[1 << i for i in range(0, 24, 2)])
+    args = p.parse_args()
+    setup_platform(args)
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+
+    rows = []
+    for nbytes in args.sizes:
+        ty = dt.contiguous(nbytes, dt.BYTE)
+        buf = comm.alloc(nbytes)
+
+        def pingpong():
+            r1 = p2p.isend(comm, 0, buf, 1, ty)
+            r2 = p2p.irecv(comm, 1, buf, 0, ty)
+            p2p.waitall([r1, r2])
+            r3 = p2p.isend(comm, 1, buf, 0, ty)
+            r4 = p2p.irecv(comm, 0, buf, 1, ty)
+            p2p.waitall([r3, r4])
+            buf.data.block_until_ready()
+
+        pingpong()
+        r = benchmark(pingpong, **kw)
+        rows.append((nbytes, r.trimean / 2, int(r.iid_ok)))
+    emit_csv(("bytes", "oneway_s", "iid"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""2-D strided-datatype pingpong — BASELINE config 2.
+
+Re-design of /root/reference/bin/bench_mpi_pingpong_nd.cpp: two ranks
+exchange a 2-D strided object back and forth; reports the trimean one-way
+latency per strategy (DEVICE vs STAGED vs ONESHOT), max across ranks.
+Needs >= 2 devices (use --cpu on a single-chip machine).
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("2-D strided pingpong")
+    p.add_argument("--blocklength", type=int, default=256)
+    p.add_argument("--stride", type=int, default=512)
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[1 << 10, 1 << 14, 1 << 18, 1 << 20, 4 << 20])
+    p.add_argument("--strategies", nargs="*",
+                   default=["device", "staged", "oneshot"])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    import support_types as st
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.parallel import p2p
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+
+    rows = []
+    for nbytes in args.sizes:
+        nblocks = max(1, nbytes // args.blocklength)
+        ty = st.make_2d_byte_subarray(nblocks, args.blocklength, args.stride)
+        buf = comm.alloc(ty.extent)
+
+        def pingpong(strategy):
+            r1 = p2p.isend(comm, 0, buf, 1, ty)
+            r2 = p2p.irecv(comm, 1, buf, 0, ty)
+            p2p.waitall([r1, r2], strategy)
+            r3 = p2p.isend(comm, 1, buf, 0, ty)
+            r4 = p2p.irecv(comm, 0, buf, 1, ty)
+            p2p.waitall([r3, r4], strategy)
+            buf.data.block_until_ready()
+
+        for strategy in args.strategies:
+            pingpong(strategy)  # compile
+            r = benchmark(lambda: pingpong(strategy), **kw)
+            rows.append((strategy, nbytes, ty.size, r.trimean / 2,
+                         r.iters_per_sample, int(r.iid_ok)))
+    emit_csv(("strategy", "bytes", "packed_B", "oneway_s", "iters", "iid"),
+             rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Random sparse alltoallv with dist-graph remap — BASELINE config 4.
+
+Re-design of /root/reference/bin/bench_alltoallv_random_sparse.cpp and
+bin/bench_mpi_random_alltoallv.cpp: a random sparse communication matrix,
+alltoallv under each strategy, with and without the graph-partition rank
+remap; reports trimean time and node-boundary traffic before/after the remap.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def make_sparse_counts(size, density, scale, seed):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(1, scale, (size, size))
+    counts[rng.random((size, size)) > density] = 0
+    np.fill_diagonal(counts, 0)
+    return counts
+
+
+def offnode_bytes(comm, counts):
+    """Traffic crossing a node boundary under the communicator's placement
+    (reference: bench_alltoallv_random_sparse.cpp:41-80 node stats)."""
+    total = 0
+    for a in range(comm.size):
+        for b in range(comm.size):
+            if counts[a, b] and comm.node_of_app_rank(a) != \
+                    comm.node_of_app_rank(b):
+                total += int(counts[a, b])
+    return total
+
+
+def main() -> int:
+    p = base_parser("random sparse alltoallv")
+    p.add_argument("--density", type=float, default=0.3)
+    p.add_argument("--scale", type=int, default=1 << 16)
+    p.add_argument("--ranks-per-node", type=int, default=2)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils.env import AlltoallvMethod
+    import os
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+
+    devices_or_die(1)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    counts = make_sparse_counts(size, args.density, args.scale, seed=1)
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    for r in range(size):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(counts.T[r])[:-1]])
+    nb_s = int(counts.sum(1).max())
+    nb_r = int(counts.sum(0).max())
+    sbuf = comm.alloc(max(nb_s, 1))
+    rbuf = comm.alloc(max(nb_r, 1))
+
+    # graph remap: neighbors weighted by traffic (config 4's dist_graph step)
+    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+               for r in range(size)]
+    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
+    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+    from tempi_tpu.utils.env import PlacementMethod
+    gcomm = api.dist_graph_create_adjacent(
+        comm, sources, dests, sweights=sw, dweights=dw, reorder=True,
+        method=PlacementMethod.KAHIP)
+
+    rows = []
+    for label, c in (("original", comm), ("remapped", gcomm)):
+        off = offnode_bytes(c, counts)
+        for method in (AlltoallvMethod.AUTO, AlltoallvMethod.STAGED,
+                       AlltoallvMethod.REMOTE_FIRST):
+            sb = c.alloc(max(nb_s, 1))
+            rb = c.alloc(max(nb_r, 1))
+
+            def run():
+                api.alltoallv(c, sb, counts, sdispls, rb, counts.T, rdispls,
+                              method=method)
+                rb.data.block_until_ready()
+
+            run()  # compile
+            r = benchmark(run, **kw)
+            rows.append((label, method.value, int(counts.sum()), off,
+                         r.trimean))
+    emit_csv(("placement", "method", "total_B", "offnode_B", "time_s"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

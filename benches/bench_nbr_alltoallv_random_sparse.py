@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Sparse neighbor_alltoallv with reorder — BASELINE config 5.
+
+Re-design of /root/reference/bin/bench_nbr_alltoallv_random_sparse.cpp: a
+random sparse neighborhood graph, dist_graph_create_adjacent with reorder, and
+neighbor_alltoallv over the resulting communicator; reports trimean time and
+off-node traffic with and without the remap.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+from bench_mpi_random_alltoallv import make_sparse_counts, offnode_bytes
+
+
+def main() -> int:
+    p = base_parser("sparse neighbor alltoallv")
+    p.add_argument("--density", type=float, default=0.25)
+    p.add_argument("--scale", type=int, default=1 << 14)
+    p.add_argument("--ranks-per-node", type=int, default=2)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+    import os
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils.env import PlacementMethod
+
+    devices_or_die(1)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    counts = make_sparse_counts(size, args.density, args.scale, seed=3)
+
+    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+               for r in range(size)]
+    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
+    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+
+    rows = []
+    for label, reorder in (("original", False), ("remapped", True)):
+        g = api.dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sw, dweights=dw, reorder=reorder,
+            method=PlacementMethod.KAHIP if reorder else None)
+        nb_s = max(1, int(counts.sum(1).max()))
+        nb_r = max(1, int(counts.sum(0).max()))
+        sb = g.alloc(nb_s)
+        rb = g.alloc(nb_r)
+        sc, sd, rc, rd = [], [], [], []
+        for r in range(size):
+            srcs, dsts = g.graph[r]
+            cs = [int(counts[r, d]) for d in dsts]
+            cr = [int(counts[s, r]) for s in srcs]
+            sc.append(cs)
+            sd.append(list(np.concatenate([[0], np.cumsum(cs)[:-1]])
+                           if cs else []))
+            rc.append(cr)
+            rd.append(list(np.concatenate([[0], np.cumsum(cr)[:-1]])
+                           if cr else []))
+
+        def run():
+            api.neighbor_alltoallv(g, sb, sc, sd, rb, rc, rd)
+            rb.data.block_until_ready()
+
+        run()  # compile
+        res = benchmark(run, **kw)
+        rows.append((label, int(counts.sum()), offnode_bytes(g, counts),
+                     res.trimean))
+    emit_csv(("placement", "total_B", "offnode_B", "time_s"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

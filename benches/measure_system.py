@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""System measurement tool.
+
+Re-design of /root/reference/bin/measure_system.cpp: import the existing
+perf.json (if any), measure only the missing sections, re-export. Run once
+per machine; senders then model DEVICE vs ONESHOT from the cached curves.
+"""
+
+import sys
+
+from _common import base_parser, devices_or_die, setup_platform
+
+
+def main() -> int:
+    p = base_parser("measure system performance")
+    args = p.parse_args()
+    setup_platform(args)
+
+    from tempi_tpu.measure import sweep, system as msys
+
+    devices_or_die(1)
+    sp = sweep.measure_all(quick=args.quick)
+    path = msys.save(sp)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""MPI-shaped top-level API.
+
+The reference interposes 18 MPI entry points (SURVEY.md §1 L1); this module is
+the standalone equivalent surface: init/finalize lifecycle, datatype
+commit/free, pack/unpack, send/recv/isend/irecv/wait, alltoallv, neighbor
+collectives, and dist_graph_create_adjacent, all honoring the TEMPI_* env
+gates. Mirrors the MPI_Init call stack (SURVEY.md §3.1): read env, init
+counters, discover topology, pre-commit named types, load the perf cache.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from .ops import dtypes, type_cache
+from .ops.dtypes import Datatype
+from .parallel import p2p
+from .parallel.communicator import Communicator, DistBuffer
+from .utils import counters, env as envmod, logging as log
+
+_world: Optional[Communicator] = None
+
+
+def init(devices=None) -> Communicator:
+    """MPI_Init analog (reference: src/init.cpp:22-46)."""
+    global _world
+    if _world is not None:
+        return _world
+    envmod.read_environment()
+    counters.init()
+    log.world_rank = 0  # single controller drives all ranks
+    if devices is None:
+        devices = jax.devices()
+    _world = Communicator(devices)
+    type_cache.init()
+    try:
+        from .measure import system as msys
+        msys.load_cached()
+    except Exception as e:  # perf cache is optional at init
+        log.spew(f"no system measurement cache loaded: {e}")
+    log.debug(f"tempi init: {_world.size} ranks, "
+              f"{_world.num_nodes} node(s)")
+    return _world
+
+
+def finalize() -> None:
+    """MPI_Finalize analog: leak checks then teardown
+    (reference: src/finalize.cpp:20-40)."""
+    global _world
+    if _world is None:
+        return
+    try:
+        p2p.finalize_check(_world)
+    finally:
+        counters.finalize()
+        type_cache.clear()
+        _world = None
+
+
+def comm_world() -> Communicator:
+    if _world is None:
+        raise RuntimeError("tempi_tpu.api.init() has not been called")
+    return _world
+
+
+def initialized() -> bool:
+    return _world is not None
+
+
+# -- datatypes ----------------------------------------------------------------
+
+def type_commit(datatype: Datatype):
+    return type_cache.commit(datatype)
+
+
+def type_free(datatype: Datatype) -> None:
+    type_cache.free(datatype)
+
+
+def pack_size(incount: int, datatype: Datatype) -> int:
+    return dtypes.pack_size(incount, datatype)
+
+
+def pack(src_u8, incount: int, datatype: Datatype):
+    """MPI_Pack analog on a single device buffer (uint8 array in, packed
+    uint8 array out)."""
+    rec = type_cache.get_or_commit(datatype)
+    return rec.best_packer().pack(src_u8, incount)
+
+
+def unpack(dst_u8, packed_u8, outcount: int, datatype: Datatype):
+    """MPI_Unpack analog: returns the updated destination buffer."""
+    rec = type_cache.get_or_commit(datatype)
+    return rec.best_packer().unpack(dst_u8, packed_u8, outcount)
+
+
+# -- p2p ----------------------------------------------------------------------
+
+send = p2p.send
+recv = p2p.recv
+isend = p2p.isend
+irecv = p2p.irecv
+wait = p2p.wait
+waitall = p2p.waitall
+Request = p2p.Request
+ANY_TAG = p2p.ANY_TAG
+
+
+# -- collectives & graph communicators ---------------------------------------
+
+def alltoallv(*args, **kwargs):
+    from .parallel.alltoallv import alltoallv as _a2av
+    return _a2av(*args, **kwargs)
+
+
+def neighbor_alltoallv(*args, **kwargs):
+    from .parallel.neighbor import neighbor_alltoallv as _nav
+    return _nav(*args, **kwargs)
+
+
+def neighbor_alltoallw(*args, **kwargs):
+    from .parallel.neighbor import neighbor_alltoallw as _naw
+    return _naw(*args, **kwargs)
+
+
+def dist_graph_create_adjacent(*args, **kwargs):
+    from .parallel.dist_graph import dist_graph_create_adjacent as _dg
+    return _dg(*args, **kwargs)
+
+
+def dist_graph_neighbors(*args, **kwargs):
+    from .parallel.dist_graph import dist_graph_neighbors as _dgn
+    return _dgn(*args, **kwargs)

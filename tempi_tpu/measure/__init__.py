@@ -1,0 +1,2 @@
+from . import benchmark, iid, system, sweep  # noqa: F401
+from .benchmark import Result, benchmark as run_benchmark  # noqa: F401

@@ -1,0 +1,71 @@
+"""IID-validated micro-benchmark harness.
+
+Re-design of the reference's Benchmark runner
+(/root/reference/src/internal/benchmark.cpp, include/benchmark.hpp): size each
+sample to at least ~200 us of work, collect trials of 7..500 samples bounded
+by ~1 s, accept the first trial whose sample distribution passes the IID
+permutation tests, and report the trimean. The reference's MpiBenchmark
+broadcasts loop control so all ranks stay in lockstep (benchmark.cpp:91-159);
+under a single controller every rank is already driven by one loop, so that
+machinery is unnecessary.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..utils.statistics import Statistics
+from . import iid
+
+
+@dataclass
+class Result:
+    trimean: float       # seconds per iteration
+    iters_per_sample: int
+    num_samples: int
+    iid_ok: bool
+    stats: Statistics
+
+
+def benchmark(fn: Callable[[], None],
+              min_sample_secs: float = 200e-6,
+              max_trial_secs: float = 1.0,
+              min_samples: int = 7,
+              max_samples: int = 500,
+              max_trials: int = 10,
+              setup: Optional[Callable[[], None]] = None) -> Result:
+    """Run ``fn`` repeatedly; return IID-validated timing statistics.
+    ``fn`` must block until its work is complete (e.g. block_until_ready)."""
+    if setup:
+        setup()
+    # warmup + estimate iterations per sample (benchmark.cpp:25-32)
+    t0 = time.perf_counter()
+    fn()
+    once = max(time.perf_counter() - t0, 1e-9)
+    # one more timed run now that compilation caches are hot
+    t0 = time.perf_counter()
+    fn()
+    once = max(min(once, time.perf_counter() - t0), 1e-9)
+    iters = max(1, int(min_sample_secs / once))
+
+    sample_secs = max(min_sample_secs, once * iters)
+    nsamples = int(max(min_samples, min(max_samples,
+                                        max_trial_secs / sample_secs)))
+
+    last_stats = None
+    ok = False
+    for _ in range(max_trials):
+        stats = Statistics()
+        for _ in range(nsamples):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            stats.insert((time.perf_counter() - t0) / iters)
+        last_stats = stats
+        if iid.is_iid(stats.raw()):
+            ok = True
+            break
+    return Result(trimean=last_stats.trimean(), iters_per_sample=iters,
+                  num_samples=len(last_stats), iid_ok=ok, stats=last_stats)

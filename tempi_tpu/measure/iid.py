@@ -1,0 +1,88 @@
+"""IID acceptance testing for benchmark samples.
+
+Re-design of the reference's SP 800-90B-style permutation testing
+(/root/reference/src/internal/iid.cpp:171-245): statistics computed on the
+original sample order must not rank in either extreme tail across thousands
+of shuffles. The hot loop runs in native C++ (native/iid.cpp); the numpy
+fallback uses fewer permutations to stay fast.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Sequence
+
+import numpy as np
+
+from ..native import build as native_build
+
+TAIL = 5  # extreme-rank threshold, as in the reference (iid.cpp:180-245)
+
+
+def _stats(x: np.ndarray) -> np.ndarray:
+    """excursion; directional runs count/longest; increases; median runs
+    count/longest."""
+    n = len(x)
+    mean = x.mean()
+    exc = np.abs(np.cumsum(x - mean)).max()
+    d = np.sign(np.diff(x))
+    d[d == 0] = -1
+    changes = np.count_nonzero(d[1:] != d[:-1])
+    nruns = changes + 1
+    # longest directional run
+    longest = 1
+    cur = 1
+    for i in range(1, len(d)):
+        cur = cur + 1 if d[i] == d[i - 1] else 1
+        longest = max(longest, cur)
+    ninc = int((np.diff(x) > 0).sum())
+    med = np.median(x)
+    m = np.where(x >= med, 1, -1)
+    mchanges = np.count_nonzero(m[1:] != m[:-1])
+    mruns = mchanges + 1
+    mlong = 1
+    cur = 1
+    for i in range(1, n):
+        cur = cur + 1 if m[i] == m[i - 1] else 1
+        mlong = max(mlong, cur)
+    return np.array([exc, nruns, longest, ninc, mruns, mlong], dtype=float)
+
+
+def _iid_py(samples: np.ndarray, nperm: int, seed: int) -> bool:
+    orig = _stats(samples)
+    rng = np.random.default_rng(seed)
+    gt = np.zeros(len(orig), dtype=int)
+    eq = np.zeros(len(orig), dtype=int)
+    y = samples.copy()
+    for _ in range(nperm):
+        rng.shuffle(y)
+        s = _stats(y)
+        gt += s > orig
+        eq += s == orig
+    if ((gt + eq) <= TAIL).any():
+        return False
+    if (gt >= nperm - TAIL).any():
+        return False
+    return True
+
+
+def is_iid(samples: Sequence[float], nperm: int = 10000,
+           seed: int = 12345) -> bool:
+    """True when the sequence passes the permutation tests. Sequences shorter
+    than 8 samples are too small to judge and are rejected."""
+    x = np.asarray(list(samples), dtype=np.float64)
+    if len(x) < 8:
+        return False
+    if np.all(x == x[0]):
+        return True  # constant sequence: trivially order-independent
+    lib = native_build.load()
+    if lib is not None:
+        fn = lib.tempi_iid_test
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+                       ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
+        r = fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(x),
+               seed, nperm, TAIL)
+        if r >= 0:
+            return bool(r)
+    return _iid_py(x, min(nperm, 1000), seed)

@@ -1,0 +1,160 @@
+"""System measurement sweep.
+
+Re-design of the reference's measurement suite
+(/root/reference/src/internal/measure_system.cu:377-606 and
+bin/measure_system.cpp): measure each curve family the model needs, SKIPPING
+sections that already have data (the reference's incremental `empty()` guards)
+so repeated runs complete the cache instead of redoing it. Persists to
+TEMPI_CACHE_DIR/perf.json.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import logging as log
+from . import system as msys
+from .benchmark import benchmark
+from .system import (GRID_BLOCKLEN, GRID_BYTES, GRID_STRIDE,
+                     SystemPerformance)
+
+
+def _bench_kwargs(quick: bool) -> dict:
+    if quick:
+        return dict(min_sample_secs=20e-6, max_trial_secs=0.05,
+                    min_samples=7, max_samples=20, max_trials=1)
+    return {}
+
+
+def _transfer_sizes(quick: bool) -> List[int]:
+    # reference sweeps 2^0..2^23 (measure_system.cu:90-167)
+    step = 4 if quick else 1
+    return [1 << i for i in range(0, 24, step)]
+
+
+def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
+                device=None) -> SystemPerformance:
+    import jax
+    import jax.numpy as jnp
+
+    if sp is None:
+        sp = msys.load_cached() or SystemPerformance()
+    if device is None:
+        device = jax.devices()[0]
+    kw = _bench_kwargs(quick)
+
+    if sp.device_launch == 0.0:
+        x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
+        f = jax.jit(lambda v: v + 1.0)
+        f(x).block_until_ready()
+        t0 = time.perf_counter()
+        n = 100
+        for _ in range(n):
+            f(x)  # dispatch only: launch overhead analog
+        jax.block_until_ready(f(x))
+        sp.device_launch = (time.perf_counter() - t0) / n
+        log.debug(f"device_launch = {sp.device_launch:.2e}s")
+
+    if not sp.d2h:
+        for nb in _transfer_sizes(quick):
+            buf = jax.device_put(np.zeros(nb, np.uint8), device)
+            buf.block_until_ready()
+            r = benchmark(lambda: np.asarray(buf), **kw)
+            sp.d2h.append((nb, r.trimean))
+        log.debug(f"d2h: {len(sp.d2h)} points")
+
+    if not sp.h2d:
+        for nb in _transfer_sizes(quick):
+            host = np.zeros(nb, np.uint8)
+            r = benchmark(
+                lambda: jax.device_put(host, device).block_until_ready(),
+                **kw)
+            sp.h2d.append((nb, r.trimean))
+        log.debug(f"h2d: {len(sp.h2d)} points")
+
+    if not sp.host_pingpong:
+        for nb in _transfer_sizes(quick):
+            a = np.zeros(nb, np.uint8)
+            b = np.empty_like(a)
+            # host->host round trip (reference intra-node CPU pingpong)
+            r = benchmark(lambda: (np.copyto(b, a), np.copyto(a, b)), **kw)
+            sp.host_pingpong.append((nb, r.trimean))
+
+    if not sp.intra_node_pingpong:
+        devs = jax.devices()
+        if len(devs) >= 2:
+            sp.intra_node_pingpong = _pingpong_curve(devs, quick, kw)
+        else:
+            log.debug("single device: skipping intra-node pingpong curve")
+
+    grids = [("pack_device", False, False), ("unpack_device", True, False),
+             ("pack_host", False, True), ("unpack_host", True, True)]
+    for name, is_unpack, to_host in grids:
+        if getattr(sp, name):
+            continue
+        setattr(sp, name,
+                _pack_grid(device, is_unpack, to_host, quick, kw))
+        log.debug(f"{name}: grid measured")
+
+    msys.set_system(sp)
+    return sp
+
+
+def _pingpong_curve(devs, quick, kw):
+    """Device-device round trip over the mesh (ICI on TPU): one ppermute
+    there, one back (reference GPU-GPU pingpong, measure_system.cu:429-508)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devs[:2]), ("p",))
+    sh = NamedSharding(mesh, P("p", None))
+    curve = []
+
+    def roundtrip(x):
+        y = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])
+        return jax.lax.ppermute(y, "p", [(0, 1), (1, 0)])
+
+    fn = jax.jit(jax.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
+                               out_specs=P("p", None), check_vma=False))
+    for nb in _transfer_sizes(quick):
+        x = jax.device_put(np.zeros((2, nb), np.uint8), sh)
+        fn(x).block_until_ready()
+        r = benchmark(lambda: fn(x).block_until_ready(), **kw)
+        curve.append((nb, r.trimean / 2))  # one-way time
+    return curve
+
+
+def _pack_grid(device, is_unpack, to_host, quick, kw):
+    """9x9 grid of (bytes=2^(2i+6), blockLength=2^j), stride 512
+    (measure_system.cu:254-373)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.packer import PackerND
+    from ..ops.strided_block import StridedBlock
+
+    ni = 3 if quick else len(GRID_BYTES)
+    nj = 3 if quick else len(GRID_BLOCKLEN)
+    grid = [[0.0] * nj for _ in range(ni)]
+    for i in range(ni):
+        for j in range(nj):
+            nbytes, bl = GRID_BYTES[i], GRID_BLOCKLEN[j]
+            count = max(1, nbytes // bl)
+            sb = StridedBlock(start=0, extent=count * GRID_STRIDE,
+                              counts=[bl, count], strides=[1, GRID_STRIDE])
+            packer = PackerND(sb)
+            buf = jax.device_put(np.zeros(sb.extent, np.uint8), device)
+            packed = jax.device_put(np.zeros(bl * count, np.uint8), device)
+            if is_unpack:
+                fn = lambda: packer.unpack(buf, packed, 1).block_until_ready()
+            elif to_host:
+                fn = lambda: np.asarray(packer.pack(buf, 1))
+            else:
+                fn = lambda: packer.pack(buf, 1).block_until_ready()
+            r = benchmark(fn, **kw)
+            grid[i][j] = r.trimean
+    return grid

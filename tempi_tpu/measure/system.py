@@ -1,0 +1,195 @@
+"""Measured system performance model and its cache.
+
+Re-design of the reference's system measurement subsystem
+(/root/reference/src/internal/measure_system.cpp/.cu,
+include/measure_system.hpp): a one-time sweep measures transfer and pack
+curves, persists them as ``perf.json`` under TEMPI_CACHE_DIR, and senders
+interpolate those curves to choose DEVICE vs ONESHOT/STAGED per message.
+
+Curve families, renamed for TPU hardware (reference names in parens):
+  * device_launch        — dispatch overhead (cudaKernelLaunch)
+  * d2h / h2d            — device<->host transfer time vs bytes
+  * intra_node_pingpong  — device-device over ICI (intraNodeGpuGpuPingpong)
+  * inter_node_pingpong  — device-device over DCN (interNodeGpuGpuPingpong)
+  * host_pingpong        — host-host copy (intraNodeCpuCpuPingpong)
+  * pack_device/unpack_device — 2-D pack on device HBM over a
+    (bytes=2^(2i+6), blockLength=2^j, stride=512) grid (packDevice)
+  * pack_host/unpack_host     — pack landing in host memory (packHost)
+
+Interpolation mirrors the reference: 1-D piecewise-linear in log2(bytes) with
+linear extrapolation beyond the ends (measure_system.cpp:184-205); 2-D
+bilinear on the log2 grid with clamping (:217-293). Model composition
+(:100-132): oneshot = pack_host + host transport + unpack_host; device =
+pack_device + device transport + unpack_device.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import env as envmod
+from ..utils import logging as log
+
+PERF_JSON = "perf.json"
+
+# 2-D grid axes (reference: measure_system.cu:254-373 sweeps 9x9)
+GRID_BYTES = [1 << (2 * i + 6) for i in range(9)]      # 64 B .. 4 MiB
+GRID_BLOCKLEN = [1 << j for j in range(9)]             # 1 .. 256 B
+GRID_STRIDE = 512
+
+
+@dataclass
+class SystemPerformance:
+    device_launch: float = 0.0
+    d2h: List[Tuple[int, float]] = field(default_factory=list)
+    h2d: List[Tuple[int, float]] = field(default_factory=list)
+    intra_node_pingpong: List[Tuple[int, float]] = field(default_factory=list)
+    inter_node_pingpong: List[Tuple[int, float]] = field(default_factory=list)
+    host_pingpong: List[Tuple[int, float]] = field(default_factory=list)
+    pack_device: List[List[float]] = field(default_factory=list)
+    unpack_device: List[List[float]] = field(default_factory=list)
+    pack_host: List[List[float]] = field(default_factory=list)
+    unpack_host: List[List[float]] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "device_launch": self.device_launch,
+            **{k: [[int(b), t] for b, t in getattr(self, k)]
+               for k in ("d2h", "h2d", "intra_node_pingpong",
+                         "inter_node_pingpong", "host_pingpong")},
+            **{k: getattr(self, k)
+               for k in ("pack_device", "unpack_device", "pack_host",
+                         "unpack_host")},
+            "grid_bytes": GRID_BYTES,
+            "grid_blocklen": GRID_BLOCKLEN,
+            "grid_stride": GRID_STRIDE,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "SystemPerformance":
+        sp = SystemPerformance()
+        sp.device_launch = float(d.get("device_launch", 0.0))
+        for k in ("d2h", "h2d", "intra_node_pingpong", "inter_node_pingpong",
+                  "host_pingpong"):
+            sp.__setattr__(k, [(int(b), float(t)) for b, t in d.get(k, [])])
+        for k in ("pack_device", "unpack_device", "pack_host", "unpack_host"):
+            sp.__setattr__(k, [list(map(float, row)) for row in d.get(k, [])])
+        return sp
+
+
+_system: Optional[SystemPerformance] = None
+
+
+def get() -> SystemPerformance:
+    global _system
+    if _system is None:
+        _system = SystemPerformance()
+    return _system
+
+
+def set_system(sp: SystemPerformance) -> None:
+    global _system
+    _system = sp
+
+
+def cache_path() -> str:
+    return os.path.join(envmod.env.cache_dir, PERF_JSON)
+
+
+def save(sp: SystemPerformance) -> str:
+    """Export to TEMPI_CACHE_DIR/perf.json (measure_system.cpp:134-153)."""
+    path = cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(sp.to_json(), f, indent=1)
+    return path
+
+
+def load_cached() -> Optional[SystemPerformance]:
+    """Import at init if present (measure_system.cpp:154-173, loaded from
+    MPI_Init via measure_system_init)."""
+    path = cache_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            sp = SystemPerformance.from_json(json.load(f))
+        set_system(sp)
+        log.debug(f"loaded system performance cache from {path}")
+        return sp
+    except Exception as e:
+        log.warn(f"failed to load {path}: {e}")
+        return None
+
+
+# -- interpolation ------------------------------------------------------------
+
+
+def interp_time(curve: List[Tuple[int, float]], nbytes: int) -> float:
+    """Piecewise-linear in log2(bytes), extrapolating past both ends
+    (measure_system.cpp:184-205). Empty curve -> +inf so models relying on a
+    missing measurement never win."""
+    if not curve:
+        return math.inf
+    if len(curve) == 1:
+        return curve[0][1]
+    xs = [math.log2(max(b, 1)) for b, _ in curve]
+    ys = [t for _, t in curve]
+    x = math.log2(max(nbytes, 1))
+    if x <= xs[0]:
+        i = 0
+    elif x >= xs[-1]:
+        i = len(xs) - 2
+    else:
+        i = max(j for j in range(len(xs) - 1) if xs[j] <= x)
+    x0, x1, y0, y1 = xs[i], xs[i + 1], ys[i], ys[i + 1]
+    if x1 == x0:
+        return y0
+    return y0 + (y1 - y0) * (x - x0) / (x1 - x0)
+
+
+def interp_2d(grid: List[List[float]], nbytes: int, block_length: int) -> float:
+    """Bilinear on the (log2 bytes, log2 blockLength) grid with clamping
+    (measure_system.cpp:217-293)."""
+    if not grid or not grid[0]:
+        return math.inf
+    bx = [math.log2(b) for b in GRID_BYTES[: len(grid)]]
+    by = [math.log2(b) for b in GRID_BLOCKLEN[: len(grid[0])]]
+    x = min(max(math.log2(max(nbytes, 1)), bx[0]), bx[-1])
+    y = min(max(math.log2(max(block_length, 1)), by[0]), by[-1])
+    i = min(int((x - bx[0]) / 2), len(bx) - 2) if len(bx) > 1 else 0
+    j = min(int(y - by[0]), len(by) - 2) if len(by) > 1 else 0
+    fx = 0.0 if len(bx) == 1 else (x - bx[i]) / (bx[i + 1] - bx[i])
+    fy = 0.0 if len(by) == 1 else (y - by[j]) / (by[j + 1] - by[j])
+    i1 = min(i + 1, len(bx) - 1)
+    j1 = min(j + 1, len(by) - 1)
+    g = grid
+    return ((1 - fx) * (1 - fy) * g[i][j] + fx * (1 - fy) * g[i1][j]
+            + (1 - fx) * fy * g[i][j1] + fx * fy * g[i1][j1])
+
+
+# -- model composition (measure_system.cpp:100-132) ---------------------------
+
+
+def model_oneshot(nbytes: int, block_length: int, colocated: bool) -> float:
+    sp = get()
+    ph = interp_2d(sp.pack_host, nbytes, block_length)
+    send = interp_time(sp.host_pingpong, nbytes)
+    uh = interp_2d(sp.unpack_host, nbytes, block_length)
+    return ph + send + uh
+
+
+def model_device(nbytes: int, block_length: int, colocated: bool) -> float:
+    sp = get()
+    pd = interp_2d(sp.pack_device, nbytes, block_length)
+    send = interp_time(sp.intra_node_pingpong if colocated
+                       else sp.inter_node_pingpong, nbytes)
+    ud = interp_2d(sp.unpack_device, nbytes, block_length)
+    return pd + send + ud

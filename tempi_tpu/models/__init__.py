@@ -1,0 +1,1 @@
+from . import halo3d  # noqa: F401

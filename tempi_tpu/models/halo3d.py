@@ -1,0 +1,264 @@
+"""3-D halo exchange: the framework's flagship workload.
+
+Re-design of the reference's flagship benchmark workload
+(/root/reference/bin/bench_halo_exchange.cpp): an X^3 grid of float32 cells
+decomposed over ranks by recursive bisection (:211-236), with radius-1 ghost
+rings exchanged every iteration through per-direction subarray datatypes
+(:87-169) and a distributed-graph communicator created with reorder so
+heavily-communicating ranks share a node (:320-352). Here the exchange
+compiles to fused ppermute rounds over ICI and the stencil update is a jitted
+shard_map over the same mesh — communication and compute in one XLA world.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import dtypes as dt
+from ..parallel import p2p
+from ..parallel.communicator import AXIS, Communicator, DistBuffer
+from ..parallel.dist_graph import dist_graph_create_adjacent
+from ..utils import logging as log
+
+Box = Tuple[Tuple[int, int, int], Tuple[int, int, int]]  # (lo, hi) exclusive
+
+
+def decompose(size: int, shape: Tuple[int, int, int]) -> List[Box]:
+    """Recursive bisection: split the rank count (unevenly if odd) and the
+    box's longest axis proportionally (reference :211-236)."""
+    boxes: List[Tuple[Box, int]] = [(((0, 0, 0), shape), size)]
+    done: List[Box] = []
+    while boxes:
+        (lo, hi), n = boxes.pop()
+        if n == 1:
+            done.append((lo, hi))
+            continue
+        n0 = n // 2
+        n1 = n - n0
+        ext = [hi[d] - lo[d] for d in range(3)]
+        d = int(np.argmax(ext))
+        cut = lo[d] + max(1, min(ext[d] - 1, round(ext[d] * n0 / n)))
+        lo0, hi0 = list(lo), list(hi)
+        lo1, hi1 = list(lo), list(hi)
+        hi0[d] = cut
+        lo1[d] = cut
+        boxes.append(((tuple(lo0), tuple(hi0)), n0))
+        boxes.append(((tuple(lo1), tuple(hi1)), n1))
+    done.sort()
+    return done
+
+
+def dims_create(size: int) -> Tuple[int, int, int]:
+    """Balanced 3-factor factorization (MPI_Dims_create analog), used by the
+    regular decomposition when exact bisection can't stay uniform."""
+    dims = [1, 1, 1]
+    n = size
+    f = 2
+    factors = []
+    while f * f <= n:
+        while n % f == 0:
+            factors.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        dims[int(np.argmin(dims))] *= f
+    return tuple(sorted(dims, reverse=True))
+
+
+def decompose_regular(dims: Tuple[int, int, int],
+                      shape: Tuple[int, int, int]) -> List[Box]:
+    """Regular block decomposition: axis d split into dims[d] equal parts."""
+    for d in range(3):
+        assert shape[d] % dims[d] == 0, \
+            f"axis {d}: {shape[d]} not divisible by {dims[d]}"
+    boxes = []
+    lx, ly, lz = (shape[0] // dims[0], shape[1] // dims[1],
+                  shape[2] // dims[2])
+    for i in range(dims[0]):
+        for j in range(dims[1]):
+            for k in range(dims[2]):
+                boxes.append(((i * lx, j * ly, k * lz),
+                              ((i + 1) * lx, (j + 1) * ly, (k + 1) * lz)))
+    boxes.sort()
+    return boxes
+
+
+def _overlap(a: Box, b: Box, r: int) -> Optional[Box]:
+    """Cells of box ``a`` within distance r of box ``b`` (the region a must
+    send to b)."""
+    lo, hi = [], []
+    for d in range(3):
+        l = max(a[0][d], b[0][d] - r)
+        h = min(a[1][d], b[1][d] + r)
+        if l >= h:
+            return None
+        lo.append(l)
+        hi.append(h)
+    return (tuple(lo), tuple(hi))
+
+
+@dataclass
+class _Edge:
+    src: int
+    dst: int
+    send_type: dt.Datatype
+    recv_type: dt.Datatype
+    cells: int
+
+
+class HaloExchange:
+    """Builds the datatype set and the (optionally reordered) graph
+    communicator for a radius-r halo exchange; exchange() runs one full
+    26-neighbor update through the p2p engine."""
+
+    ELEM = dt.FLOAT  # float32 cells
+
+    def __init__(self, comm: Communicator, X, radius: int = 1,
+                 reorder: bool = False,
+                 dims: Optional[Tuple[int, int, int]] = None):
+        self.radius = r = radius
+        shape = (X, X, X) if isinstance(X, int) else tuple(X)
+        self.X = shape[0]
+        if dims is not None:
+            self.boxes = decompose_regular(dims, shape)
+        else:
+            self.boxes = decompose(comm.size, shape)
+        exts = {tuple(b[1][d] - b[0][d] for d in range(3))
+                for b in self.boxes}
+        if len(exts) != 1:
+            raise ValueError(
+                f"non-uniform decomposition {exts}: rank count must evenly "
+                "bisect the grid (use a power-of-two rank count)")
+        self.local = next(iter(exts))          # (lx, ly, lz)
+        # allocated array shape (z, y, x) with ghost ring, C order
+        self.alloc = tuple(self.local[2 - d] + 2 * r for d in range(3))
+        self.nbytes = int(np.prod(self.alloc)) * self.ELEM.size
+
+        # edges: for each adjacent ordered pair, subarray types over the
+        # allocated shape selecting the send (interior) / recv (ghost) region
+        self.edges: List[_Edge] = []
+        sources: List[List[int]] = [[] for _ in range(comm.size)]
+        dests: List[List[int]] = [[] for _ in range(comm.size)]
+        sweights: List[List[int]] = [[] for _ in range(comm.size)]
+        dweights: List[List[int]] = [[] for _ in range(comm.size)]
+        for a in range(comm.size):
+            for b in range(comm.size):
+                if a == b:
+                    continue
+                region = _overlap(self.boxes[a], self.boxes[b], r)
+                if region is None:
+                    continue
+                cells = int(np.prod([region[1][d] - region[0][d]
+                                     for d in range(3)]))
+                st = self._subarray(region, self.boxes[a])
+                rt = self._subarray(region, self.boxes[b])
+                self.edges.append(_Edge(a, b, st, rt, cells))
+                dests[a].append(b)
+                dweights[a].append(cells)
+                sources[b].append(a)
+                sweights[b].append(cells)
+
+        self.comm = dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sweights, dweights=dweights,
+            reorder=reorder)
+
+    def _subarray(self, region: Box, box: Box) -> dt.Datatype:
+        """Subarray datatype selecting ``region`` (global coords) inside the
+        allocated local array of ``box`` (its owner's frame, ghost offset
+        applied). C order: sizes are (z, y, x)."""
+        r = self.radius
+        sizes = list(self.alloc)
+        subsizes = [region[1][2 - d] - region[0][2 - d] for d in range(3)]
+        starts = [region[0][2 - d] - box[0][2 - d] + r for d in range(3)]
+        return dt.subarray(sizes, subsizes, starts, self.ELEM)
+
+    def alloc_grid(self, fill=None) -> DistBuffer:
+        buf = self.comm.alloc(self.nbytes)
+        if fill is not None:
+            rows = []
+            for rank in range(self.comm.size):
+                a = np.zeros(self.alloc, dtype=np.float32)
+                a[...] = fill(rank, self.alloc)
+                rows.append(a.astype(np.float32).tobytes())
+            buf = self.comm.buffer_from_host(
+                [np.frombuffer(x, dtype=np.uint8) for x in rows])
+        return buf
+
+    def exchange(self, buf: DistBuffer, strategy: Optional[str] = None) -> None:
+        """One full halo exchange: every edge as isend/irecv, then waitall
+        (the reference's default packed Isend/Irecv path, :986)."""
+        reqs = []
+        for e in self.edges:
+            reqs.append(p2p.isend(self.comm, e.src, buf, e.dst, e.send_type,
+                                  tag=0))
+            reqs.append(p2p.irecv(self.comm, e.dst, buf, e.src, e.recv_type,
+                                  tag=0))
+        p2p.waitall(reqs, strategy)
+
+    # -- stencil compute (the "model" forward) -------------------------------
+
+    def stencil_fn(self):
+        """Jitted 7-point Jacobi update over the mesh (interior only)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        az, ay, ax = self.alloc
+        r = self.radius
+        nbytes = self.nbytes
+
+        def step_u8(local):
+            u8 = local.reshape(-1)
+            x = jax.lax.bitcast_convert_type(
+                u8.reshape(-1, 4), jnp.float32).reshape(az, ay, ax)
+            c = x[r:-r, r:-r, r:-r]
+            nb = (x[2 * r:, r:-r, r:-r] + x[: az - 2 * r, r:-r, r:-r]
+                  + x[r:-r, 2 * r:, r:-r] + x[r:-r, : ay - 2 * r, r:-r]
+                  + x[r:-r, r:-r, 2 * r:] + x[r:-r, r:-r, : ax - 2 * r])
+            x = x.at[r:-r, r:-r, r:-r].set((c + nb) / 7.0)
+            out = jax.lax.bitcast_convert_type(x, jnp.uint8)
+            return out.reshape(1, nbytes)
+
+        sm = jax.shard_map(step_u8, mesh=self.comm.mesh,
+                           in_specs=P(AXIS, None), out_specs=P(AXIS, None),
+                           check_vma=False)
+        return jax.jit(sm)
+
+    def run_iteration(self, buf: DistBuffer, stencil=None,
+                      strategy: Optional[str] = None) -> None:
+        """One training-step analog: halo exchange then stencil update."""
+        self.exchange(buf, strategy)
+        if stencil is None:
+            stencil = self.stencil_fn()
+        buf.data = stencil(buf.data)
+
+
+def single_chip_step(alloc=(66, 66, 66)):
+    """A jittable single-device forward step (stencil + boundary pack) for
+    compile checking: returns (fn, example_args)."""
+    import jax
+    import jax.numpy as jnp
+
+    az, ay, ax = alloc
+
+    def fn(x):
+        c = x[1:-1, 1:-1, 1:-1]
+        nb = (x[2:, 1:-1, 1:-1] + x[:-2, 1:-1, 1:-1]
+              + x[1:-1, 2:, 1:-1] + x[1:-1, :-2, 1:-1]
+              + x[1:-1, 1:-1, 2:] + x[1:-1, 1:-1, :-2])
+        x = x.at[1:-1, 1:-1, 1:-1].set((c + nb) / 7.0)
+        # boundary faces packed dense (what the halo exchange would send)
+        faces = jnp.concatenate([
+            x[1, 1:-1, 1:-1].reshape(-1), x[-2, 1:-1, 1:-1].reshape(-1),
+            x[1:-1, 1, 1:-1].reshape(-1), x[1:-1, -2, 1:-1].reshape(-1),
+            x[1:-1, 1:-1, 1].reshape(-1), x[1:-1, 1:-1, -2].reshape(-1),
+        ])
+        return x, faces
+
+    example = jnp.zeros(alloc, jnp.float32)
+    return fn, (example,)

@@ -1,0 +1,56 @@
+"""Lazy builder/loader for the native runtime library.
+
+The reference links KaHIP/METIS C libraries at build time
+(/root/reference/CMakeLists.txt:94-137); here the native components compile
+on first use with the system toolchain into a cached shared object, and every
+consumer has a pure-Python fallback so a missing compiler never breaks the
+framework.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libtempi_native.so")
+_SOURCES = ["partition.cpp", "iid.cpp"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_HERE, s)) > so_m
+        for s in _SOURCES if os.path.exists(os.path.join(_HERE, s)))
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if stale) and dlopen the native library; None on any failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        srcs = [os.path.join(_HERE, s) for s in _SOURCES
+                if os.path.exists(os.path.join(_HERE, s))]
+        if not srcs:
+            return None
+        try:
+            if _needs_build():
+                cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                       "-o", _SO] + srcs
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            _lib = ctypes.CDLL(_SO)
+        except Exception:
+            _lib = None
+        return _lib

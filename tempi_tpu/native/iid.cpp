@@ -1,0 +1,113 @@
+// IID permutation testing for benchmark sample acceptance.
+//
+// Native-equivalent of the reference's NIST SP 800-90B-style permutation
+// test (/root/reference/src/internal/iid.cpp:171-245): compute test
+// statistics on the original sample sequence, re-compute them on many
+// shuffles, and reject the IID assumption when the original ranks in either
+// extreme tail. Statistics here: excursion, number/longest of directional
+// runs, number of increases, number/longest of runs about the median.
+//
+// C ABI only (loaded with ctypes).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace {
+
+constexpr int kNumStats = 6;
+
+void stats(const std::vector<double> &x, double *out) {
+  int n = (int)x.size();
+  double mean = 0;
+  for (double v : x) mean += v;
+  mean /= n;
+
+  // 1: excursion
+  double c = 0, exc = 0;
+  for (double v : x) {
+    c += v - mean;
+    exc = std::max(exc, std::fabs(c));
+  }
+  out[0] = exc;
+
+  // 2-4: directional runs over successive differences
+  int nruns = 1, longest = 1, cur = 1, ninc = 0;
+  int prev = 0;
+  for (int i = 1; i < n; ++i) {
+    int s = x[i] > x[i - 1] ? 1 : -1;
+    if (x[i] > x[i - 1]) ++ninc;
+    if (i > 1 && s == prev) {
+      ++cur;
+    } else {
+      cur = 1;
+      if (i > 1) ++nruns;
+    }
+    longest = std::max(longest, cur);
+    prev = s;
+  }
+  out[1] = nruns;
+  out[2] = longest;
+  out[3] = ninc;
+
+  // 5-6: runs about the median
+  std::vector<double> sorted(x);
+  std::nth_element(sorted.begin(), sorted.begin() + n / 2, sorted.end());
+  double med = sorted[n / 2];
+  int mruns = 1, mlong = 1, mcur = 1, mprev = 0;
+  bool first = true;
+  for (int i = 0; i < n; ++i) {
+    int s = x[i] >= med ? 1 : -1;
+    if (!first && s == mprev) {
+      ++mcur;
+    } else if (!first) {
+      ++mruns;
+      mcur = 1;
+    }
+    mlong = std::max(mlong, mcur);
+    mprev = s;
+    first = false;
+  }
+  out[4] = mruns;
+  out[5] = mlong;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 1 when the sample sequence is plausibly IID, 0 when rejected,
+// -1 on error. tail: extreme-rank threshold (reference uses 5).
+int32_t tempi_iid_test(const double *samples, int32_t n, uint64_t seed,
+                       int32_t nperm, int32_t tail) {
+  if (n < 8 || nperm < 10) return -1;
+  std::vector<double> x(samples, samples + n);
+  double orig[kNumStats];
+  stats(x, orig);
+
+  int32_t gt[kNumStats] = {0}, eq[kNumStats] = {0};
+  std::mt19937_64 rng(seed);
+  std::vector<double> y(x);
+  double s[kNumStats];
+  for (int p = 0; p < nperm; ++p) {
+    for (int i = n - 1; i > 0; --i) {
+      int j = (int)(rng() % (uint64_t)(i + 1));
+      std::swap(y[i], y[j]);
+    }
+    stats(y, s);
+    for (int k = 0; k < kNumStats; ++k) {
+      if (s[k] > orig[k]) ++gt[k];
+      else if (s[k] == orig[k]) ++eq[k];
+    }
+  }
+  for (int k = 0; k < kNumStats; ++k) {
+    // original must not rank in either extreme tail
+    if (gt[k] + eq[k] <= tail) return 0;
+    if (gt[k] >= nperm - tail) return 0;
+  }
+  return 1;
+}
+
+}  // extern "C"

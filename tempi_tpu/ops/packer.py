@@ -30,6 +30,15 @@ from .dtypes import Datatype
 from .strided_block import StridedBlock
 
 
+def _is_tracing(x) -> bool:
+    """True while JAX is tracing (e.g. inside a plan's lax.switch branch):
+    counters must reflect executed packs, not compilations."""
+    try:
+        return isinstance(x, jax.core.Tracer)
+    except AttributeError:
+        return False
+
+
 class Packer:
     """pack(src, incount) -> uint8[incount*packed_size];
     unpack(dst, packed, outcount) -> new dst."""
@@ -56,15 +65,21 @@ class Packer1D(Packer):
         self.extent = extent if extent and extent > blocklength else blocklength
         self.packed_size = blocklength
 
+    @property
+    def cache_key(self):
+        return ("1d", self.start, self.blocklength, self.extent)
+
     def pack(self, src_u8, incount):
-        ctr.counters.pack1d.num_packs += 1
-        ctr.counters.pack1d.bytes_packed += incount * self.blocklength
+        if not _is_tracing(src_u8):
+            ctr.counters.pack1d.num_packs += 1
+            ctr.counters.pack1d.bytes_packed += incount * self.blocklength
         return pack_xla.pack(src_u8, self.start, (self.blocklength,), (1,),
                              self.extent, incount)
 
     def unpack(self, dst_u8, packed_u8, outcount):
-        ctr.counters.pack1d.num_unpacks += 1
-        ctr.counters.pack1d.bytes_unpacked += outcount * self.blocklength
+        if not _is_tracing(dst_u8):
+            ctr.counters.pack1d.num_unpacks += 1
+            ctr.counters.pack1d.bytes_unpacked += outcount * self.blocklength
         return pack_xla.unpack(dst_u8, packed_u8, self.start,
                                (self.blocklength,), (1,), self.extent, outcount)
 
@@ -76,6 +91,11 @@ class PackerND(Packer):
         assert sb.ndims in (2, 3)
         self.sb = sb
         self.packed_size = sb.packed_size
+
+    @property
+    def cache_key(self):
+        return ("nd", self.sb.start, tuple(self.sb.counts),
+                tuple(self.sb.strides), self.sb.extent)
 
     @property
     def _group(self):
@@ -100,15 +120,17 @@ class PackerND(Packer):
         return pack_xla
 
     def pack(self, src_u8, incount):
-        self._group.num_packs += 1
-        self._group.bytes_packed += incount * self.packed_size
+        if not _is_tracing(src_u8):
+            self._group.num_packs += 1
+            self._group.bytes_packed += incount * self.packed_size
         b = self._backend()
         return b.pack(src_u8, self.sb.start, tuple(self.sb.counts),
                       tuple(self.sb.strides), self.sb.extent, incount)
 
     def unpack(self, dst_u8, packed_u8, outcount):
-        self._group.num_unpacks += 1
-        self._group.bytes_unpacked += outcount * self.packed_size
+        if not _is_tracing(dst_u8):
+            self._group.num_unpacks += 1
+            self._group.bytes_unpacked += outcount * self.packed_size
         b = self._backend()
         return b.unpack(dst_u8, packed_u8, self.sb.start,
                         tuple(self.sb.counts), tuple(self.sb.strides),
@@ -129,6 +151,11 @@ class PackerFallback(Packer):
         ) if tm.size else np.zeros((0,), np.int64)
         self._idx = idx
         self._cache = {}  # (nbytes, incount) -> (pack_fn, unpack_fn)
+
+    @property
+    def cache_key(self):
+        # typemap content + extent identify the pack program exactly
+        return ("fb", self.datatype.extent, self.datatype.typemap().tobytes())
 
     def _fns(self, nbytes: int, incount: int):
         key = (nbytes, incount)

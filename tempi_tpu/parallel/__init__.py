@@ -1,0 +1,13 @@
+from . import (  # noqa: F401
+    alltoallv,
+    communicator,
+    dist_graph,
+    neighbor,
+    p2p,
+    partition,
+    plan,
+    tags,
+    topology,
+)
+from .communicator import Communicator, DistBuffer  # noqa: F401
+from .p2p import Request, irecv, isend, recv, send, wait, waitall  # noqa: F401

@@ -1,0 +1,216 @@
+"""GPU-aware-style MPI_Alltoallv rebuilt for the TPU mesh.
+
+Re-design of the reference's alltoallv engine
+(/root/reference/src/internal/alltoallv_impl.cpp, src/alltoallv.cpp). The
+reference offers four strategies around a CUDA-aware library call; here the
+"library path" is XLA itself, so the strategy set becomes:
+
+  * device_fused — pad each (src,dst) segment to the max count and run ONE
+    ``lax.all_to_all`` over ICI (the TPU-first default; what AUTO/NONE map
+    to — on a torus a single fused collective beats per-pair sends).
+  * staged — bulk D2H of the send buffer, permute on the host, H2D
+    (alltoallv_impl.cpp:68-93 semantics).
+  * isir_remote_first — per-pair messages through the p2p engine, off-node
+    destinations posted first so inter-node rounds start earliest
+    (alltoallv_impl.cpp:21-63).
+  * isir_staged — per-pair messages, each through the host path
+    (alltoallv_impl.cpp:97-149).
+  * isir_remote_staged — colocated pairs on-device, remote pairs host-staged
+    (alltoallv_impl.cpp:154-258).
+
+Counts/displacements are full matrices (every rank's perspective, in
+single-controller style); counts are in elements of a dense datatype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops import dtypes, type_cache
+from ..ops.dtypes import Datatype
+from ..utils import env as envmod
+from ..utils import logging as log
+from ..utils.env import AlltoallvMethod
+from .communicator import AXIS, Communicator, DistBuffer
+from .plan import Message, get_plan
+
+
+def _as_matrix(comm: Communicator, counts) -> np.ndarray:
+    m = np.asarray(counts, dtype=np.int64)
+    assert m.shape == (comm.size, comm.size), \
+        f"counts must be ({comm.size},{comm.size}) [src,dst] matrix"
+    return m
+
+
+def _elem_size(datatype: Datatype) -> int:
+    assert datatype.size == datatype.extent, \
+        "alltoallv requires a dense (contiguous) datatype"
+    return datatype.size
+
+
+def alltoallv(comm: Communicator, sendbuf: DistBuffer, sendcounts,
+              sdispls, recvbuf: DistBuffer, recvcounts, rdispls,
+              datatype: Datatype = dtypes.BYTE,
+              method: Optional[AlltoallvMethod] = None) -> None:
+    """Dispatcher (reference: src/alltoallv.cpp:29-67). counts/displs are
+    (size, size) matrices indexed [rank, peer], in elements/bytes of
+    ``datatype``; displacements are in elements like MPI."""
+    es = _elem_size(datatype)
+    sc = _as_matrix(comm, sendcounts) * es
+    rc = _as_matrix(comm, recvcounts) * es
+    sd = _as_matrix(comm, sdispls) * es
+    rd = _as_matrix(comm, rdispls) * es
+    if not np.array_equal(sc, rc.T):
+        raise ValueError("recvcounts must be the transpose of sendcounts")
+
+    method = method or envmod.env.alltoallv
+    if method in (AlltoallvMethod.AUTO, AlltoallvMethod.NONE):
+        # the TPU "library path": one fused XLA collective over ICI
+        _device_fused(comm, sendbuf, sc, sd, recvbuf, rd)
+    elif method is AlltoallvMethod.STAGED:
+        _staged(comm, sendbuf, sc, sd, recvbuf, rd)
+    elif method is AlltoallvMethod.REMOTE_FIRST:
+        _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="remote_first",
+              strategy="device")
+    elif method is AlltoallvMethod.ISIR_STAGED:
+        _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="posted",
+              strategy="staged")
+    elif method is AlltoallvMethod.ISIR_REMOTE_STAGED:
+        _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd)
+    else:
+        raise ValueError(f"unhandled alltoallv method {method}")
+
+
+# -- device_fused -------------------------------------------------------------
+
+
+def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
+    size = comm.size
+    M = int(sc.max()) if sc.size else 0
+    if M == 0:
+        return
+    # library-rank-space tables (application displacements translated)
+    lsc = np.zeros_like(sc)
+    lsd = np.zeros_like(sd)
+    lrd = np.zeros_like(rd)
+    for ar in range(size):
+        lr = comm.library_rank(ar)
+        for pr in range(size):
+            lp = comm.library_rank(pr)
+            lsc[lr, lp] = sc[ar, pr]
+            lsd[lr, lp] = sd[ar, pr]
+            lrd[lr, lp] = rd[ar, pr]
+
+    def step(s, r):
+        sloc = s.reshape(-1)
+        rloc = r.reshape(-1)
+        me = jax.lax.axis_index(AXIS)
+
+        def gather_branch(rank):
+            def f(x):
+                rows = [
+                    jax.lax.pad(
+                        x[lsd[rank, j]: lsd[rank, j] + lsc[rank, j]],
+                        jnp.zeros((), jnp.uint8),
+                        [(0, M - int(lsc[rank, j]), 0)])
+                    for j in range(size)
+                ]
+                return jnp.stack(rows)
+            return f
+
+        out = jax.lax.switch(me, [gather_branch(k) for k in range(size)],
+                             sloc)
+        # one fused collective: row j of ``out`` goes to rank j; received
+        # row i comes from rank i
+        got = jax.lax.all_to_all(out, AXIS, split_axis=0, concat_axis=0,
+                                 tiled=True)
+
+        def scatter_branch(rank):
+            def f(g, x):
+                for i in range(size):
+                    n = int(lsc[i, rank])
+                    if n:
+                        x = jax.lax.dynamic_update_slice(
+                            x, g[i, :n], (lrd[rank, i],))
+                return x
+            return f
+
+        rloc = jax.lax.switch(me, [scatter_branch(k) for k in range(size)],
+                              got, rloc)
+        return rloc.reshape(1, -1)
+
+    fn = comm._plan_cache.get(("a2av", M, sendbuf.nbytes, recvbuf.nbytes,
+                               lsc.tobytes(), lsd.tobytes(), lrd.tobytes()))
+    if fn is None:
+        sm = jax.shard_map(step, mesh=comm.mesh,
+                           in_specs=(P(AXIS, None), P(AXIS, None)),
+                           out_specs=P(AXIS, None), check_vma=False)
+        fn = jax.jit(sm)
+        comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes,
+                          lsc.tobytes(), lsd.tobytes(), lrd.tobytes())] = fn
+    recvbuf.data = fn(sendbuf.data, recvbuf.data)
+
+
+# -- staged (bulk host) -------------------------------------------------------
+
+
+def _staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
+    """Bulk D2H -> host alltoallv -> H2D (alltoallv_impl.cpp:68-93)."""
+    size = comm.size
+    host_s = np.asarray(sendbuf.data)          # D2H
+    host_r = np.array(recvbuf.data, copy=True)  # writable host copy
+    for ar in range(size):
+        src = comm.library_rank(ar)
+        for pr in range(size):
+            dst = comm.library_rank(pr)
+            n = sc[ar, pr]
+            if n:
+                host_r[dst, rd[pr, ar]: rd[pr, ar] + n] = \
+                    host_s[src, sd[ar, pr]: sd[ar, pr] + n]
+    recvbuf.data = jax.device_put(host_r, comm.sharding())  # H2D
+
+
+# -- isend/irecv lowerings ----------------------------------------------------
+
+
+def _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order: str):
+    size = comm.size
+    pairs = [(a, p) for a in range(size) for p in range(size) if sc[a, p] > 0]
+    if order == "remote_first":
+        pairs.sort(key=lambda ap: comm.is_colocated(
+            comm.library_rank(ap[0]), comm.library_rank(ap[1])))
+    msgs = []
+    for a, p in pairs:
+        n = int(sc[a, p])
+        ty = dtypes.contiguous(n, dtypes.BYTE)
+        packer = type_cache.get_or_commit(ty).best_packer()
+        msgs.append(Message(
+            src=comm.library_rank(a), dst=comm.library_rank(p), tag=0,
+            nbytes=n, sbuf=sendbuf, spacker=packer, scount=1,
+            soffset=int(sd[a, p]), rbuf=recvbuf, rpacker=packer, rcount=1,
+            roffset=int(rd[p, a])))
+    return msgs
+
+
+def _isir(comm, sendbuf, sc, sd, recvbuf, rd, order: str,
+          strategy: str) -> None:
+    msgs = _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order)
+    if msgs:
+        get_plan(comm, msgs).run(strategy)
+
+
+def _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
+    """Colocated pairs direct on device, remote pairs through the host
+    (alltoallv_impl.cpp:154-258)."""
+    msgs = _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, "posted")
+    local = [m for m in msgs if comm.is_colocated(m.src, m.dst)]
+    remote = [m for m in msgs if not comm.is_colocated(m.src, m.dst)]
+    if remote:
+        get_plan(comm, remote).run("staged")
+    if local:
+        get_plan(comm, local).run("device")

@@ -1,0 +1,121 @@
+"""Communicators and distributed byte buffers.
+
+The reference interposes MPI communicators and translates application ranks to
+library ranks on every call (SURVEY.md §3.5). Here a Communicator owns a 1-D
+``jax.sharding.Mesh`` over its devices ("library rank" == mesh position), the
+node topology, and an optional Placement from dist-graph reordering. Rank
+translation (reference: topology.cpp:155-171 library_rank/application_rank)
+lives on the communicator, not in global state, so placements are
+per-communicator exactly like the reference caches them per MPI_Comm.
+
+A DistBuffer is the SPMD analog of "each rank has a local byte buffer": one
+global (size, nbytes) uint8 array sharded along ranks. Benchmarks and tests
+address per-rank contents by application rank; the communicator maps them to
+mesh rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import logging as log
+from . import topology as topo_mod
+
+AXIS = "ranks"
+
+
+class Communicator:
+    def __init__(self, devices: Sequence, placement=None, graph=None,
+                 parent=None):
+        self.devices = list(devices)
+        self.size = len(self.devices)
+        self.mesh = Mesh(np.array(self.devices), (AXIS,))
+        self.topology = topo_mod.discover(self.devices)
+        self.placement: Optional[topo_mod.Placement] = placement
+        # dist-graph adjacency per application rank: (sources, destinations)
+        self.graph = graph
+        self.parent = parent
+        self._plan_cache = {}
+        self._pending = []  # deferred isend/irecv ops (async engine)
+        self.freed = False
+
+    # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------
+
+    def library_rank(self, app_rank: int) -> int:
+        if self.placement is None:
+            return app_rank
+        return self.placement.lib_rank[app_rank]
+
+    def application_rank(self, lib_rank: int) -> int:
+        if self.placement is None:
+            return lib_rank
+        return self.placement.app_rank[lib_rank]
+
+    def is_colocated(self, lib_a: int, lib_b: int) -> bool:
+        return self.topology.is_colocated(lib_a, lib_b)
+
+    def node_of_app_rank(self, app_rank: int) -> int:
+        return self.topology.node_of_rank[self.library_rank(app_rank)]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.topology.num_nodes
+
+    @property
+    def ranks_per_node(self) -> int:
+        return max(len(r) for r in self.topology.ranks_of_node)
+
+    # -- buffers --------------------------------------------------------------
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(AXIS, None))
+
+    def alloc(self, nbytes: int) -> "DistBuffer":
+        data = jax.device_put(
+            np.zeros((self.size, nbytes), dtype=np.uint8), self.sharding())
+        return DistBuffer(self, nbytes, data)
+
+    def buffer_from_host(self, rows: Sequence[np.ndarray]) -> "DistBuffer":
+        """Per-application-rank rows -> sharded buffer (rows live on the
+        library rank that runs that application rank)."""
+        assert len(rows) == self.size
+        nbytes = len(rows[0])
+        lib_rows = [None] * self.size
+        for ar, row in enumerate(rows):
+            assert len(row) == nbytes
+            lib_rows[self.library_rank(ar)] = np.asarray(row, dtype=np.uint8)
+        data = jax.device_put(np.stack(lib_rows), self.sharding())
+        return DistBuffer(self, nbytes, data)
+
+    def free(self) -> None:
+        """MPI_Comm_free analog (reference: src/comm_free.cpp) — drops cached
+        plans/topology state."""
+        self._plan_cache.clear()
+        self.freed = True
+
+
+class DistBuffer:
+    """One uint8 buffer per rank, stored as a (size, nbytes) sharded array."""
+
+    def __init__(self, comm: Communicator, nbytes: int, data: jax.Array):
+        self.comm = comm
+        self.nbytes = nbytes
+        self.data = data
+
+    def set_rank(self, app_rank: int, content: np.ndarray) -> None:
+        lib = self.comm.library_rank(app_rank)
+        host = np.array(self.data, copy=True)
+        host[lib, : len(content)] = content
+        self.data = jax.device_put(host, self.comm.sharding())
+
+    def get_rank(self, app_rank: int) -> np.ndarray:
+        lib = self.comm.library_rank(app_rank)
+        return np.asarray(self.data[lib])
+
+    def block_until_ready(self) -> "DistBuffer":
+        self.data.block_until_ready()
+        return self

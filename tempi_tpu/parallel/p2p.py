@@ -1,0 +1,233 @@
+"""Point-to-point layer: blocking and nonblocking send/recv with strategy
+selection.
+
+Re-design of the reference's send/recv interposers and async engine
+(/root/reference/src/internal/send.cpp, isend.cpp, async_operation.cpp) for a
+single-controller SPMD world: every rank's operations are described in one
+program; isend/irecv append deferred ops to the communicator; progress happens
+inside framework calls (wait/waitall/flush or a buffer read), mirroring the
+reference's "progress only inside TEMPI calls" guarantee
+(async_operation.cpp:501-513). Matched ops compile into an ExchangePlan and
+execute as collective rounds.
+
+Strategy selection mirrors SendRecvND (sender.cpp:251-328): the TEMPI_DATATYPE
+knob forces DEVICE/ONESHOT, and AUTO consults the measured system model
+(measure/system.py) keyed on {colocated, bytes} with a per-plan decision
+cache.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..ops import type_cache
+from ..ops.dtypes import Datatype
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from ..utils.env import DatatypeMethod
+from .communicator import Communicator, DistBuffer
+from .plan import Message, get_plan
+
+ANY_TAG = -1
+
+_req_ids = itertools.count(1)
+
+
+@dataclass
+class Request:
+    """Fake-request analog (reference: include/request.hpp Request::make):
+    a framework-owned handle, never a live library object."""
+
+    id: int
+    comm: Communicator
+    done: bool = False
+
+    def wait(self) -> None:
+        wait(self)
+
+
+@dataclass
+class Op:
+    kind: str  # "send" | "recv"
+    rank: int  # library rank posting the op
+    peer: int  # library rank of the other side
+    tag: int
+    buf: DistBuffer
+    offset: int
+    packer: object
+    count: int
+    nbytes: int
+    request: Request
+
+
+def _packer_for(datatype: Datatype):
+    rec = type_cache.get_or_commit(datatype)
+    return rec.best_packer()
+
+
+def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
+          peer_app: int, datatype: Datatype, count: int, tag: int,
+          offset: int) -> Request:
+    if comm.freed:
+        raise RuntimeError("communicator has been freed")
+    packer = _packer_for(datatype)
+    req = Request(next(_req_ids), comm)
+    op = Op(kind=kind, rank=comm.library_rank(app_rank),
+            peer=comm.library_rank(peer_app), tag=tag, buf=buf, offset=offset,
+            packer=packer, count=count, nbytes=count * datatype.size,
+            request=req)
+    comm._pending.append(op)
+    group = ctr.counters.isend if kind == "send" else ctr.counters.irecv
+    group.num_device += 1
+    return req
+
+
+def isend(comm: Communicator, app_rank: int, buf: DistBuffer, dest: int,
+          datatype: Datatype, count: int = 1, tag: int = 0,
+          offset: int = 0) -> Request:
+    """Nonblocking send from ``app_rank`` to ``dest`` (application ranks)."""
+    return _post(comm, "send", app_rank, buf, dest, datatype, count, tag,
+                 offset)
+
+
+def irecv(comm: Communicator, app_rank: int, buf: DistBuffer, source: int,
+          datatype: Datatype, count: int = 1, tag: int = 0,
+          offset: int = 0) -> Request:
+    """Nonblocking receive on ``app_rank`` from ``source``."""
+    return _post(comm, "recv", app_rank, buf, source, datatype, count, tag,
+                 offset)
+
+
+def send(comm: Communicator, app_rank: int, buf: DistBuffer, dest: int,
+         datatype: Datatype, count: int = 1, tag: int = 0,
+         offset: int = 0) -> None:
+    """Blocking send: deferred until the matching recv completes the pair
+    (single-controller semantics — the data is on its way once both sides
+    are posted; a buffer read or flush is the synchronization point)."""
+    isend(comm, app_rank, buf, dest, datatype, count, tag, offset)
+
+
+def recv(comm: Communicator, app_rank: int, buf: DistBuffer, source: int,
+         datatype: Datatype, count: int = 1, tag: int = 0,
+         offset: int = 0) -> None:
+    """Blocking recv: posts the op then drives progress."""
+    irecv(comm, app_rank, buf, source, datatype, count, tag, offset)
+    try_progress(comm)
+
+
+def _match(pending: List[Op]):
+    """FIFO matching by (src, dst, tag) (MPI ordering semantics). Returns
+    (messages, consumed ops, leftover ops)."""
+    sends = [op for op in pending if op.kind == "send"]
+    recvs = [op for op in pending if op.kind == "recv"]
+    used_r = [False] * len(recvs)
+    messages, consumed = [], []
+    for s in sends:
+        for i, r in enumerate(recvs):
+            if used_r[i]:
+                continue
+            if r.rank != s.peer or r.peer != s.rank:
+                continue
+            if r.tag != ANY_TAG and r.tag != s.tag:
+                continue
+            if r.nbytes != s.nbytes:
+                raise ValueError(
+                    f"matched send/recv sizes differ: send {s.nbytes}B from "
+                    f"{s.rank} to {s.peer}, recv {r.nbytes}B (tag {s.tag})")
+            used_r[i] = True
+            messages.append(Message(
+                src=s.rank, dst=r.rank, tag=s.tag, nbytes=s.nbytes,
+                sbuf=s.buf, spacker=s.packer, scount=s.count,
+                soffset=s.offset, rbuf=r.buf, rpacker=r.packer,
+                rcount=r.count, roffset=r.offset))
+            consumed.append(s)
+            consumed.append(r)
+            break
+    leftover = [op for op in pending if all(op is not c for c in consumed)]
+    return messages, consumed, leftover
+
+
+def choose_strategy(comm: Communicator, messages) -> str:
+    """DEVICE/ONESHOT forced by env; AUTO asks the measured model per the
+    largest message, with the decision cached per {colocated, bytes,
+    blockLength} like SendRecvND's model-choice cache (sender.cpp:259-277,
+    sender.hpp:104-122)."""
+    method = envmod.env.datatype
+    if method is DatatypeMethod.DEVICE:
+        return "device"
+    if method is DatatypeMethod.ONESHOT:
+        return "oneshot"
+    # AUTO
+    try:
+        from ..measure import system as msys
+        m = max(messages, key=lambda m: m.nbytes)
+        colocated = comm.is_colocated(m.src, m.dst)
+        block = min(max(_block_length(m), 1), 512)
+        cache = comm.__dict__.setdefault("_strategy_cache", {})
+        key = (colocated, m.nbytes, block)
+        hit = cache.get(key)
+        if hit is not None:
+            ctr.counters.modeling.cache_hit += 1
+            return hit
+        ctr.counters.modeling.cache_miss += 1
+        t_dev = msys.model_device(m.nbytes, block, colocated)
+        t_one = msys.model_oneshot(m.nbytes, block, colocated)
+        choice = "oneshot" if t_one < t_dev else "device"
+        cache[key] = choice
+        return choice
+    except Exception:
+        pass
+    return "device"
+
+
+def _block_length(m: Message) -> int:
+    sb = getattr(m.spacker, "sb", None)
+    if sb is not None and sb.ndims >= 2:
+        return sb.counts[0]
+    return m.nbytes
+
+
+def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
+    """Execute every currently-matched message set; leave unmatched ops
+    pending (reference: async::try_progress pumping on each call)."""
+    if not comm._pending:
+        return 0
+    messages, consumed, leftover = _match(comm._pending)
+    if not messages:
+        return 0
+    comm._pending = leftover
+    plan = get_plan(comm, messages)
+    plan.run(strategy or choose_strategy(comm, messages))
+    for op in consumed:
+        op.request.done = True
+    return len(messages)
+
+
+def wait(req: Request, strategy: Optional[str] = None) -> None:
+    """MPI_Wait analog: drive progress until this request completes
+    (async_operation.cpp:448-463)."""
+    if req.done:
+        return
+    try_progress(req.comm, strategy)
+    if not req.done:
+        raise RuntimeError(
+            "wait() on a request whose peer operation was never posted "
+            "(deadlock in MPI terms)")
+
+
+def waitall(reqs, strategy: Optional[str] = None) -> None:
+    for r in reqs:
+        wait(r, strategy)
+
+
+def finalize_check(comm: Communicator) -> None:
+    """Leaked-operation detection at finalize (async_operation.cpp:515-521)."""
+    if comm._pending:
+        for op in comm._pending:
+            log.error(f"finalize: pending {op.kind} rank {op.rank} <-> "
+                      f"{op.peer} tag {op.tag} ({op.nbytes}B) never matched")
+        comm._pending.clear()
+        raise RuntimeError("finalize with incomplete p2p operations")

@@ -1,0 +1,8 @@
+"""Internal tag reservation (reference: /root/reference/src/internal/tags.cpp
+reserves MPI_TAG_UB-1 for neighbor_alltoallw traffic). Our tag space is a
+Python int; internal collectives use tags above this floor so they can never
+collide with application tags."""
+
+RESERVED_BASE = 1 << 30
+
+NEIGHBOR_ALLTOALLW = RESERVED_BASE + 1

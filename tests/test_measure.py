@@ -1,0 +1,116 @@
+"""Measurement subsystem tests (reference analogs: test/iid.cpp,
+test/measure_system.cpp interpolation checks)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tempi_tpu.measure import iid, system as msys
+from tempi_tpu.measure.benchmark import benchmark
+from tempi_tpu.measure.system import SystemPerformance, interp_2d, interp_time
+
+
+def test_iid_rejects_monotone():
+    """A monotone sequence is maximally order-dependent (test/iid.cpp:14-30)."""
+    xs = np.arange(100, dtype=float)
+    assert not iid.is_iid(xs, nperm=2000)
+
+
+def test_iid_accepts_uniform_noise():
+    rng = np.random.default_rng(7)
+    for attempt in range(5):
+        xs = rng.random(100)
+        if iid.is_iid(xs, nperm=2000):
+            return
+    pytest.fail("uniform noise never accepted as IID")
+
+
+def test_iid_small_sample_rejected():
+    assert not iid.is_iid([1.0, 2.0, 3.0])
+
+
+def test_iid_constant_accepted():
+    assert iid.is_iid([5.0] * 50)
+
+
+def test_interp_1d_exact_and_between():
+    """Hand-built table checks (reference test/measure_system.cpp:13-50)."""
+    curve = [(1, 1.0), (4, 3.0), (16, 5.0)]
+    assert interp_time(curve, 1) == 1.0
+    assert interp_time(curve, 4) == 3.0
+    assert interp_time(curve, 16) == 5.0
+    assert math.isclose(interp_time(curve, 2), 2.0)   # log2 midpoint of 1,4
+    assert math.isclose(interp_time(curve, 8), 4.0)
+    # extrapolation beyond both ends
+    assert math.isclose(interp_time(curve, 64), 7.0)
+    assert interp_time([], 128) == math.inf
+
+
+def test_interp_2d_clamped_bilinear():
+    # grid[i][j] over bytes=2^(2i+6), blocklen=2^j
+    grid = [[float(10 * i + j) for j in range(9)] for i in range(9)]
+    assert interp_2d(grid, 64, 1) == 0.0
+    assert interp_2d(grid, 256, 2) == 11.0
+    # midpoints interpolate
+    assert math.isclose(interp_2d(grid, 128, 1), 5.0)
+    v = interp_2d(grid, 64, 3)  # between j=1 (1.0) and j=2 (2.0)
+    assert math.isclose(v, 1.0 + math.log2(3) - 1)
+    # clamping outside the grid
+    assert interp_2d(grid, 1, 1) == 0.0
+    assert interp_2d(grid, 1 << 30, 512) == 88.0
+
+
+def test_model_composition():
+    sp = SystemPerformance()
+    sp.pack_device = [[1e-6]]
+    sp.unpack_device = [[1e-6]]
+    sp.pack_host = [[5e-6]]
+    sp.unpack_host = [[5e-6]]
+    sp.intra_node_pingpong = [(1, 1e-6), (1 << 23, 1e-3)]
+    sp.host_pingpong = [(1, 10e-6), (1 << 23, 10e-3)]
+    msys.set_system(sp)
+    assert msys.model_device(1024, 64, True) < msys.model_oneshot(1024, 64, True)
+    # missing inter-node curve -> device path over DCN is inf
+    assert msys.model_device(1024, 64, False) == math.inf
+
+
+def test_benchmark_harness_runs():
+    r = benchmark(lambda: sum(range(500)), min_sample_secs=20e-6,
+                  max_trial_secs=0.05, max_samples=20, max_trials=2)
+    assert r.trimean > 0
+    assert r.num_samples >= 7
+
+
+def test_perf_json_roundtrip(tmp_path, monkeypatch):
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = SystemPerformance()
+    sp.device_launch = 1e-5
+    sp.d2h = [(1, 1e-6), (1024, 2e-6)]
+    sp.pack_device = [[1e-6, 2e-6], [3e-6, 4e-6]]
+    path = msys.save(sp)
+    assert path.startswith(str(tmp_path))
+    loaded = msys.load_cached()
+    assert loaded is not None
+    assert loaded.d2h == sp.d2h
+    assert loaded.pack_device == sp.pack_device
+    assert loaded.device_launch == sp.device_launch
+
+
+def test_quick_sweep_fills_sections(tmp_path, monkeypatch):
+    """Incremental sweep on CPU: fills empty sections, keeps existing ones
+    (reference bin/measure_system.cpp import->complete->export)."""
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = SystemPerformance()
+    sp.d2h = [(1, 99.0)]  # pre-existing section must be preserved
+    out = sweep.measure_all(sp, quick=True)
+    assert out.d2h == [(1, 99.0)]
+    assert out.h2d and out.host_pingpong
+    assert out.device_launch > 0
+    assert len(out.pack_device) == 3 and len(out.pack_device[0]) == 3
+    assert out.intra_node_pingpong  # 8 CPU devices available
+    msys.save(out)
+    assert msys.load_cached() is not None

@@ -1,0 +1,169 @@
+"""P2P tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's communication tests (test/send.cpp 2-rank host+device,
+test/isend.cu self-messaging, test/sender.cpp contiguous sweep) against our
+SPMD exchange engine.
+"""
+
+import numpy as np
+import pytest
+
+import support_types as st
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def fill(comm, nbytes, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = [rng.integers(0, 256, nbytes, np.uint8) for _ in range(comm.size)]
+    return api.comm_world().buffer_from_host(rows), rows
+
+
+def test_world_size(world):
+    assert world.size == 8
+    assert world.num_nodes >= 1
+
+
+def test_send_recv_bytes(world):
+    """rank 0 -> rank 1, contiguous bytes (reference test/send.cpp)."""
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf, rows = fill(world, 64)
+    rbuf = world.alloc(64)
+    api.send(world, 0, sbuf, 1, ty)
+    api.recv(world, 1, rbuf, 0, ty)
+    np.testing.assert_array_equal(rbuf.get_rank(1), rows[0])
+
+
+def test_send_recv_strided(world):
+    """2-D strided datatype across ranks."""
+    ty = st.make_2d_byte_vector(4, 8, 32)
+    n = ty.extent
+    sbuf, rows = fill(world, n)
+    rbuf = world.alloc(n)
+    api.send(world, 2, sbuf, 5, ty)
+    api.recv(world, 5, rbuf, 2, ty)
+    got = rbuf.get_rank(5)
+    want = st.oracle_unpack(np.zeros(n, np.uint8),
+                            st.oracle_pack(rows[2], ty, 1), ty, 1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_self_message(world):
+    """Isend/Irecv to own rank (reference test/isend.cu:28-41)."""
+    ty = dt.contiguous(32, dt.BYTE)
+    sbuf, rows = fill(world, 32)
+    rbuf = world.alloc(32)
+    r1 = api.isend(world, 3, sbuf, 3, ty)
+    r2 = api.irecv(world, 3, rbuf, 3, ty)
+    api.waitall([r1, r2])
+    np.testing.assert_array_equal(rbuf.get_rank(3), rows[3])
+
+
+def test_ring_exchange(world):
+    """All ranks send right, receive from left, one ppermute round."""
+    ty = dt.contiguous(16, dt.BYTE)
+    sbuf, rows = fill(world, 16)
+    rbuf = world.alloc(16)
+    reqs = []
+    for r in range(world.size):
+        reqs.append(api.isend(world, r, sbuf, (r + 1) % world.size, ty))
+        reqs.append(api.irecv(world, r, rbuf, (r - 1) % world.size, ty))
+    api.waitall(reqs)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r),
+                                      rows[(r - 1) % world.size])
+
+
+def test_pingpong(world):
+    """Two-round pingpong: 0 -> 1 then 1 -> 0 (bench-mpi-pingpong pattern)."""
+    ty = st.make_2d_byte_subarray(8, 16, 64)
+    n = ty.extent
+    a, rows = fill(world, n, seed=1)
+    b = world.alloc(n)
+    api.send(world, 0, a, 1, ty)
+    api.recv(world, 1, b, 0, ty)
+    api.send(world, 1, b, 0, ty)
+    api.recv(world, 0, b, 1, ty)
+    packed = st.oracle_pack(rows[0], ty, 1)
+    want = st.oracle_unpack(np.zeros(n, np.uint8), packed, ty, 1)
+    np.testing.assert_array_equal(b.get_rank(0), want)
+
+
+def test_tag_matching_fifo(world):
+    """Two messages same pair, distinct tags, posted out of order on the
+    recv side: tags must pair them correctly."""
+    ty = dt.contiguous(8, dt.BYTE)
+    s1, _ = fill(world, 8, seed=2)
+    s2, _ = fill(world, 8, seed=3)
+    r1 = world.alloc(8)
+    r2 = world.alloc(8)
+    api.isend(world, 0, s1, 1, ty, tag=11)
+    api.isend(world, 0, s2, 1, ty, tag=22)
+    q1 = api.irecv(world, 1, r2, 0, ty, tag=22)
+    q2 = api.irecv(world, 1, r1, 0, ty, tag=11)
+    api.waitall([q1, q2])
+    np.testing.assert_array_equal(r1.get_rank(1), s1.get_rank(0))
+    np.testing.assert_array_equal(r2.get_rank(1), s2.get_rank(0))
+
+
+def test_mismatched_sizes_raise(world):
+    ty8 = dt.contiguous(8, dt.BYTE)
+    ty16 = dt.contiguous(16, dt.BYTE)
+    s, _ = fill(world, 16)
+    r = world.alloc(16)
+    api.isend(world, 0, s, 1, ty8)
+    api.irecv(world, 1, r, 0, ty16)
+    with pytest.raises(ValueError, match="sizes differ"):
+        api.comm_world() and __import__(
+            "tempi_tpu.parallel.p2p", fromlist=["p2p"]).try_progress(world)
+    world._pending.clear()
+
+
+def test_wait_unmatched_raises(world):
+    ty = dt.contiguous(8, dt.BYTE)
+    s, _ = fill(world, 8)
+    req = api.isend(world, 0, s, 1, ty)
+    with pytest.raises(RuntimeError, match="never posted|deadlock"):
+        api.wait(req)
+    world._pending.clear()
+
+
+def test_finalize_leak_detection(world):
+    ty = dt.contiguous(8, dt.BYTE)
+    s, _ = fill(world, 8)
+    api.isend(world, 0, s, 1, ty)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        api.finalize()
+
+
+def test_staged_strategy(world):
+    """STAGED (host path) produces identical results to DEVICE."""
+    from tempi_tpu.parallel import p2p as p2p_mod
+    ty = st.make_2d_byte_vector(4, 8, 32)
+    n = ty.extent
+    sbuf, rows = fill(world, n)
+    rbuf = world.alloc(n)
+    api.isend(world, 1, sbuf, 4, ty)
+    api.irecv(world, 4, rbuf, 1, ty)
+    p2p_mod.try_progress(world, strategy="staged")
+    want = st.oracle_unpack(np.zeros(n, np.uint8),
+                            st.oracle_pack(rows[1], ty, 1), ty, 1)
+    np.testing.assert_array_equal(rbuf.get_rank(4), want)
+
+
+def test_contiguous_sweep(world):
+    """Contiguous sizes 1B..64KiB (reference test/sender.cpp:27-58)."""
+    for nbytes in [1, 7, 64, 1024, 65536]:
+        ty = dt.contiguous(nbytes, dt.BYTE)
+        s, rows = fill(world, nbytes, seed=nbytes)
+        r = world.alloc(nbytes)
+        api.send(world, 6, s, 7, ty)
+        api.recv(world, 7, r, 6, ty)
+        np.testing.assert_array_equal(r.get_rank(7), rows[6])

@@ -1,0 +1,134 @@
+"""Partitioner and placement tests (reference analogs:
+test/partition_kahip.cpp balance sanity, test/dist_graph_create_adjacent.cpp
+4-rank reorder lifecycle)."""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import partition as pm
+from tempi_tpu.parallel.topology import discover, make_placement
+
+
+def two_cliques_csr():
+    """8 vertices: cliques {0..3} and {4..7} with heavy internal edges and
+    one light bridge."""
+    edges = {}
+    for grp in (range(0, 4), range(4, 8)):
+        for u in grp:
+            for v in grp:
+                if u < v:
+                    edges[(u, v)] = 10
+    edges[(3, 4)] = 1
+    adj = [[] for _ in range(8)]
+    for (u, v), w in edges.items():
+        adj[u].append((v, w))
+        adj[v].append((u, w))
+    xadj = [0]
+    adjncy, adjwgt = [], []
+    for r in range(8):
+        for v, w in sorted(adj[r]):
+            adjncy.append(v)
+            adjwgt.append(w)
+        xadj.append(len(adjncy))
+    return pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                  np.array(adjwgt, np.int64))
+
+
+def test_random_partition_balanced():
+    res = pm.random_partition(4, 8, seed=1)
+    assert pm.is_balanced(res, 4)
+    assert sorted(np.bincount(res.part, minlength=4)) == [2, 2, 2, 2]
+
+
+def test_partition_separates_cliques():
+    csr = two_cliques_csr()
+    res = pm.partition(2, csr, seed=0, nseeds=10)
+    assert pm.is_balanced(res, 2)
+    # optimal cut severs only the bridge (weight 1)
+    assert res.objective == 1
+    assert len({res.part[i] for i in range(4)}) == 1
+    assert len({res.part[i] for i in range(4, 8)}) == 1
+
+
+def test_partition_python_fallback_matches():
+    csr = two_cliques_csr()
+    res = pm._partition_py(2, csr, seed=0, nseeds=10)
+    assert pm.is_balanced(res, 2)
+    assert res.objective == 1
+
+
+def test_make_placement_greedy_slots(monkeypatch):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        topo = comm.topology
+        assert topo.num_nodes == 4
+        # app ranks 0..7 want nodes [0,0,1,1,2,2,3,3] -> identity
+        p = make_placement(topo, [0, 0, 1, 1, 2, 2, 3, 3])
+        assert p.lib_rank == list(range(8))
+        # pair (0,7) on node 0: 7 gets node 0's second slot (lib rank 1)
+        p = make_placement(topo, [0, 1, 1, 2, 2, 3, 3, 0])
+        assert p.lib_rank[0] == 0 and p.lib_rank[7] == 1
+        assert p.app_rank[1] == 7
+    finally:
+        api.finalize()
+
+
+def test_dist_graph_reorder_colocates_heavy_pairs(monkeypatch):
+    """Ranks communicating heavily should land on the same node: app pairs
+    (0,4), (1,5), (2,6), (3,7) exchange heavy traffic; with 4 nodes x 2
+    ranks, a reordering placement must colocate each pair."""
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    monkeypatch.setenv("TEMPI_PLACEMENT_KAHIP", "1")
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        size = comm.size
+        pair = lambda r: (r + 4) % 8
+        sources = [[pair(r)] for r in range(size)]
+        dests = [[pair(r)] for r in range(size)]
+        sw = [[100] for _ in range(size)]
+        dw = [[100] for _ in range(size)]
+        g = api.dist_graph_create_adjacent(comm, sources, dests,
+                                           sweights=sw, dweights=dw,
+                                           reorder=True)
+        assert g.placement is not None
+        for r in range(4):
+            assert g.node_of_app_rank(r) == g.node_of_app_rank(pair(r)), \
+                f"pair ({r},{pair(r)}) split across nodes"
+        # traffic still routes correctly through the permuted placement
+        ty = dt.contiguous(8, dt.BYTE)
+        rows = [np.full(8, r, np.uint8) for r in range(size)]
+        sbuf = g.buffer_from_host(rows)
+        rbuf = g.alloc(8)
+        reqs = []
+        for r in range(size):
+            reqs.append(api.isend(g, r, sbuf, pair(r), ty))
+            reqs.append(api.irecv(g, r, rbuf, pair(r), ty))
+        api.waitall(reqs)
+        for r in range(size):
+            np.testing.assert_array_equal(rbuf.get_rank(r),
+                                          np.full(8, pair(r), np.uint8))
+    finally:
+        api.finalize()
+
+
+def test_dist_graph_random_placement(monkeypatch):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    monkeypatch.setenv("TEMPI_PLACEMENT_RANDOM", "1")
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        sources = [[(r + 1) % 8] for r in range(8)]
+        dests = [[(r - 1) % 8] for r in range(8)]
+        g = api.dist_graph_create_adjacent(comm, sources, dests, reorder=True)
+        assert g.placement is not None
+        assert sorted(g.placement.lib_rank) == list(range(8))
+    finally:
+        api.finalize()
