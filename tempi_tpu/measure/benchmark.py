@@ -35,19 +35,36 @@ def benchmark(fn: Callable[[], None],
               min_samples: int = 7,
               max_samples: int = 500,
               max_trials: int = 10,
-              setup: Optional[Callable[[], None]] = None) -> Result:
+              setup: Optional[Callable[[], None]] = None,
+              flush: Optional[Callable[[], None]] = None) -> Result:
     """Run ``fn`` repeatedly; return IID-validated timing statistics.
-    ``fn`` must block until its work is complete (e.g. block_until_ready)."""
+
+    Without ``flush``, ``fn`` must block until its work is complete (e.g.
+    block_until_ready). With ``flush``, ``fn`` may merely enqueue async
+    device work and ``flush()`` drains it once per sample — the throughput
+    pattern for dispatch-latency-dominated transports (a tunneled TPU pays a
+    full round trip per blocking call, swamping a ~30 us kernel)."""
     if setup:
         setup()
+
+    def sample_once(iters: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        if flush:
+            flush()
+        return time.perf_counter() - t0
+
     # warmup + estimate iterations per sample (benchmark.cpp:25-32)
-    t0 = time.perf_counter()
-    fn()
-    once = max(time.perf_counter() - t0, 1e-9)
+    once = max(sample_once(1), 1e-9)
     # one more timed run now that compilation caches are hot
-    t0 = time.perf_counter()
-    fn()
-    once = max(min(once, time.perf_counter() - t0), 1e-9)
+    once = max(min(once, sample_once(1)), 1e-9)
+    if flush:
+        # a blocking flush costs a full dispatch round trip, which would
+        # drive the estimate to iters=1 and defeat the enqueue batching;
+        # estimate the amortized per-iteration cost from a batched sample
+        batched = max(sample_once(8) / 8, 1e-9)
+        once = min(once, batched)
     iters = max(1, int(min_sample_secs / once))
 
     sample_secs = max(min_sample_secs, once * iters)
@@ -59,10 +76,7 @@ def benchmark(fn: Callable[[], None],
     for _ in range(max_trials):
         stats = Statistics()
         for _ in range(nsamples):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                fn()
-            stats.insert((time.perf_counter() - t0) / iters)
+            stats.insert(sample_once(iters) / iters)
         last_stats = stats
         if iid.is_iid(stats.raw()):
             ok = True

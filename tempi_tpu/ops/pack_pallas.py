@@ -1,0 +1,308 @@
+"""Pallas TPU pack kernels: strided gather at HBM bandwidth.
+
+TPU-native equivalent of the reference's CUDA pack kernels
+(/root/reference/include/pack_kernels.cuh pack_2d/pack_3d,
+packer_{2d,3d}.cu). The design is not a kernel translation: where the CUDA
+kernels hand-roll word-width-specialized grid-stride loops, here the strided
+gather is expressed through the Pallas pipeline — the source buffer is
+reinterpreted (for free) as a (rows, rowstride) matrix, and each grid step
+DMAs one (TILE, blocklength) sub-block HBM->VMEM->HBM. The hardware DMA
+engine performs the strided reads natively, touching ONLY the packed bytes
+(gap bytes are never read), which is what makes this faster than both the
+reference-style elementwise kernel and a dense copy.
+
+Measured on a v5e chip (8192x512B blocks at 1024B stride, the
+bench-mpi-pack headline shape): ~230 GB/s packed-bytes throughput vs
+~39 GB/s for the generic XLA slice/pad/reshape chain and ~112 GB/s for a
+dense same-size copy.
+
+Fast-path requirements (else ``supports()`` is False and PackerND uses the
+XLA backend):
+  * start and every outer stride/extent are multiples of strides[1]
+    (rows of the view land on block boundaries);
+  * the buffer length is a multiple of strides[1] (the 2-D view is a free
+    bitcast reshape — slicing/padding first would cost a full copy);
+  * the strided level fits the grid (TILE divisibility, see ``_plan``).
+
+Unpack is deliberately NOT a Pallas kernel: writing (TILE, rowstride)
+output blocks stitched from two differently-offset inputs drives Mosaic
+into a ~100x slowdown (measured 2.7 ms vs 24 us for the same op in XLA),
+so the fast unpack is a strided-view XLA update — read the packed matrix,
+concatenate with the gap columns, one fused copy. Gap bytes are preserved
+exactly (MPI_Unpack semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import logging as log
+from ..utils.numeric import gcd
+from .strided_block import StridedBlock
+
+# Target rows per grid step: TILE*blocklength bytes of VMEM per buffer
+# (double-buffered by the pipeline). 512 rows x 512 B = 256 KiB.
+_TILE_TARGET = 512
+# Below these, dispatch overhead dominates and XLA does fine.
+_MIN_BLOCKLEN = 32
+_MIN_PACKED = 16 * 1024
+# A (tile, blocklength) block must fit VMEM with double buffering.
+_MAX_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+@functools.lru_cache(maxsize=8192)
+def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
+          strides: Tuple[int, ...], extent: int,
+          incount: int) -> Optional[dict]:
+    """Geometry of the strided-view kernel, or None if unsupported.
+
+    Levels outer->inner: (incount, extent), then (counts[d], strides[d]) for
+    d = ndims-1 .. 2, then the row level (counts[1], strides[1]) whose blocks
+    are CONSECUTIVE rows of the (nrows, rowstride) view, then the dense
+    blocklength counts[0].
+    """
+    ndims = len(counts)
+    if ndims not in (2, 3):
+        return None
+    bl = counts[0]
+    rowstride = strides[1]
+    if bl > rowstride:
+        return None  # overlapping (shouldn't happen for valid types)
+    # Mosaic: a block's last dim must be 128-divisible (u8 lanes) unless it
+    # equals the whole array dim; the in-block is (tile, bl) over
+    # (nrows, rowstride)
+    if bl % 128 and bl != rowstride:
+        return None
+    outer = [(incount, extent)]
+    if ndims == 3:
+        outer.append((counts[2], strides[2]))
+    # row-alignment of every outer offset
+    if start % rowstride:
+        return None
+    for _, s in outer:
+        if s % rowstride:
+            return None
+    if nbytes % rowstride:
+        return None  # view reshape would not be free
+    nrows = nbytes // rowstride
+    start_row = start // rowstride
+    outer_rows = [(n, s // rowstride) for n, s in outer]
+    nblocks = counts[1]
+    # collapse tight outer levels into the row level (objects/planes that
+    # tile contiguously are just more consecutive rows) — the row-granular
+    # analog of the canonicalizer's stream_flatten pass
+    while outer_rows and outer_rows[-1][1] == nblocks:
+        n, _ = outer_rows.pop()
+        nblocks *= n
+    if not outer_rows:
+        outer_rows = [(1, nblocks)]
+    counts = (counts[0], nblocks)
+    # last row touched must exist
+    last = start_row + sum((n - 1) * s for n, s in outer_rows) + nblocks - 1
+    if last >= nrows:
+        return None
+    # TILE must divide every outer row-offset so index_map stays in block
+    # units; counts[1] itself may be ragged (edge blocks are clipped).
+    # Levels with a single index never contribute an offset. Scale the
+    # target down for fat rows so a (tile, bl) block stays within budget.
+    tile = _TILE_TARGET
+    while tile > 8 and tile * bl > _MAX_BLOCK_BYTES:
+        tile //= 2
+    if tile * bl > _MAX_BLOCK_BYTES:
+        return None
+    for n, s in outer_rows:
+        if n > 1:
+            tile = gcd(tile, s)
+    tile = gcd(tile, start_row) if start_row else tile
+    if tile < 8 or tile % 8:  # Mosaic sublane divisibility
+        return None
+    return dict(bl=bl, rowstride=rowstride, nrows=nrows, start_row=start_row,
+                outer_rows=outer_rows, nblocks=counts[1], tile=tile)
+
+
+def supports(sb: StridedBlock, nbytes: Optional[int] = None,
+             incount: int = 1) -> bool:
+    """Cheap static check used by PackerND backend selection. When ``nbytes``
+    is unknown the buffer-length condition is assumed to hold for a
+    tight buffer (incount * extent bytes)."""
+    if sb.ndims not in (2, 3):
+        return False
+    if sb.counts[0] < _MIN_BLOCKLEN:
+        return False
+    if sb.packed_size * incount < _MIN_PACKED:
+        return False
+    nb = nbytes if nbytes is not None else sb.start + incount * sb.extent
+    return _plan(nb, sb.start, tuple(sb.counts), tuple(sb.strides),
+                 sb.extent, incount) is not None
+
+
+def _interpret() -> bool:
+    # CPU (tests, virtual meshes) runs the kernel in interpreter mode
+    return jax.default_backend() == "cpu"
+
+
+@functools.lru_cache(maxsize=2048)
+def _build_pack(nbytes: int, start: int, counts: Tuple[int, ...],
+                strides: Tuple[int, ...], extent: int, incount: int):
+    from jax.experimental import pallas as pl
+
+    interpret = _interpret()
+    if interpret:  # CPU: pltpu is unimportable without a TPU platform
+        mem = {}
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+        mem = {"memory_space": pltpu.VMEM}
+
+    p = _plan(nbytes, start, counts, strides, extent, incount)
+    assert p is not None
+    bl, rowstride = p["bl"], p["rowstride"]
+    tile, nblocks = p["tile"], p["nblocks"]
+    outer_rows = p["outer_rows"]  # [(incount, e_rows)] (+ [(c2, s2_rows)])
+    start_blk = p["start_row"] // tile
+    nb_tiles = pl.cdiv(nblocks, tile)
+
+    def kern(in_ref, out_ref):
+        # out blocks carry leading singleton dims for the outer grid levels
+        out_ref[...] = in_ref[...].reshape(out_ref.shape)
+
+    if len(outer_rows) == 1 and outer_rows[0][0] == 1:
+        # single fully-collapsed level: pure 2-D pipeline (the hot case —
+        # leading singleton out dims measurably derail Mosaic here)
+        grid = (nb_tiles,)
+
+        def in_map(i):
+            return (start_blk + i, 0)
+
+        def out_map(i):
+            return (i, 0)
+
+        out_shape = (nblocks, bl)
+        in_block = (tile, bl)
+        out_block = (tile, bl)
+    elif len(outer_rows) == 1:
+        (n_o, e_rows), = outer_rows
+        e_blk = e_rows // tile
+        grid = (n_o, nb_tiles)
+
+        def in_map(o, i):
+            return (start_blk + o * e_blk + i, 0)
+
+        def out_map(o, i):
+            return (o, i, 0)
+
+        out_shape = (n_o, nblocks, bl)
+        in_block = (tile, bl)
+        out_block = (1, tile, bl)
+    else:
+        (n_o, e_rows), (n_k, s_rows) = outer_rows
+        e_blk, s_blk = e_rows // tile, s_rows // tile
+        grid = (n_o, n_k, nb_tiles)
+
+        def in_map(o, k, i):
+            return (start_blk + o * e_blk + k * s_blk + i, 0)
+
+        def out_map(o, k, i):
+            return (o, k, i, 0)
+
+        out_shape = (n_o, n_k, nblocks, bl)
+        in_block = (tile, bl)
+        out_block = (1, 1, tile, bl)
+
+    call = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[pl.BlockSpec(in_block, in_map, **mem)],
+        out_specs=pl.BlockSpec(out_block, out_map, **mem),
+        out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint8),
+        interpret=interpret,
+    )
+
+    def fn(u8):
+        view = u8.reshape(p["nrows"], rowstride)
+        return call(view).reshape(-1)
+
+    return jax.jit(fn)
+
+
+def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
+         strides: Sequence[int], extent: int, incount: int) -> jax.Array:
+    """Pack ``incount`` strided objects into a dense uint8 vector.
+    Same contract as pack_xla.pack."""
+    assert strides[0] == 1
+    if incount == 0 or any(c == 0 for c in counts):
+        return jnp.zeros((0,), dtype=jnp.uint8)
+    args = (src_u8.shape[0], int(start), tuple(map(int, counts)),
+            tuple(map(int, strides)), int(extent), int(incount))
+    if _plan(*args) is not None:
+        try:
+            return _build_pack(*args)(src_u8)
+        except ImportError:  # pallas unimportable (tpu factory dropped)
+            log.warn("pallas unavailable; packing via XLA")
+    # geometry of THIS buffer unsupported
+    from . import pack_xla
+    return pack_xla.pack(src_u8, start, counts, strides, extent, incount)
+
+
+# -- unpack: strided-view XLA update (see module docstring) -------------------
+
+
+@functools.lru_cache(maxsize=2048)
+def _build_unpack(nbytes: int, start: int, counts: Tuple[int, ...],
+                  strides: Tuple[int, ...], extent: int, incount: int):
+    p = _plan(nbytes, start, counts, strides, extent, incount)
+    assert p is not None
+    bl, rowstride = p["bl"], p["rowstride"]
+    nblocks = p["nblocks"]
+    outer_rows = p["outer_rows"]
+    start_row = p["start_row"]
+
+    def splice(out, pk2d, r0):
+        """One fused strided update over ``nblocks`` contiguous rows
+        (static offsets — all indices are Python ints)."""
+        rows = jnp.concatenate([pk2d, out[r0:r0 + nblocks, bl:]], axis=1)
+        if r0 == 0 and nblocks == out.shape[0]:
+            return rows
+        return jnp.concatenate([out[:r0], rows, out[r0 + nblocks:]], axis=0)
+
+    def fn(u8, packed):
+        out = u8.reshape(p["nrows"], rowstride)
+        if len(outer_rows) == 1:
+            n_o, e_rows = outer_rows[0]
+            pk = packed.reshape(n_o, nblocks, bl)
+            for o in range(n_o):
+                out = splice(out, pk[o], start_row + o * e_rows)
+        else:
+            (n_o, e_rows), (n_k, s_rows) = outer_rows
+            pk = packed.reshape(n_o, n_k, nblocks, bl)
+            for o in range(n_o):
+                for k in range(n_k):
+                    out = splice(out, pk[o, k],
+                                 start_row + o * e_rows + k * s_rows)
+        return out.reshape(-1)
+
+    return jax.jit(fn)
+
+
+def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
+           counts: Sequence[int], strides: Sequence[int], extent: int,
+           incount: int) -> jax.Array:
+    """Unpack into a copy of ``dst_u8`` preserving gap bytes.
+    Same contract as pack_xla.unpack."""
+    assert strides[0] == 1
+    if incount == 0 or any(c == 0 for c in counts):
+        return dst_u8
+    args = (dst_u8.shape[0], int(start), tuple(map(int, counts)),
+            tuple(map(int, strides)), int(extent), int(incount))
+    p = _plan(*args)
+    n_updates = (0 if p is None else
+                 math.prod(n for n, _ in p["outer_rows"]))
+    if p is None or n_updates > 64:  # unrolled updates would bloat the program
+        from . import pack_xla
+        return pack_xla.unpack(dst_u8, packed_u8, start, counts, strides,
+                               extent, incount)
+    return _build_unpack(*args)(dst_u8, packed_u8)

@@ -103,27 +103,22 @@ class PackerND(Packer):
         return (ctr.counters.pack2d if self.sb.ndims == 2
                 else ctr.counters.pack3d)
 
-    def _backend(self):
+    def _backend(self, nbytes: int, incount: int):
         kernel = envmod.env.pack_kernel
         if kernel in (PackKernel.PALLAS, PackKernel.AUTO):
-            try:
-                from . import pack_pallas
-                if pack_pallas.supports(self.sb):
-                    return pack_pallas
-                if kernel is PackKernel.PALLAS:
-                    log.warn(f"TEMPI_PACK_KERNEL=pallas but {self.sb} "
-                             "unsupported by the pallas backend; using XLA")
-            except ImportError:
-                if kernel is PackKernel.PALLAS:
-                    log.warn("TEMPI_PACK_KERNEL=pallas but the pallas backend "
-                             "is unavailable; using XLA")
+            from . import pack_pallas
+            if pack_pallas.supports(self.sb, nbytes, incount):
+                return pack_pallas
+            if kernel is PackKernel.PALLAS:
+                log.warn(f"TEMPI_PACK_KERNEL=pallas but {self.sb} "
+                         "unsupported by the pallas backend; using XLA")
         return pack_xla
 
     def pack(self, src_u8, incount):
         if not _is_tracing(src_u8):
             self._group.num_packs += 1
             self._group.bytes_packed += incount * self.packed_size
-        b = self._backend()
+        b = self._backend(src_u8.shape[0], incount)
         return b.pack(src_u8, self.sb.start, tuple(self.sb.counts),
                       tuple(self.sb.strides), self.sb.extent, incount)
 
@@ -131,7 +126,7 @@ class PackerND(Packer):
         if not _is_tracing(dst_u8):
             self._group.num_unpacks += 1
             self._group.bytes_unpacked += outcount * self.packed_size
-        b = self._backend()
+        b = self._backend(dst_u8.shape[0], outcount)
         return b.unpack(dst_u8, packed_u8, self.sb.start,
                         tuple(self.sb.counts), tuple(self.sb.strides),
                         self.sb.extent, outcount)

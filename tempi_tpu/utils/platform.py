@@ -27,6 +27,13 @@ def force_cpu(device_count: int = 8) -> None:
 
     jax.config.update("jax_platforms", "cpu")
     try:
+        # pallas registers TPU lowering rules at import; that import fails
+        # once the 'tpu' factory is dropped below, so do it now (cheap, and
+        # pack_pallas interpret-mode tests need it later)
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:
+        pass
+    try:
         from jax._src import xla_bridge as xb
 
         # drop any non-CPU plugin factories so backends() cannot try to
