@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Model-choice cache vs recomputing the interpolation.
+
+Re-design of /root/reference/bin/bench_cache.cpp (which compared C++ map
+containers for the sender's model-decision cache): measures a strategy-cache
+hit against re-running the measured-model composition
+(interp_2d + interp_time) it memoizes, plus the dict insert cost, justifying
+the per-plan decision cache in p2p.choose_strategy.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("model cache vs recompute")
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.measure.benchmark import benchmark
+
+    devices_or_die(1)
+    kw = bench_kwargs(args.quick)
+
+    # synthetic measured curves so the model composition has real work
+    sp = msys.SystemPerformance()
+    sp.host_pingpong = [(1 << i, 1e-6 * (i + 1)) for i in range(24)]
+    sp.intra_node_pingpong = [(1 << i, 5e-7 * (i + 1)) for i in range(24)]
+    sp.inter_node_pingpong = [(1 << i, 2e-6 * (i + 1)) for i in range(24)]
+    grid = [[1e-6 * (i + j + 1) for j in range(9)] for i in range(9)]
+    sp.pack_device = sp.unpack_device = grid
+    sp.pack_host = sp.unpack_host = [[2 * v for v in row] for row in grid]
+    msys.set_system(sp)
+
+    rng = np.random.default_rng(0)
+    keys = [(bool(rng.integers(0, 2)), int(1 << rng.integers(6, 23)),
+             int(1 << rng.integers(0, 9))) for _ in range(512)]
+
+    def recompute():
+        for colocated, nbytes, bl in keys:
+            t_d = msys.model_device(nbytes, bl, colocated)
+            t_o = msys.model_oneshot(nbytes, bl, colocated)
+            _ = t_o < t_d
+
+    cache = {}
+
+    def cached():
+        for key in keys:
+            hit = cache.get(key)
+            if hit is None:
+                colocated, nbytes, bl = key
+                hit = (msys.model_oneshot(nbytes, bl, colocated)
+                       < msys.model_device(nbytes, bl, colocated))
+                cache[key] = hit
+
+    recompute()
+    r_re = benchmark(recompute, **kw)
+    cached()
+    r_hit = benchmark(cached, **kw)
+    emit_csv(("variant", "lookups", "time_s", "per_lookup_s"),
+             [("recompute", len(keys), r_re.trimean,
+               r_re.trimean / len(keys)),
+              ("dict_cache", len(keys), r_hit.trimean,
+               r_hit.trimean / len(keys))])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

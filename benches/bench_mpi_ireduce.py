@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Reduction survey over element counts.
+
+Re-design of /root/reference/bin/bench_mpi_ireduce.cpp (a survey of the
+library's Ireduce on device buffers of doubles): times allreduce and
+root-reduce over the mesh for float32/int32 at 2^10..2^22 bytes (float64
+would need jax_enable_x64; the reduce layer refuses the silent downcast).
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("reduce survey", multirank=True)
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[1 << k for k in range(10, 23, 4)])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+    rows = []
+    # float64 needs jax x64; the canonical on-TPU element types are surveyed
+    for nbytes in args.sizes:
+        for dtype in (np.float32, np.int32):
+            buf = comm.alloc(nbytes)
+
+            for kind in ("allreduce", "reduce"):
+                def run():
+                    if kind == "allreduce":
+                        api.allreduce(comm, buf, dtype=dtype)
+                    else:
+                        api.reduce(comm, buf, root=0, dtype=dtype)
+                    buf.data.block_until_ready()
+
+                run()  # compile
+                r = benchmark(run, **kw)
+                rows.append((kind, np.dtype(dtype).name, nbytes, r.trimean,
+                             nbytes / r.trimean))
+    emit_csv(("op", "dtype", "bytes", "time_s", "Bps"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Isend/Irecv throughput between two ranks.
+
+Re-design of /root/reference/bin/bench_mpi_isend.cpp: rank 0 posts a window
+of Isends of a 2-D strided type to rank 1 (which posts matching Irecvs),
+waits on all, and reports operations/s and payload bandwidth per window size.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("isend window throughput", multirank=True)
+    p.add_argument("--nblocks", type=int, default=512)
+    p.add_argument("--blocklength", type=int, default=256)
+    p.add_argument("--stride", type=int, default=512)
+    p.add_argument("--windows", type=int, nargs="*", default=[1, 4, 16])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import support_types as st
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+    ty = st.make_2d_byte_subarray(args.nblocks, args.blocklength, args.stride)
+    payload = args.nblocks * args.blocklength
+    sbuf = comm.alloc(ty.extent)
+    rbuf = comm.alloc(ty.extent)
+
+    rows = []
+    for window in args.windows:
+        def run():
+            reqs = []
+            for i in range(window):
+                reqs.append(api.isend(comm, 0, sbuf, 1, ty, tag=i))
+                reqs.append(api.irecv(comm, 1, rbuf, 0, ty, tag=i))
+            api.waitall(reqs)
+            rbuf.data.block_until_ready()
+
+        run()  # compile the exchange plan
+        r = benchmark(run, **kw)
+        rows.append((window, payload, r.trimean, window / r.trimean,
+                     window * payload / r.trimean))
+    emit_csv(("window", "payload_B", "time_s", "isend_per_s", "Bps"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Random matrix driven through neighbor_alltoallv.
+
+Re-design of /root/reference/bin/bench_mpi_random_neighbor_alltoallv.cpp:
+the same random matrix executed as a graph-neighborhood collective (with and
+without placement reorder), comparable row-for-row against the alltoallv and
+isend/irecv pattern methods.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("random neighbor alltoallv", multirank=True)
+    p.add_argument("--scale", type=int, default=1 << 14)
+    p.add_argument("--density", type=float, default=0.3)
+    p.add_argument("--ranks-per-node", type=int, default=2)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import os
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+
+    from bench_mpi_random_alltoallv import make_sparse_counts
+    from method import MethodAlltoallv, MethodNeighborAlltoallv
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+    counts = make_sparse_counts(comm.size, args.density, args.scale, seed=17)
+    rows = []
+    methods = [MethodAlltoallv(comm, counts),
+               MethodNeighborAlltoallv(comm, counts, reorder=False),
+               MethodNeighborAlltoallv(comm, counts, reorder=True)]
+    labels = ["alltoallv", "neighbor", "neighbor+reorder"]
+    for label, m in zip(labels, methods):
+        m.run()  # compile
+        r = benchmark(m.run, **kw)
+        rows.append((label, int(counts.sum()), r.trimean,
+                     counts.sum() / r.trimean))
+    emit_csv(("method", "total_B", "time_s", "Bps"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

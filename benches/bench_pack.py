@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Direct packer micro-benchmark with backend comparison.
+
+Re-design of /root/reference/bin/bench_pack.cpp: drive Packer objects
+directly (no send machinery) over a (numBlocks x blockLength) sweep at fixed
+stride, reporting pack/unpack bandwidth per backend (pallas kernel vs XLA
+chain vs typemap fallback) so kernel wins are visible in isolation.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("direct packer micro-benchmark")
+    p.add_argument("--stride", type=int, default=1024)
+    p.add_argument("--nblocks", type=int, nargs="*",
+                   default=[64, 512, 4096, 8192])
+    p.add_argument("--blocklengths", type=int, nargs="*",
+                   default=[128, 256, 512])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import pack_pallas, pack_xla
+    from tempi_tpu.ops.packer import PackerFallback
+    import support_types as st
+
+    devices_or_die(1)
+    kw = bench_kwargs(args.quick)
+    rng = np.random.default_rng(0)
+    rows = []
+    for nb in args.nblocks:
+        for bl in args.blocklengths:
+            if bl > args.stride:
+                continue
+            nbytes = nb * args.stride
+            extent = nbytes
+            buf = jax.device_put(jnp.asarray(
+                rng.integers(0, 256, nbytes, np.uint8)))
+            geom = (0, (bl, nb), (1, args.stride), extent, 1)
+            backends = [("xla", pack_xla), ("pallas", pack_pallas)]
+            for name, mod in backends:
+                if name == "pallas" and pack_pallas._plan(nbytes,
+                                                          *geom) is None:
+                    continue
+                last = []
+
+                def enq():
+                    last[:] = [mod.pack(buf, *geom)]
+
+                enq()
+                last[0].block_until_ready()
+                r = benchmark(enq, flush=lambda: last[0].block_until_ready(),
+                              **kw)
+                rows.append((name, nb, bl, args.stride, nb * bl, r.trimean,
+                             nb * bl / r.trimean))
+            # typemap fallback reference point (small shapes only: the
+            # gather index table is O(bytes))
+            if nb * bl <= 1 << 20:
+                ty = st.make_2d_byte_vector(nb, bl, args.stride)
+                fb = PackerFallback(ty)
+                last = []
+
+                def enqf():
+                    last[:] = [fb.pack(buf, 1)]
+
+                enqf()
+                last[0].block_until_ready()
+                r = benchmark(enqf, flush=lambda: last[0].block_until_ready(),
+                              **kw)
+                rows.append(("fallback", nb, bl, args.stride, nb * bl,
+                             r.trimean, nb * bl / r.trimean))
+    emit_csv(("backend", "nblocks", "blocklen_B", "stride_B", "size_B",
+              "pack_s", "pack_Bps"), rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

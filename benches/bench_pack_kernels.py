@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Raw pack-kernel bench over the measure-system grid.
+
+Re-design of /root/reference/bin/bench_pack_kernels.cu: times the raw kernel
+entry points (no Packer/type-cache layers) over the same 9x9
+(bytes=2^(2i+6), blockLength=2^j, stride 512) grid the system measurement
+sweeps, so perf.json numbers can be sanity-checked against a direct run.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("raw pack kernels over the measurement grid")
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.measure.system import GRID_BLOCKLEN, GRID_BYTES, GRID_STRIDE
+    from tempi_tpu.ops import pack_pallas, pack_xla
+
+    devices_or_die(1)
+    kw = bench_kwargs(args.quick)
+    rng = np.random.default_rng(0)
+    rows = []
+    for total in GRID_BYTES:
+        for bl in GRID_BLOCKLEN:
+            nb = max(1, total // bl)
+            nbytes = nb * GRID_STRIDE
+            buf = jax.device_put(jnp.asarray(
+                rng.integers(0, 256, nbytes, np.uint8)))
+            geom = (0, (bl, nb), (1, GRID_STRIDE), nbytes, 1)
+            mods = [("xla", pack_xla)]
+            if pack_pallas._plan(nbytes, geom[0], geom[1], geom[2], geom[3],
+                                 geom[4]) is not None:
+                mods.append(("pallas", pack_pallas))
+            for name, mod in mods:
+                last = []
+
+                def enq():
+                    last[:] = [mod.pack(buf, *geom)]
+
+                enq()
+                last[0].block_until_ready()
+                r = benchmark(enq, flush=lambda: last[0].block_until_ready(),
+                              **kw)
+                rows.append((name, total, bl, nb, r.trimean,
+                             nb * bl / r.trimean))
+    emit_csv(("kernel", "target_B", "blocklen_B", "nblocks", "pack_s",
+              "pack_Bps"), rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Type create/commit latency over the datatype zoo.
+
+Re-design of /root/reference/bin/bench_type_commit.cpp: measures the cost of
+building a datatype plus committing it (decode -> canonicalize ->
+strided-block -> plan) for every factory spelling, cold (cache cleared each
+iteration) and warm (type-cache hit).
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("type commit latency")
+    args = p.parse_args()
+    setup_platform(args)
+
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import type_cache
+    import support_types as st
+
+    devices_or_die(1)
+    kw = bench_kwargs(args.quick)
+
+    cases = {}
+    for name, f in st.FACTORIES_1D.items():
+        cases[f"1d/{name}"] = lambda f=f: f(64)
+    for name, f in st.FACTORIES_2D.items():
+        cases[f"2d/{name}"] = lambda f=f: f(128, 256, 512)
+    for name, f in st.FACTORIES_3D.items():
+        cases[f"3d/{name}"] = lambda f=f: f((16, 16, 16), (64, 64, 64))
+
+    rows = []
+    for name, make in cases.items():
+        def cold():
+            type_cache.clear()
+            type_cache.commit(make())
+
+        cold()
+        rc = benchmark(cold, **kw)
+
+        ty = make()
+        type_cache.clear()
+        type_cache.commit(ty)
+
+        def warm():
+            type_cache.get_or_commit(ty)
+
+        rw = benchmark(warm, **kw)
+        rows.append((name, rc.trimean, rw.trimean))
+    type_cache.clear()
+    emit_csv(("type", "commit_cold_s", "cache_hit_s"), rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

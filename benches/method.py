@@ -1,0 +1,135 @@
+"""Pattern methods: ways of executing a random communication matrix.
+
+Re-design of /root/reference/bin/method.hpp + method.cpp: a Method turns a
+(size x size) counts matrix into communication through one API surface —
+alltoallv, isend/irecv for every pair, isend/irecv for nonzero pairs only,
+or neighbor_alltoallv over a dist-graph communicator — so the
+bench-mpi-random-* CLIs share one driver (reference: bin/benchmark.cpp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_random_counts(size, scale, seed):
+    """Dense random square matrix (reference: support/squaremat.cpp)."""
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(1, scale, (size, size))
+    np.fill_diagonal(counts, 0)
+    return counts
+
+
+def displs_of(counts):
+    sd = np.zeros_like(counts)
+    rd = np.zeros_like(counts)
+    for r in range(counts.shape[0]):
+        sd[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rd[r] = np.concatenate([[0], np.cumsum(counts.T[r])[:-1]])
+    return sd, rd
+
+
+def alloc_pair(comm, counts):
+    nb_s = max(1, int(counts.sum(1).max()))
+    nb_r = max(1, int(counts.sum(0).max()))
+    return comm.alloc(nb_s), comm.alloc(nb_r)
+
+
+class MethodAlltoallv:
+    name = "alltoallv"
+
+    def __init__(self, comm, counts):
+        from tempi_tpu import api
+
+        self.api = api
+        self.comm = comm
+        self.counts = counts
+        self.sd, self.rd = displs_of(counts)
+        self.sbuf, self.rbuf = alloc_pair(comm, counts)
+
+    def run(self):
+        self.api.alltoallv(self.comm, self.sbuf, self.counts, self.sd,
+                           self.rbuf, self.counts.T, self.rd)
+        self.rbuf.data.block_until_ready()
+
+
+class MethodIsendIrecv:
+    """One isend/irecv per pair — including zero-byte pairs, which the
+    reference posts too (bin/method.cpp Method_isend_irecv)."""
+
+    name = "isend_irecv"
+    sparse = False
+
+    def __init__(self, comm, counts):
+        from tempi_tpu import api
+        from tempi_tpu.ops import dtypes as dt
+
+        self.api = api
+        self.dt = dt
+        self.comm = comm
+        self.counts = counts
+        self.sd, self.rd = displs_of(counts)
+        self.sbuf, self.rbuf = alloc_pair(comm, counts)
+
+    def run(self):
+        api, dt, comm, counts = self.api, self.dt, self.comm, self.counts
+        reqs = []
+        for a in range(comm.size):
+            for b in range(comm.size):
+                n = int(counts[a, b])
+                if a == b or (self.sparse and n == 0):
+                    continue
+                # dense mode posts zero-byte pairs too (count=0 on a 1-byte
+                # type): no payload moves, but the request/match machinery
+                # runs — the posting overhead is what dense-vs-sparse measures
+                ty = dt.contiguous(max(n, 1), dt.BYTE)
+                reqs.append(api.isend(comm, a, self.sbuf, b, ty,
+                                      count=1 if n else 0,
+                                      offset=int(self.sd[a, b])))
+                reqs.append(api.irecv(comm, b, self.rbuf, a, ty,
+                                      count=1 if n else 0,
+                                      offset=int(self.rd[b, a])))
+        api.waitall(reqs)
+        self.rbuf.data.block_until_ready()
+
+
+class MethodSparseIsendIrecv(MethodIsendIrecv):
+    name = "sparse_isend_irecv"
+    sparse = True
+
+
+class MethodNeighborAlltoallv:
+    name = "neighbor_alltoallv"
+
+    def __init__(self, comm, counts, reorder=False):
+        from tempi_tpu import api
+        from tempi_tpu.utils.env import PlacementMethod
+
+        self.api = api
+        size = comm.size
+        sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+                   for r in range(size)]
+        dests = [[int(d) for d in np.nonzero(counts[r])[0]]
+                 for r in range(size)]
+        sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+        dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+        self.g = api.dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sw, dweights=dw, reorder=reorder,
+            method=PlacementMethod.KAHIP if reorder else None)
+        self.sbuf, self.rbuf = alloc_pair(self.g, counts)
+        self.sc, self.sd, self.rc, self.rd = [], [], [], []
+        for r in range(size):
+            srcs, dsts = self.g.graph[r]
+            cs = [int(counts[r, d]) for d in dsts]
+            cr = [int(counts[s, r]) for s in srcs]
+            self.sc.append(cs)
+            self.sd.append(list(np.concatenate([[0], np.cumsum(cs)[:-1]])
+                                if cs else []))
+            self.rc.append(cr)
+            self.rd.append(list(np.concatenate([[0], np.cumsum(cr)[:-1]])
+                                if cr else []))
+
+    def run(self):
+        self.api.neighbor_alltoallv(self.g, self.sbuf, self.sc, self.sd,
+                                    self.rbuf, self.rc, self.rd)
+        self.rbuf.data.block_until_ready()

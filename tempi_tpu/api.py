@@ -125,6 +125,16 @@ def neighbor_alltoallw(*args, **kwargs):
     return _naw(*args, **kwargs)
 
 
+def allreduce(*args, **kwargs):
+    from .parallel.reduce import allreduce as _ar
+    return _ar(*args, **kwargs)
+
+
+def reduce(*args, **kwargs):
+    from .parallel.reduce import reduce as _r
+    return _r(*args, **kwargs)
+
+
 def dist_graph_create_adjacent(*args, **kwargs):
     from .parallel.dist_graph import dist_graph_create_adjacent as _dg
     return _dg(*args, **kwargs)

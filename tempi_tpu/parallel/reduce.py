@@ -1,0 +1,91 @@
+"""Reduction collectives over the communicator mesh.
+
+The reference does not interpose MPI_Reduce/MPI_Ireduce, but ships a survey
+benchmark of the library's Ireduce on device buffers
+(/root/reference/bin/bench_mpi_ireduce.cpp). The standalone framework needs
+the collective itself: here a reduce is one ``lax.psum`` over the mesh axis
+(XLA lowers it to a ring/tree over ICI), with the root-only result of
+MPI_Reduce expressed as a select on the axis index — the TPU-native shape of
+the reference's "library path".
+
+Buffers are DistBuffer byte rows; ``dtype`` gives the element view
+(MPI_DOUBLE ≙ float64 etc.). Ops: sum, max, min.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import counters as ctr
+from .communicator import AXIS, Communicator, DistBuffer
+
+_OPS = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+def _build(comm: Communicator, nbytes: int, dtype, op: str,
+           root: Optional[int]):
+    # with x64 disabled jax would silently compute a float64 view in
+    # float32, reinterpreting each double as two unrelated singles — refuse
+    # rather than reduce garbage
+    import numpy as np
+
+    jdt = jnp.dtype(jax.dtypes.canonicalize_dtype(dtype))
+    if jdt.itemsize != np.dtype(dtype).itemsize:
+        raise ValueError(
+            f"dtype {np.dtype(dtype).name} is unavailable (canonicalizes "
+            f"to {jdt.name}); enable jax_enable_x64 for 64-bit reductions")
+    if nbytes % jdt.itemsize:
+        raise ValueError(f"buffer of {nbytes} B is not a whole number of "
+                         f"{jdt.name} elements")
+    collective = _OPS[op]
+
+    def step(x):
+        loc = x.reshape(-1)
+        vals = jax.lax.bitcast_convert_type(
+            loc.reshape(-1, jdt.itemsize), jdt)
+        red = collective(vals, AXIS)
+        out = jax.lax.bitcast_convert_type(red, jnp.uint8).reshape(-1)
+        if root is not None:
+            # MPI_Reduce: only the root's buffer receives the result
+            me = jax.lax.axis_index(AXIS)
+            out = jnp.where(me == root, out, loc)
+        return out.reshape(1, -1)
+
+    sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
+                       out_specs=P(AXIS, None), check_vma=False)
+    return jax.jit(sm)
+
+
+def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
+         root: Optional[int]) -> None:
+    import numpy as np
+
+    key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
+    fn = comm._plan_cache.get(key)
+    if fn is None:
+        fn = _build(comm, buf.nbytes, dtype, op, root)
+        comm._plan_cache[key] = fn
+    buf.data = fn(buf.data)
+
+
+def allreduce(comm: Communicator, buf: DistBuffer, dtype=jnp.float32,
+              op: str = "sum") -> None:
+    """MPI_Allreduce analog, in place across every rank's row."""
+    ctr.counters.lib.num_calls += 1
+    _run(comm, buf, dtype, op, root=None)
+
+
+def reduce(comm: Communicator, buf: DistBuffer, root: int = 0,
+           dtype=jnp.float32, op: str = "sum") -> None:
+    """MPI_Reduce analog: the reduction lands in the root's row; other rows
+    are unchanged. ``root`` is an application rank."""
+    ctr.counters.lib.num_calls += 1
+    _run(comm, buf, dtype, op, root=comm.library_rank(root))

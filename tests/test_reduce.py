@@ -1,0 +1,64 @@
+"""Reduction collectives over the virtual 8-device mesh."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def comm():
+    from tempi_tpu import api
+
+    c = api.init()
+    yield c
+    api.finalize()
+
+
+def rows(comm, n=4):
+    rng = np.random.default_rng(7)
+    return [rng.standard_normal(n).astype(np.float32) for _ in range(comm.size)]
+
+
+def test_allreduce_sum(comm):
+    from tempi_tpu import api
+
+    data = rows(comm)
+    buf = comm.buffer_from_host([np.frombuffer(r.tobytes(), np.uint8)
+                                 for r in data])
+    api.allreduce(comm, buf, dtype=np.float32, op="sum")
+    want = np.sum(data, axis=0)
+    for r in range(comm.size):
+        got = np.frombuffer(buf.get_rank(r).tobytes(), np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_reduce_root_only(comm):
+    from tempi_tpu import api
+
+    data = rows(comm)
+    buf = comm.buffer_from_host([np.frombuffer(r.tobytes(), np.uint8)
+                                 for r in data])
+    api.reduce(comm, buf, root=3, dtype=np.float32, op="max")
+    want = np.max(data, axis=0)
+    got_root = np.frombuffer(buf.get_rank(3).tobytes(), np.float32)
+    np.testing.assert_allclose(got_root, want, rtol=1e-6)
+    # non-root rows untouched
+    got_other = np.frombuffer(buf.get_rank(0).tobytes(), np.float32)
+    np.testing.assert_array_equal(got_other, data[0])
+
+
+def test_reduce_bad_size(comm):
+    from tempi_tpu import api
+
+    buf = comm.alloc(7)  # not a whole number of float32
+    with pytest.raises(ValueError):
+        api.allreduce(comm, buf, dtype=np.float32)
+
+
+def test_reduce_refuses_silent_downcast(comm):
+    """With x64 off, a float64 view would reinterpret each double as two
+    unrelated singles — must raise, not reduce garbage."""
+    from tempi_tpu import api
+
+    buf = comm.alloc(16)
+    with pytest.raises(ValueError, match="canonicalizes"):
+        api.allreduce(comm, buf, dtype=np.float64)
