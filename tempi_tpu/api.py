@@ -54,6 +54,11 @@ def finalize() -> None:
     try:
         p2p.finalize_check(_world)
     finally:
+        from .parallel import communicator as comm_mod
+        comm_mod.free_all()  # includes derived dist-graph communicators
+        from .runtime import allocators, events
+        events.finalize()
+        allocators.finalize()
         counters.finalize()
         type_cache.clear()
         _world = None

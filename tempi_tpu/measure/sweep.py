@@ -58,30 +58,43 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         sp.device_launch = (time.perf_counter() - t0) / n
         log.debug(f"device_launch = {sp.device_launch:.2e}s")
 
+    # measurement scratch comes from the slab pools like the reference's
+    # sweep allocating through hostAllocator/deviceAllocator
+    # (measure_system.cu:90-167): device-destined staging from the device
+    # pool, host-side buffers from the host pool
+    from ..runtime import allocators
+    dev_alloc = allocators.device_allocator()
+    host_alloc = allocators.host_allocator()
+
     if not sp.d2h:
         for nb in _transfer_sizes(quick):
-            buf = jax.device_put(np.zeros(nb, np.uint8), device)
+            scratch = dev_alloc.allocate(nb)
+            buf = jax.device_put(scratch, device)
             buf.block_until_ready()
             r = benchmark(lambda: np.asarray(buf), **kw)
             sp.d2h.append((nb, r.trimean))
+            dev_alloc.release(scratch)
         log.debug(f"d2h: {len(sp.d2h)} points")
 
     if not sp.h2d:
         for nb in _transfer_sizes(quick):
-            host = np.zeros(nb, np.uint8)
+            host = dev_alloc.allocate(nb)
             r = benchmark(
                 lambda: jax.device_put(host, device).block_until_ready(),
                 **kw)
             sp.h2d.append((nb, r.trimean))
+            dev_alloc.release(host)
         log.debug(f"h2d: {len(sp.h2d)} points")
 
     if not sp.host_pingpong:
         for nb in _transfer_sizes(quick):
-            a = np.zeros(nb, np.uint8)
-            b = np.empty_like(a)
+            a = host_alloc.allocate(nb)
+            b = host_alloc.allocate(nb)
             # host->host round trip (reference intra-node CPU pingpong)
             r = benchmark(lambda: (np.copyto(b, a), np.copyto(a, b)), **kw)
             sp.host_pingpong.append((nb, r.trimean))
+            host_alloc.release(a)
+            host_alloc.release(b)
 
     if not sp.intra_node_pingpong:
         devs = jax.devices()

@@ -1,0 +1,152 @@
+// Slab allocator over host memory for staging buffers.
+//
+// Native-equivalent of the reference's allocator stack
+// (/root/reference/include/allocator_slab.hpp, allocator_host.hpp,
+// allocator_device.hpp, src/internal/allocators.cpp): power-of-two size-class
+// pools that never return memory to the system until finalize, usage
+// counters, and detection of foreign-pointer releases. The reference backs
+// its pools with cudaMalloc (device) and pinned mapped host registration;
+// here the backing store is page-aligned host memory used for the
+// host-staging transport (STAGED/ONESHOT paths) and measurement scratch —
+// device memory on TPU is owned by the XLA runtime, so the device side of
+// the reference maps to buffer-donation/plan caching, not a malloc pool.
+//
+// C ABI only (loaded with ctypes).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// round up to a power of two, minimum 64 (one cache line)
+uint64_t size_class(uint64_t n) {
+  uint64_t c = 64;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+struct Slab {
+  void *ptr;
+  uint64_t bytes;  // size-class bytes actually reserved
+};
+
+struct Pool {
+  uint64_t alignment;
+  // size class -> free slabs
+  std::map<uint64_t, std::vector<void *>> avail;
+  // live pointer -> size class
+  std::unordered_map<void *, uint64_t> live;
+  // everything ever reserved (for teardown)
+  std::vector<Slab> all;
+  // counters (mirrors SlabAllocator usage counters,
+  // allocator_slab.hpp:117-121)
+  uint64_t num_allocs = 0;    // fresh reservations from the system
+  uint64_t num_requests = 0;  // allocate() calls
+  uint64_t num_releases = 0;  // release() calls
+  uint64_t current_usage = 0; // bytes handed out right now
+  uint64_t max_usage = 0;     // high-water mark
+  uint64_t reserved = 0;      // bytes held from the system
+};
+
+// one mutex serializes every pool operation: destroy() can run concurrently
+// with allocate/release from another thread, so per-pool locking would race
+// with pool deletion (host staging traffic is far from lock-bound)
+std::mutex g_mu;
+std::unordered_map<int64_t, Pool *> g_pools;
+int64_t g_next = 1;
+
+Pool *get_pool_locked(int64_t h) {
+  auto it = g_pools.find(h);
+  return it == g_pools.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tempi_slab_create(uint64_t alignment) {
+  if (alignment == 0 || (alignment & (alignment - 1)) != 0) alignment = 4096;
+  Pool *p = new Pool();
+  p->alignment = alignment;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next++;
+  g_pools[h] = p;
+  return h;
+}
+
+void *tempi_slab_allocate(int64_t h, uint64_t bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Pool *p = get_pool_locked(h);
+  if (!p || bytes == 0) return nullptr;
+  uint64_t cls = size_class(bytes);
+  p->num_requests++;
+  auto &freelist = p->avail[cls];
+  void *ptr;
+  if (!freelist.empty()) {
+    ptr = freelist.back();
+    freelist.pop_back();
+  } else {
+    if (posix_memalign(&ptr, p->alignment, cls) != 0) return nullptr;
+    p->num_allocs++;
+    p->reserved += cls;
+    p->all.push_back({ptr, cls});
+  }
+  p->live[ptr] = cls;
+  p->current_usage += cls;
+  if (p->current_usage > p->max_usage) p->max_usage = p->current_usage;
+  return ptr;
+}
+
+// 0 on success; -1 foreign pointer (reference FATALs,
+// allocator_slab.hpp:154-172 — the binding layer raises)
+int tempi_slab_release(int64_t h, void *ptr) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Pool *p = get_pool_locked(h);
+  if (!p) return -1;
+  auto it = p->live.find(ptr);
+  if (it == p->live.end()) return -1;
+  p->num_releases++;
+  p->current_usage -= it->second;
+  p->avail[it->second].push_back(ptr);
+  p->live.erase(it);
+  return 0;
+}
+
+// out[0..6] = num_allocs, num_requests, num_releases, current_usage,
+//             max_usage, reserved, live_count
+void tempi_slab_stats(int64_t h, uint64_t *out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Pool *p = get_pool_locked(h);
+  if (!p) {
+    memset(out, 0, 7 * sizeof(uint64_t));
+    return;
+  }
+  out[0] = p->num_allocs;
+  out[1] = p->num_requests;
+  out[2] = p->num_releases;
+  out[3] = p->current_usage;
+  out[4] = p->max_usage;
+  out[5] = p->reserved;
+  out[6] = p->live.size();
+}
+
+// returns the number of leaked (still-live) allocations, then frees
+// everything back to the system (reference: release_all at finalize)
+int64_t tempi_slab_destroy(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_pools.find(h);
+  if (it == g_pools.end()) return -1;
+  Pool *p = it->second;
+  g_pools.erase(it);
+  int64_t leaked = (int64_t)p->live.size();
+  for (auto &s : p->all) free(s.ptr);
+  delete p;
+  return leaked;
+}
+
+}  // extern "C"

@@ -17,7 +17,7 @@ from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libtempi_native.so")
-_SOURCES = ["partition.cpp", "iid.cpp"]
+_SOURCES = ["partition.cpp", "iid.cpp", "allocator.cpp"]
 
 _lock = threading.Lock()
 _lib = None

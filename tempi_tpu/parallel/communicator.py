@@ -16,6 +16,7 @@ mesh rows.
 
 from __future__ import annotations
 
+import weakref
 from typing import List, Optional, Sequence
 
 import jax
@@ -26,6 +27,16 @@ from ..utils import logging as log
 from . import topology as topo_mod
 
 AXIS = "ranks"
+
+# every live communicator, so finalize can release cached resources held by
+# derived (dist-graph) communicators the app never explicitly freed
+_all_comms: "weakref.WeakSet[Communicator]" = weakref.WeakSet()
+
+
+def free_all() -> None:
+    for comm in list(_all_comms):
+        if not comm.freed:
+            comm.free()
 
 
 class Communicator:
@@ -42,6 +53,7 @@ class Communicator:
         self._plan_cache = {}
         self._pending = []  # deferred isend/irecv ops (async engine)
         self.freed = False
+        _all_comms.add(self)
 
     # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------
 
@@ -93,7 +105,11 @@ class Communicator:
 
     def free(self) -> None:
         """MPI_Comm_free analog (reference: src/comm_free.cpp) — drops cached
-        plans/topology state."""
+        plans/topology state and returns staging memory to the slab pool."""
+        for plan in self._plan_cache.values():
+            release = getattr(plan, "release_staging", None)
+            if release is not None:  # cache also holds bare jitted programs
+                release()
         self._plan_cache.clear()
         self.freed = True
 

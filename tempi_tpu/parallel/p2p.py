@@ -39,10 +39,13 @@ _req_ids = itertools.count(1)
 @dataclass
 class Request:
     """Fake-request analog (reference: include/request.hpp Request::make):
-    a framework-owned handle, never a live library object."""
+    a framework-owned handle, never a live library object. Completion is an
+    event recorded over the buffers the exchange produced, mirroring the
+    reference's CUDA-event completion tracking (async_operation.cpp:161)."""
 
     id: int
     comm: Communicator
+    buf: Optional[DistBuffer] = None
     done: bool = False
 
     def wait(self) -> None:
@@ -74,7 +77,7 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
     if comm.freed:
         raise RuntimeError("communicator has been freed")
     packer = _packer_for(datatype)
-    req = Request(next(_req_ids), comm)
+    req = Request(next(_req_ids), comm, buf=buf)
     op = Op(kind=kind, rank=comm.library_rank(app_rank),
             peer=comm.library_rank(peer_app), tag=tag, buf=buf, offset=offset,
             packer=packer, count=count, nbytes=count * datatype.size,
@@ -195,6 +198,9 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
     pending (reference: async::try_progress pumping on each call)."""
     if not comm._pending:
         return 0
+    if comm.freed:
+        raise RuntimeError("communicator has been freed with operations "
+                           "still pending")
     messages, consumed, leftover = _match(comm._pending)
     if not messages:
         return 0
@@ -209,13 +215,21 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
 def wait(req: Request, strategy: Optional[str] = None) -> None:
     """MPI_Wait analog: drive progress until this request completes
     (async_operation.cpp:448-463)."""
-    if req.done:
-        return
-    try_progress(req.comm, strategy)
+    if not req.done:
+        try_progress(req.comm, strategy)
     if not req.done:
         raise RuntimeError(
             "wait() on a request whose peer operation was never posted "
             "(deadlock in MPI terms)")
+    if req.buf is not None:
+        # completion event over the exchanged buffer, recorded and drained
+        # here like the reference's cudaEventSynchronize on wait
+        # (async_operation.cpp:318-327)
+        from ..runtime import events
+        ev = events.request().record(req.buf.data)
+        ev.synchronize()
+        events.release(ev)
+        req.buf = None
 
 
 def waitall(reqs, strategy: Optional[str] = None) -> None:

@@ -92,6 +92,8 @@ class ExchangePlan:
         self.bufs = bufs
         self._device_fn = None
         self._round_fns = {}  # host_kind -> per-round (pack, unpack) fns
+        self._staging = None  # pooled host staging buffer (STAGED/ONESHOT)
+        self._staging_inflight = None  # H2D copy that may still read staging
 
     # -- signature for plan caching ------------------------------------------
 
@@ -267,16 +269,59 @@ class ExchangePlan:
             else:
                 payload = pf(*datas)
             host = np.asarray(payload)            # D2H (packed bytes only)
-            moved = np.zeros_like(host)
+            moved = self._staging_for(host.shape, host.dtype)
             for m in rnd:                          # host-side transport
                 moved[m.dst, : m.nbytes] = host[m.src, : m.nbytes]
             dev = jax.device_put(moved, comm.sharding())   # H2D
+            self._staging_inflight = dev
             datas = list(uf(dev, *datas))
         for b, d in zip(self.bufs, datas):
             b.data = d
 
+    def _staging_for(self, shape, dtype) -> np.ndarray:
+        """Host transport buffer from the slab pool (reference: hostAllocator
+        serving the staged senders, sender.cpp:194-249). One slab sized for
+        the plan's largest round backs every round's view, so varying round
+        sizes don't churn the pool. Stale bytes in rows/tails this round does
+        not write are never read: each receiving rank's unpack branch consumes
+        exactly payload[:nbytes], and non-receiving ranks take the identity
+        branch. jax.device_put is asynchronous, so before mutating the slab we
+        drain any H2D copy still reading it."""
+        if self._staging_inflight is not None:
+            jax.block_until_ready(self._staging_inflight)
+            self._staging_inflight = None
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if nbytes == 0:
+            return np.zeros(shape, dtype)
+        if self._staging is None or self._staging.nbytes < nbytes:
+            self.release_staging()
+            from ..runtime import allocators
+            self._staging = allocators.host_allocator().allocate(
+                max(nbytes, self._staging_capacity()))
+        return self._staging[:nbytes].view(dtype).reshape(shape)
+
+    def _staging_capacity(self) -> int:
+        """Largest per-round staging footprint of this plan."""
+        return max((self.comm.size * max(m.nbytes for m in rnd)
+                    for rnd in self.rounds if rnd), default=0)
+
+    def release_staging(self) -> None:
+        if self._staging_inflight is not None:
+            jax.block_until_ready(self._staging_inflight)
+            self._staging_inflight = None
+        if self._staging is not None:
+            from ..runtime import allocators
+            allocators.host_allocator().release(self._staging)
+            self._staging = None
+
     def run(self, strategy: str = "device") -> None:
-        with jax.named_scope(f"tempi.exchange.{strategy}"):
+        # DEVICE work (pack kernels + ICI permute) lands on the kernel
+        # stream scope, host-staged transport on the comm stream — the same
+        # split the reference draws between kernStream and commStream
+        from ..runtime import events
+        scope = events.kern_stream if strategy == "device" \
+            else events.comm_stream
+        with scope(), jax.named_scope(f"tempi.exchange.{strategy}"):
             if strategy == "device":
                 ctr.counters.send.num_device += len(self.messages)
                 self.run_device()

@@ -68,6 +68,8 @@ def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
          root: Optional[int]) -> None:
     import numpy as np
 
+    if comm.freed:
+        raise RuntimeError("communicator has been freed")
     key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
     fn = comm._plan_cache.get(key)
     if fn is None:

@@ -1,0 +1,1 @@
+"""Runtime services: allocators, events, progress queue (SURVEY.md L6)."""

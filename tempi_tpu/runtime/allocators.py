@@ -1,0 +1,217 @@
+"""Host/device staging allocators over the native slab pool.
+
+Re-design of the reference's allocator stack
+(/root/reference/include/allocator_slab.hpp, allocator_host.hpp,
+allocator_device.hpp, src/internal/allocators.cpp): global slab allocators
+with power-of-two size classes that keep memory until finalize, count usage,
+and fatally reject foreign releases. Two instances mirror the reference's
+``hostAllocator``/``deviceAllocator`` pair (allocators.cpp:10-11):
+
+* ``host_allocator()`` — page-aligned host memory from the native C++ pool
+  (tempi_tpu/native/allocator.cpp), used by the STAGED/ONESHOT transports as
+  the staging area that the reference serves from pinned mapped host memory.
+* ``device_allocator()`` — on TPU the XLA runtime owns HBM, so the device
+  pool hands out *host-shaped scratch destined for device_put* and tracks the
+  same counters; reuse on device comes from plan caching + buffer donation
+  rather than a raw byte pool.
+
+Every allocation is exposed as a numpy uint8 view over the pooled memory, so
+callers use normal numpy ops on recycled buffers (no per-iteration
+np.zeros/np.empty on the hot staged path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils import counters as ctr
+from ..utils import logging as log
+from ..utils.numeric import next_pow2
+
+_ALIGNMENT = 4096
+
+
+class ForeignPointerError(RuntimeError):
+    """Release of memory the pool never handed out (the reference FATALs,
+    allocator_slab.hpp:154-172)."""
+
+
+class _NativePool:
+    """ctypes binding over the C++ slab pool."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tempi_slab_create.restype = ctypes.c_int64
+        lib.tempi_slab_create.argtypes = [ctypes.c_uint64]
+        lib.tempi_slab_allocate.restype = ctypes.c_void_p
+        lib.tempi_slab_allocate.argtypes = [ctypes.c_int64, ctypes.c_uint64]
+        lib.tempi_slab_release.restype = ctypes.c_int
+        lib.tempi_slab_release.argtypes = [ctypes.c_int64, ctypes.c_void_p]
+        lib.tempi_slab_stats.restype = None
+        lib.tempi_slab_stats.argtypes = [ctypes.c_int64,
+                                         ctypes.POINTER(ctypes.c_uint64)]
+        lib.tempi_slab_destroy.restype = ctypes.c_int64
+        lib.tempi_slab_destroy.argtypes = [ctypes.c_int64]
+        self._h = lib.tempi_slab_create(_ALIGNMENT)
+
+    def allocate(self, nbytes: int) -> np.ndarray:
+        ptr = self._lib.tempi_slab_allocate(self._h, nbytes)
+        if not ptr:
+            raise MemoryError(f"slab allocate of {nbytes} B failed")
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        return np.frombuffer(buf, dtype=np.uint8)
+
+    def release(self, arr: np.ndarray) -> None:
+        # the pool is keyed by the slab's base address, so views sliced from
+        # the allocation release correctly as long as they start at offset 0
+        ptr = arr.__array_interface__["data"][0]
+        if self._lib.tempi_slab_release(self._h, ptr) != 0:
+            raise ForeignPointerError(
+                f"release of foreign pointer 0x{ptr:x}")
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 7)()
+        self._lib.tempi_slab_stats(self._h, out)
+        keys = ("num_allocs", "num_requests", "num_releases", "current_usage",
+                "max_usage", "reserved", "live")
+        return dict(zip(keys, (int(v) for v in out)))
+
+    def destroy(self) -> int:
+        if self._h is None:
+            return 0
+        leaked = self._lib.tempi_slab_destroy(self._h)
+        self._h = None
+        return int(leaked)
+
+
+class _PyPool:
+    """Pure-Python fallback with identical semantics (freelists of numpy
+    arrays per power-of-two size class)."""
+
+    def __init__(self):
+        self._avail: Dict[int, list] = {}
+        self._live: Dict[int, int] = {}  # id(base array) -> class
+        self._stats = dict(num_allocs=0, num_requests=0, num_releases=0,
+                           current_usage=0, max_usage=0, reserved=0)
+        self._lock = threading.Lock()
+
+    def allocate(self, nbytes: int) -> np.ndarray:
+        cls = max(64, next_pow2(nbytes))
+        with self._lock:
+            self._stats["num_requests"] += 1
+            freelist = self._avail.setdefault(cls, [])
+            if freelist:
+                base = freelist.pop()
+            else:
+                base = np.empty(cls, dtype=np.uint8)
+                self._stats["num_allocs"] += 1
+                self._stats["reserved"] += cls
+            self._live[id(base)] = base
+            self._stats["current_usage"] += cls
+            self._stats["max_usage"] = max(self._stats["max_usage"],
+                                           self._stats["current_usage"])
+        return base[:nbytes]
+
+    def release(self, arr: np.ndarray) -> None:
+        base = arr if arr.base is None else arr.base
+        with self._lock:
+            if id(base) not in self._live:
+                raise ForeignPointerError(
+                    "release of an array the pool did not allocate")
+            base = self._live.pop(id(base))
+            cls = base.size
+            self._stats["current_usage"] -= cls
+            self._avail[cls].append(base)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats, live=len(self._live))
+
+    def destroy(self) -> int:
+        with self._lock:
+            leaked = len(self._live)
+            self._avail.clear()
+            self._live.clear()
+        return leaked
+
+
+class SlabAllocator:
+    """Counter-tracking facade over a native or Python pool; allocations are
+    numpy views that must come back through release()."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._pool = None
+
+    def _ensure(self):
+        if self._pool is None:
+            from ..native import build
+            lib = build.load()
+            if lib is not None and hasattr(lib, "tempi_slab_create"):
+                self._pool = _NativePool(lib)
+            else:
+                self._pool = _PyPool()
+                log.spew(f"{self.name}: native pool unavailable, "
+                         "using Python freelists")
+        return self._pool
+
+    @property
+    def native(self) -> bool:
+        return isinstance(self._ensure(), _NativePool)
+
+    def allocate(self, nbytes: int) -> np.ndarray:
+        arr = self._ensure().allocate(nbytes)
+        c = ctr.counters.allocator
+        c.num_requests += 1
+        c.current_usage += arr.size
+        c.max_usage = max(c.max_usage, c.current_usage)
+        return arr
+
+    def release(self, arr: np.ndarray) -> None:
+        self._ensure().release(arr)
+        c = ctr.counters.allocator
+        c.num_releases += 1
+        c.current_usage -= arr.size
+
+    def stats(self) -> dict:
+        return self._ensure().stats()
+
+    def finalize(self) -> None:
+        """Free the pool; log leaks like the reference's foreign/leak
+        detection at finalize."""
+        if self._pool is None:
+            return
+        leaked = self._pool.destroy()
+        if leaked:
+            log.error(f"{self.name}: {leaked} allocation(s) never released")
+        self._pool = None
+
+
+_host: Optional[SlabAllocator] = None
+_device: Optional[SlabAllocator] = None
+
+
+def host_allocator() -> SlabAllocator:
+    global _host
+    if _host is None:
+        _host = SlabAllocator("hostAllocator")
+    return _host
+
+
+def device_allocator() -> SlabAllocator:
+    global _device
+    if _device is None:
+        _device = SlabAllocator("deviceAllocator")
+    return _device
+
+
+def finalize() -> None:
+    global _host, _device
+    for a in (_host, _device):
+        if a is not None:
+            a.finalize()
+    _host = _device = None

@@ -1,0 +1,137 @@
+"""Event pool and named execution streams.
+
+Re-design of the reference's CUDA stream/event services
+(/root/reference/src/internal/streams.cpp, events.cpp): the reference keeps
+two named non-blocking streams (``commStream``/``kernStream``) and a reusable
+pre-warmed CUDA event pool with leak detection at finalize.
+
+On TPU, XLA owns ordering: every jitted computation is dispatched
+asynchronously and dependencies are tracked by the runtime, so a "stream" is
+a profiler-visible named scope (``jax.named_scope`` shows up in Perfetto
+traces exactly like the reference's nvtxNameCudaStreamA naming) and an
+"event" is a completion handle over the output arrays of a dispatched
+computation: ``query()`` maps to non-blocking readiness (cudaEventQuery),
+``synchronize()`` to blocking (cudaEventSynchronize). The async p2p engine
+records events at pack/unpack boundaries the way the reference records CUDA
+events after pack_async (async_operation.cpp:119,161).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+
+from ..utils import counters as ctr
+from ..utils import logging as log
+
+PREWARM = 5  # reference pre-creates 5 events (events.cpp:69)
+
+
+class Event:
+    """Completion handle over dispatched device arrays."""
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self):
+        self._arrays: List = []
+
+    def record(self, *arrays) -> "Event":
+        """Attach the outputs of a dispatched computation (cudaEventRecord
+        analog: completion of these arrays IS the event)."""
+        self._arrays = [a for a in arrays if a is not None]
+        return self
+
+    def query(self) -> bool:
+        """Non-blocking: has everything recorded completed?
+        (cudaEventQuery analog; async_operation.cpp:161)."""
+        return all(a.is_ready() for a in self._arrays
+                   if hasattr(a, "is_ready"))  # non-jax values: always ready
+
+    def synchronize(self) -> None:
+        """Block until completion (cudaEventSynchronize analog)."""
+        for a in self._arrays:
+            ctr.counters.device.num_syncs += 1
+            jax.block_until_ready(a)
+
+    def reset(self) -> None:
+        self._arrays = []
+
+
+class _EventPool:
+    """Reusable event pool with leak detection (events.cpp:17-73)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: List[Event] = [Event() for _ in range(PREWARM)]
+        self._outstanding = 0
+
+    def request(self) -> Event:
+        with self._lock:
+            self._outstanding += 1
+            if self._free:
+                return self._free.pop()
+        return Event()
+
+    def release(self, ev: Event) -> None:
+        ev.reset()
+        with self._lock:
+            self._outstanding -= 1
+            self._free.append(ev)
+
+    def finalize(self) -> int:
+        """Returns leaked (requested, never released) events; reference logs
+        these at finalize (events.cpp:31-37)."""
+        with self._lock:
+            leaked = self._outstanding
+            self._free = [Event() for _ in range(PREWARM)]
+            self._outstanding = 0
+        return leaked
+
+
+_pool: Optional[_EventPool] = None
+
+
+def request() -> Event:
+    global _pool
+    if _pool is None:
+        _pool = _EventPool()
+    return _pool.request()
+
+
+def release(ev: Event) -> None:
+    if _pool is not None:
+        _pool.release(ev)
+
+
+def finalize() -> None:
+    global _pool
+    if _pool is not None:
+        leaked = _pool.finalize()
+        if leaked:
+            log.error(f"events: {leaked} event(s) never released")
+    _pool = None
+
+
+# -- named streams (streams.cpp analog) ---------------------------------------
+
+COMM_STREAM = "tempi.commStream"
+KERN_STREAM = "tempi.kernStream"
+
+
+@contextlib.contextmanager
+def stream(name: str):
+    """Profiler-visible execution scope; all work dispatched inside shows
+    under this name in a device trace (nvtx stream-naming analog)."""
+    with jax.named_scope(name):
+        yield
+
+
+def comm_stream():
+    return stream(COMM_STREAM)
+
+
+def kern_stream():
+    return stream(KERN_STREAM)
