@@ -35,6 +35,9 @@ def init(devices=None) -> Communicator:
         devices = jax.devices()
     _world = Communicator(devices)
     type_cache.init()
+    if envmod.env.progress_thread:
+        from .runtime import progress
+        progress.start()
     try:
         from .measure import system as msys
         msys.load_cached()
@@ -55,10 +58,16 @@ def finalize() -> None:
         p2p.finalize_check(_world)
     finally:
         from .parallel import communicator as comm_mod
-        comm_mod.free_all()  # includes derived dist-graph communicators
-        from .runtime import allocators, events
-        events.finalize()
-        allocators.finalize()
+        from .runtime import allocators, events, progress
+        pump_stopped = progress.stop()  # before freeing comms it may drive
+        if pump_stopped:
+            comm_mod.free_all()  # includes derived dist-graph communicators
+            events.finalize()
+            allocators.finalize()
+        else:
+            # a wedged pump thread may still hold views into pooled slabs:
+            # deliberately leak the pools rather than free memory under it
+            log.error("finalize: progress thread wedged; leaking slab pools")
         counters.finalize()
         type_cache.clear()
         _world = None

@@ -16,6 +16,7 @@ mesh rows.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import List, Optional, Sequence
 
@@ -52,6 +53,9 @@ class Communicator:
         self.parent = parent
         self._plan_cache = {}
         self._pending = []  # deferred isend/irecv ops (async engine)
+        # serializes op posting and progress between the application thread
+        # and the background progress pump
+        self._progress_lock = threading.RLock()
         self.freed = False
         _all_comms.add(self)
 
@@ -72,6 +76,12 @@ class Communicator:
 
     def node_of_app_rank(self, app_rank: int) -> int:
         return self.topology.node_of_rank[self.library_rank(app_rank)]
+
+    @property
+    def machine(self) -> "Machine":
+        """Hardware query facade (reference: include/machine.hpp)."""
+        from .machine import Machine
+        return Machine(self)
 
     @property
     def num_nodes(self) -> int:
@@ -105,13 +115,16 @@ class Communicator:
 
     def free(self) -> None:
         """MPI_Comm_free analog (reference: src/comm_free.cpp) — drops cached
-        plans/topology state and returns staging memory to the slab pool."""
-        for plan in self._plan_cache.values():
-            release = getattr(plan, "release_staging", None)
-            if release is not None:  # cache also holds bare jitted programs
-                release()
-        self._plan_cache.clear()
-        self.freed = True
+        plans/topology state and returns staging memory to the slab pool.
+        Takes the progress lock so teardown cannot race a background pump
+        thread still executing a cached plan."""
+        with self._progress_lock:
+            for plan in self._plan_cache.values():
+                release = getattr(plan, "release_staging", None)
+                if release is not None:  # cache also holds bare jitted fns
+                    release()
+            self._plan_cache.clear()
+            self.freed = True
 
 
 class DistBuffer:

@@ -1,0 +1,31 @@
+"""Machine facade: per-communicator hardware queries.
+
+The reference declares this class but never implemented it
+(/root/reference/include/machine.hpp — a header with no .cpp, SURVEY.md §2
+component 33). The TPU build completes it as the one-stop query surface the
+header promises: node of a rank, node count, and the largest application tag
+(everything at or above tags.RESERVED_BASE is framework-reserved, mirroring
+the reference reserving MPI_TAG_UB-1 for internal traffic, tags.cpp:16-27).
+"""
+
+from __future__ import annotations
+
+from . import tags
+
+
+class Machine:
+    def __init__(self, comm):
+        self._comm = comm
+
+    def node_of_rank(self, app_rank: int) -> int:
+        """The node application rank ``app_rank`` runs on (machine.hpp:19)."""
+        return self._comm.node_of_app_rank(app_rank)
+
+    def num_nodes(self) -> int:
+        """Nodes in the machine (machine.hpp:22)."""
+        return self._comm.num_nodes
+
+    def tag_ub(self) -> int:
+        """Largest tag available to the application (machine.hpp:25: the
+        MPI_TAG_UB analog, minus the framework-reserved range)."""
+        return tags.RESERVED_BASE - 1

@@ -82,7 +82,10 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
             peer=comm.library_rank(peer_app), tag=tag, buf=buf, offset=offset,
             packer=packer, count=count, nbytes=count * datatype.size,
             request=req)
-    comm._pending.append(op)
+    with comm._progress_lock:
+        comm._pending.append(op)
+    from ..runtime import progress
+    progress.notify(comm)
     group = ctr.counters.isend if kind == "send" else ctr.counters.irecv
     group.num_device += 1
     return req
@@ -195,21 +198,25 @@ def _block_length(m: Message) -> int:
 
 def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
     """Execute every currently-matched message set; leave unmatched ops
-    pending (reference: async::try_progress pumping on each call)."""
-    if not comm._pending:
-        return 0
-    if comm.freed:
-        raise RuntimeError("communicator has been freed with operations "
-                           "still pending")
-    messages, consumed, leftover = _match(comm._pending)
-    if not messages:
-        return 0
-    comm._pending = leftover
-    plan = get_plan(comm, messages)
-    plan.run(strategy or choose_strategy(comm, messages))
-    for op in consumed:
-        op.request.done = True
-    return len(messages)
+    pending (reference: async::try_progress pumping on each call). The
+    per-comm lock serializes against the background progress pump; even the
+    empty-pending fast path must take it, so a waiter blocks behind a pump
+    thread that is mid-exchange instead of concluding "never posted"."""
+    with comm._progress_lock:
+        if not comm._pending:
+            return 0
+        if comm.freed:
+            raise RuntimeError("communicator has been freed with operations "
+                               "still pending")
+        messages, consumed, leftover = _match(comm._pending)
+        if not messages:
+            return 0
+        comm._pending = leftover
+        plan = get_plan(comm, messages)
+        plan.run(strategy or choose_strategy(comm, messages))
+        for op in consumed:
+            op.request.done = True
+        return len(messages)
 
 
 def wait(req: Request, strategy: Optional[str] = None) -> None:
@@ -218,6 +225,11 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     if not req.done:
         try_progress(req.comm, strategy)
     if not req.done:
+        err = getattr(req.comm, "_progress_error", None)
+        if err is not None:
+            req.comm._progress_error = None
+            raise RuntimeError(
+                "background progress failed for this exchange") from err
         raise RuntimeError(
             "wait() on a request whose peer operation was never posted "
             "(deadlock in MPI terms)")

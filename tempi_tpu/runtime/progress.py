@@ -1,0 +1,93 @@
+"""Background progress pump for nonblocking operations.
+
+The reference's async engine progresses operations ONLY inside other TEMPI
+calls (async_operation.cpp:501-513 try_progress, pumped from isend/irecv
+entry points) — its thread-safe queue and the dead waitall sketch show a
+progress thread was intended but never landed. The TPU build finishes that
+design: when ``TEMPI_PROGRESS_THREAD`` is set, a daemon thread blocks on a
+Queue of communicators with freshly posted ops and drives
+``p2p.try_progress`` so matched exchanges launch without waiting for the
+application's next framework call. The in-call progress guarantee is
+unchanged — wait()/recv() still pump synchronously — the thread only makes
+progress *earlier*, never the sole provider.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils import logging as log
+from .queue import Queue, ShutDown
+
+
+class ProgressPump:
+    def __init__(self):
+        self._queue: Queue = Queue()
+        self._thread = threading.Thread(target=self._run,
+                                        name="tempi-progress", daemon=True)
+        self._thread.start()
+
+    def notify(self, comm) -> None:
+        """Called at op-post time (the isend/irecv entry, like the
+        reference's try_progress call sites)."""
+        try:
+            self._queue.push(comm)
+        except ShutDown:
+            pass
+
+    def _run(self) -> None:
+        from ..parallel import p2p
+        while True:
+            try:
+                comm = self._queue.pop()
+            except ShutDown:
+                return
+            try:
+                if not comm.freed and comm._pending:
+                    p2p.try_progress(comm)
+            except Exception as e:
+                # ops this run consumed will never turn done, so stash the
+                # real failure for the app's next wait() to re-raise
+                comm._progress_error = e
+                log.error(f"background progress failed: {e}")
+
+    def stop(self) -> bool:
+        """Returns False if the thread failed to stop — the caller must then
+        NOT free memory the thread may still reference."""
+        self._queue.close()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            log.error("progress thread did not stop within 5s")
+            return False
+        return True
+
+
+_pump: Optional[ProgressPump] = None
+
+
+def start() -> ProgressPump:
+    global _pump
+    if _pump is None:
+        _pump = ProgressPump()
+    return _pump
+
+
+def notify(comm) -> None:
+    if _pump is not None:
+        _pump.notify(comm)
+
+
+def running() -> bool:
+    return _pump is not None
+
+
+def stop() -> bool:
+    """Returns False if a pump thread is wedged and may still hold references
+    into pooled memory (finalize must then leak pools, not free them)."""
+    global _pump
+    clean = True
+    if _pump is not None:
+        clean = _pump.stop()
+    _pump = None
+    return clean

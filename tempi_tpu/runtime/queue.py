@@ -1,0 +1,60 @@
+"""Thread-safe queue for the progress engine.
+
+The reference ships a mutex-guarded queue with no users
+(/root/reference/src/internal/queue.hpp) — evidence its async engine was
+headed toward a dedicated progress thread that never landed (SURVEY.md §2
+component 32). Here the queue is load-bearing: the progress pump
+(runtime/progress.py) blocks on it for communicators with freshly posted
+operations.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ShutDown(Exception):
+    """Raised by pop() after close() drains the queue."""
+
+
+class Queue(Generic[T]):
+    """Unbounded MPSC-safe queue: push never blocks; pop blocks until an
+    item, a timeout, or close()."""
+
+    def __init__(self):
+        self._items: collections.deque = collections.deque()
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._closed = False
+
+    def push(self, item: T) -> None:
+        with self._cv:
+            if self._closed:
+                raise ShutDown("push() after close()")
+            self._items.append(item)
+            self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> T:
+        """Blocking pop. Raises TimeoutError on timeout, ShutDown when the
+        queue is closed and empty."""
+        with self._cv:
+            while not self._items:
+                if self._closed:
+                    raise ShutDown()
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError()
+            return self._items.popleft()
+
+    def close(self) -> None:
+        """Wake all waiters; subsequent pops drain then raise ShutDown."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)

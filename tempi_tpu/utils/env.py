@@ -93,6 +93,9 @@ class Environment:
     cache_dir: str = ""
     pack_kernel: PackKernel = PackKernel.AUTO
     ranks_per_node: int = 0  # 0 = discover from the platform
+    # background progress thread (no reference analog: the reference's
+    # queue.hpp/waitall sketch show one was intended but never landed)
+    progress_thread: bool = False
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -146,6 +149,8 @@ class Environment:
             e.ranks_per_node = int(getenv("TEMPI_RANKS_PER_NODE") or 0)
         except ValueError:
             e.ranks_per_node = 0
+
+        e.progress_thread = getenv("TEMPI_PROGRESS_THREAD") is not None
         return e
 
 
