@@ -64,14 +64,27 @@ def main() -> int:
         jnp.asarray(np.random.default_rng(0).integers(0, 256, ty.extent,
                                                       np.uint8)),
         devices[0])
-    packer.pack(buf, 1).block_until_ready()  # compile
+    # Throughput discipline for a tunneled TPU: (a) jit the full pack call —
+    # the eager path re-runs ~25 us of Python strategy/counter logic per
+    # call, slower than the ~7 us kernel; (b) batch K independent packs per
+    # dispatch — per-dispatch gaps otherwise add ~6 us/op; (c) 2 ms samples
+    # so the ~100 us flush round trip amortizes below 1%.
+    K = 8
+    bufs = [buf] + [
+        jax.device_put(
+            jnp.asarray(np.random.default_rng(i).integers(
+                0, 256, ty.extent, np.uint8)), devices[0])
+        for i in range(1, K)]
+    mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
+    jax.block_until_ready(mega(bufs))  # compile
     last = []
 
     def enqueue():
-        last[:] = [packer.pack(buf, 1)]
+        last[:] = [mega(bufs)]
 
-    r = benchmark(enqueue, flush=lambda: last[0].block_until_ready())
-    gbs = ty.size / r.trimean / 1e9
+    r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
+                  min_sample_secs=2e-3, max_trial_secs=3.0)
+    gbs = ty.size * K / r.trimean / 1e9
     print(json.dumps({
         "metric": f"bench-mpi-pack 2D subarray pack bandwidth ({platform})",
         "value": round(gbs, 3),

@@ -74,8 +74,6 @@ def _packer_for(datatype: Datatype):
 def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
           offset: int) -> Request:
-    if comm.freed:
-        raise RuntimeError("communicator has been freed")
     packer = _packer_for(datatype)
     req = Request(next(_req_ids), comm, buf=buf)
     op = Op(kind=kind, rank=comm.library_rank(app_rank),
@@ -83,6 +81,10 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
             packer=packer, count=count, nbytes=count * datatype.size,
             request=req)
     with comm._progress_lock:
+        # freed check under the lock: comm.free() also takes it, so an op
+        # can never slip into a communicator freed concurrently
+        if comm.freed:
+            raise RuntimeError("communicator has been freed")
         comm._pending.append(op)
     from ..runtime import progress
     progress.notify(comm)
@@ -212,8 +214,17 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
         if not messages:
             return 0
         comm._pending = leftover
-        plan = get_plan(comm, messages)
-        plan.run(strategy or choose_strategy(comm, messages))
+        try:
+            plan = get_plan(comm, messages)
+            plan.run(strategy or choose_strategy(comm, messages))
+        except Exception as e:
+            # stash BEFORE the lock is released: the consumed ops will never
+            # turn done, and a waiter that acquires the lock the instant this
+            # frame unwinds must see the root cause, not conclude "peer never
+            # posted". Sticky on purpose — every request lost in this batch
+            # reports the same cause.
+            comm._progress_error = e
+            raise
         for op in consumed:
             op.request.done = True
         return len(messages)
@@ -227,9 +238,11 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     if not req.done:
         err = getattr(req.comm, "_progress_error", None)
         if err is not None:
-            req.comm._progress_error = None
+            # left sticky: sibling requests consumed by the same failed
+            # batch must report this cause too, not a bogus deadlock
             raise RuntimeError(
-                "background progress failed for this exchange") from err
+                "progress engine failed while executing a matched "
+                "exchange") from err
         raise RuntimeError(
             "wait() on a request whose peer operation was never posted "
             "(deadlock in MPI terms)")

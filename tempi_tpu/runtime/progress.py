@@ -30,9 +30,11 @@ class ProgressPump:
 
     def notify(self, comm) -> None:
         """Called at op-post time (the isend/irecv entry, like the
-        reference's try_progress call sites)."""
+        reference's try_progress call sites). Coalesced: a communicator
+        already awaiting the pump is not enqueued again, so a bulk posting
+        loop costs one matching scan, not one per op."""
         try:
-            self._queue.push(comm)
+            self._queue.push_unique(comm)
         except ShutDown:
             pass
 
@@ -47,9 +49,11 @@ class ProgressPump:
                 if not comm.freed and comm._pending:
                     p2p.try_progress(comm)
             except Exception as e:
-                # ops this run consumed will never turn done, so stash the
-                # real failure for the app's next wait() to re-raise
-                comm._progress_error = e
+                # try_progress stashes comm._progress_error under the
+                # progress lock before unwinding; this is only a fallback
+                # for failures outside that window (e.g. the freed check)
+                if getattr(comm, "_progress_error", None) is None:
+                    comm._progress_error = e
                 log.error(f"background progress failed: {e}")
 
     def stop(self) -> bool:

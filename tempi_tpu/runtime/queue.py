@@ -38,6 +38,20 @@ class Queue(Generic[T]):
             self._items.append(item)
             self._cv.notify()
 
+    def push_unique(self, item: T) -> bool:
+        """Push unless ``item`` is already queued (identity comparison).
+        Coalesces bursts of wakeups for the same target; an item mid-pop is
+        NOT considered queued, so a concurrent consumer can never miss a
+        wakeup. Returns True if the item was enqueued."""
+        with self._cv:
+            if self._closed:
+                raise ShutDown("push() after close()")
+            if any(x is item for x in self._items):
+                return False
+            self._items.append(item)
+            self._cv.notify()
+            return True
+
     def pop(self, timeout: Optional[float] = None) -> T:
         """Blocking pop. Raises TimeoutError on timeout, ShutDown when the
         queue is closed and empty."""

@@ -99,6 +99,59 @@ def test_progress_pump_completes_without_wait(world8):
         progress.stop()
 
 
+def test_queue_push_unique_coalesces():
+    q = Queue()
+    a, b = object(), object()
+    assert q.push_unique(a)
+    assert not q.push_unique(a)
+    assert q.push_unique(b)
+    assert len(q) == 2
+    assert q.pop() is a
+    # a is mid-processing (not queued): a new notify must re-enqueue it
+    assert q.push_unique(a)
+
+
+def test_progress_error_stashed_for_waiters(world8, monkeypatch):
+    """A failure while executing a matched exchange must surface its root
+    cause at wait() — for every request in the failed batch — not the
+    generic 'peer never posted' deadlock error."""
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    comm = world8
+    boom = ValueError("injected plan failure")
+
+    def bad_plan(c, messages):
+        raise boom
+
+    monkeypatch.setattr(p2p, "get_plan", bad_plan)
+    ty = dt.contiguous(64, dt.BYTE)
+    buf = comm.alloc(64)
+    r1 = p2p.isend(comm, 0, buf, 1, ty)
+    r2 = p2p.irecv(comm, 1, buf, 0, ty)
+    with pytest.raises(ValueError):
+        p2p.try_progress(comm)
+    for rq in (r1, r2):
+        with pytest.raises(RuntimeError, match="progress engine failed") \
+                as ei:
+            p2p.wait(rq)
+        assert ei.value.__cause__ is boom
+    comm._progress_error = None  # let finalize proceed
+
+
+def test_post_on_freed_comm_rejected_under_lock(world8):
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    comm = world8
+    ty = dt.contiguous(8, dt.BYTE)
+    buf = comm.alloc(8)
+    comm.free()
+    with pytest.raises(RuntimeError, match="freed"):
+        p2p.isend(comm, 0, buf, 1, ty)
+    assert not comm._pending
+
+
 def test_progress_pump_stop_idempotent():
     from tempi_tpu.runtime import progress
 
