@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Random sparse alltoallv with node-level traffic statistics.
+
+Re-design of /root/reference/bin/bench_alltoallv_random_sparse.cpp: a random
+sparse communication matrix driven through alltoallv, reported with the
+reference's Result fields — setup/teardown time, iteration trimean, and the
+node-level traffic profile (max pairwise bytes, max/total on-node bytes,
+max/total off-node bytes — fill_comm_stats, reference :58-99) — with and
+without the dist-graph rank remap. bench_mpi_random_alltoallv.py is the
+method-comparison variant (bin/bench_mpi_random_alltoallv.cpp analog); this
+one profiles a single AUTO run the way the reference binary does.
+"""
+
+import sys
+import time
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+from bench_mpi_random_alltoallv import make_adjacency, make_displs, \
+    make_sparse_counts
+
+
+def node_matrix(comm, counts):
+    """Collapse the rank-level matrix to node level
+    (reference get_node_mat, :39-56)."""
+    import numpy as np
+    nm = np.zeros((comm.num_nodes, comm.num_nodes), dtype=np.int64)
+    for a in range(comm.size):
+        na = comm.node_of_app_rank(a)
+        for b in range(comm.size):
+            if counts[a, b]:
+                nm[na, comm.node_of_app_rank(b)] += int(counts[a, b])
+    return nm
+
+
+def comm_stats(comm, counts):
+    """The reference's fill_comm_stats fields (:58-99)."""
+    nm = node_matrix(comm, counts)
+    on = nm.diagonal()
+    off_by_node = nm.sum(axis=1) - on
+    return dict(
+        max_pairwise=int(counts.max()),
+        max_on_node=int(on.max()),
+        total_on_node=int(on.sum()),
+        max_off_node=int(off_by_node.max()),
+        total_off_node=int(off_by_node.sum()),
+    )
+
+
+def main() -> int:
+    p = base_parser("random sparse alltoallv with node traffic stats")
+    p.add_argument("--density", type=float, default=0.3)
+    p.add_argument("--scale", type=int, default=1 << 16)
+    p.add_argument("--ranks-per-node", type=int, default=2)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+    import os
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils.env import PlacementMethod
+
+    devices_or_die(1)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    counts = make_sparse_counts(size, args.density, args.scale, seed=2)
+    sdispls, rdispls = make_displs(counts)
+    nb_s = max(1, int(counts.sum(1).max()))
+    nb_r = max(1, int(counts.sum(0).max()))
+    sources, dests, sw, dw = make_adjacency(counts)
+
+    rows = []
+    for label, reorder in (("original", False), ("remapped", True)):
+        t0 = time.perf_counter()
+        g = api.dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sw, dweights=dw, reorder=reorder,
+            method=PlacementMethod.KAHIP if reorder else None)
+        sb = g.alloc(nb_s)
+        rb = g.alloc(nb_r)
+        setup = time.perf_counter() - t0
+
+        def run():
+            api.alltoallv(g, sb, counts, sdispls, rb, counts.T, rdispls)
+            rb.data.block_until_ready()
+
+        run()  # compile
+        res = benchmark(run, **kw)
+        st = comm_stats(g, counts)
+        t0 = time.perf_counter()
+        g.free()
+        teardown = time.perf_counter() - t0
+        rows.append((label, res.trimean, setup, teardown,
+                     st["max_pairwise"], st["max_on_node"],
+                     st["total_on_node"], st["max_off_node"],
+                     st["total_off_node"]))
+    emit_csv(("placement", "time_s", "setup_s", "teardown_s", "max_pairwise_B",
+              "max_on_node_B", "total_on_node_B", "max_off_node_B",
+              "total_off_node_B"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

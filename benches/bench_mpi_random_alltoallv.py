@@ -22,6 +22,31 @@ def make_sparse_counts(size, density, scale, seed):
     return counts
 
 
+def make_displs(counts):
+    """Per-rank send/recv displacements for a counts matrix (rows = senders,
+    columns = receivers)."""
+    import numpy as np
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    for r in range(counts.shape[0]):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(counts.T[r])[:-1]])
+    return sdispls, rdispls
+
+
+def make_adjacency(counts):
+    """Traffic-weighted dist-graph adjacency (sources, dests, sweights,
+    dweights) from a counts matrix."""
+    import numpy as np
+    size = counts.shape[0]
+    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+               for r in range(size)]
+    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
+    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+    return sources, dests, sw, dw
+
+
 def offnode_bytes(comm, counts):
     """Traffic crossing a node boundary under the communicator's placement
     (reference: bench_alltoallv_random_sparse.cpp:41-80 node stats)."""
@@ -55,22 +80,12 @@ def main() -> int:
     size = comm.size
     kw = bench_kwargs(args.quick)
     counts = make_sparse_counts(size, args.density, args.scale, seed=1)
-    sdispls = np.zeros_like(counts)
-    rdispls = np.zeros_like(counts)
-    for r in range(size):
-        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
-        rdispls[r] = np.concatenate([[0], np.cumsum(counts.T[r])[:-1]])
+    sdispls, rdispls = make_displs(counts)
     nb_s = int(counts.sum(1).max())
     nb_r = int(counts.sum(0).max())
-    sbuf = comm.alloc(max(nb_s, 1))
-    rbuf = comm.alloc(max(nb_r, 1))
 
     # graph remap: neighbors weighted by traffic (config 4's dist_graph step)
-    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
-               for r in range(size)]
-    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
-    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
-    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+    sources, dests, sw, dw = make_adjacency(counts)
     from tempi_tpu.utils.env import PlacementMethod
     gcomm = api.dist_graph_create_adjacent(
         comm, sources, dests, sweights=sw, dweights=dw, reorder=True,

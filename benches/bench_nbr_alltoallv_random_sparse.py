@@ -11,7 +11,8 @@ import sys
 
 from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
     setup_platform
-from bench_mpi_random_alltoallv import make_sparse_counts, offnode_bytes
+from bench_mpi_random_alltoallv import make_adjacency, make_sparse_counts, \
+    offnode_bytes
 
 
 def main() -> int:
@@ -36,11 +37,7 @@ def main() -> int:
     kw = bench_kwargs(args.quick)
     counts = make_sparse_counts(size, args.density, args.scale, seed=3)
 
-    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
-               for r in range(size)]
-    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
-    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
-    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+    sources, dests, sw, dw = make_adjacency(counts)
 
     rows = []
     for label, reorder in (("original", False), ("remapped", True)):

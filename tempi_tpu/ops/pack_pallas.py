@@ -189,47 +189,65 @@ def _outer_offsets(p: dict):
             for o in range(n_o) for k in range(n_k)]
 
 
-@functools.lru_cache(maxsize=2048)
-def _build_pack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
-                    strides: Tuple[int, ...], extent: int, incount: int):
-    """Grid-free kernel: one strided HBM->HBM DMA per outer combo."""
+def _dma_call(p: dict, unpack: bool):
+    """Shared scaffolding of the grid-free DMA kernels: one strided
+    ``make_async_copy`` per outer combo (all offsets Python ints, started
+    together so they overlap on the DMA engines), then wait on all. ``unpack``
+    flips the direction — packed matrix into the strided columns of an output
+    that aliases the destination operand — everything else is identical."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    p = _plan(nbytes, start, counts, strides, extent, incount)
-    assert p is not None and p["n_dmas"] <= _MAX_DMAS
-    bl, rowstride = p["bl"], p["rowstride"]
-    nblocks = p["nblocks"]
+    bl, nblocks = p["bl"], p["nblocks"]
     combos = _outer_offsets(p)
     n = len(combos)
     single = n == 1
+    pk_shape = ((nblocks, bl) if single else
+                tuple(x for x, _ in p["outer_rows"]) + (nblocks, bl))
 
-    def kern(h_ref, o_ref, sems):
-        def copy(i):
-            idx, r0 = combos[i]
-            dst = o_ref if single else o_ref.at[idx]
-            return pltpu.make_async_copy(
-                h_ref.at[pl.ds(r0, nblocks), pl.ds(0, bl)],
-                dst, sems if single else sems.at[i])
-        for i in range(n):
-            copy(i).start()
-        for i in range(n):
-            copy(i).wait()
+    def copies(pk_ref, view_ref, sems):
+        for i, (idx, r0) in enumerate(combos):
+            pk_at = pk_ref if single else pk_ref.at[idx]
+            view_at = view_ref.at[pl.ds(r0, nblocks), pl.ds(0, bl)]
+            src, dst = (pk_at, view_at) if unpack else (view_at, pk_at)
+            yield pltpu.make_async_copy(src, dst,
+                                        sems if single else sems.at[i])
 
-    out_shape = ((nblocks, bl) if single else
-                 tuple(x for x, _ in p["outer_rows"]) + (nblocks, bl))
+    def kern(*refs):
+        if unpack:
+            pk_ref, _dst_in, view_ref, sems = refs  # out aliases _dst_in
+        else:
+            view_ref, pk_ref, sems = refs
+        for cp in copies(pk_ref, view_ref, sems):
+            cp.start()
+        for cp in copies(pk_ref, view_ref, sems):
+            cp.wait()
+
+    anyspec = pl.BlockSpec(memory_space=pl.ANY)
+    out_shape = (p["nrows"], p["rowstride"]) if unpack else pk_shape
     call = pl.pallas_call(
         kern,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        in_specs=[anyspec, anyspec] if unpack else [anyspec],
+        out_specs=anyspec,
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint8),
+        input_output_aliases={1: 0} if unpack else {},
         scratch_shapes=[pltpu.SemaphoreType.DMA if single
                         else pltpu.SemaphoreType.DMA((n,))],
         interpret=_interpret(),
     )
+    return call, pk_shape
+
+
+@functools.lru_cache(maxsize=2048)
+def _build_pack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
+                    strides: Tuple[int, ...], extent: int, incount: int):
+    """Grid-free kernel: one strided HBM->HBM DMA per outer combo."""
+    p = _plan(nbytes, start, counts, strides, extent, incount)
+    assert p is not None and p["n_dmas"] <= _MAX_DMAS
+    call, _ = _dma_call(p, unpack=False)
 
     def fn(u8):
-        view = u8.reshape(p["nrows"], rowstride)
+        view = u8.reshape(p["nrows"], p["rowstride"])
         return call(view).reshape(-1)
 
     return jax.jit(fn)
@@ -351,48 +369,13 @@ def _build_unpack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
     """In-place kernel: destination aliases the output, packed columns are
     DMAed over it, gap bytes are never touched. The caller's ``dst`` operand
     is consumed (XLA inserts a defensive copy when it is still live)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     p = _plan(nbytes, start, counts, strides, extent, incount)
     assert p is not None and p["n_dmas"] <= _MAX_DMAS
-    bl, rowstride = p["bl"], p["rowstride"]
-    nblocks = p["nblocks"]
-    combos = _outer_offsets(p)
-    n = len(combos)
-    single = n == 1
-
-    def kern(pk_ref, dst_in, dst_out, sems):
-        # dst_out aliases dst_in (input_output_aliases below)
-        del dst_in
-        def copy(i):
-            idx, r0 = combos[i]
-            src = pk_ref if single else pk_ref.at[idx]
-            return pltpu.make_async_copy(
-                src, dst_out.at[pl.ds(r0, nblocks), pl.ds(0, bl)],
-                sems if single else sems.at[i])
-        for i in range(n):
-            copy(i).start()
-        for i in range(n):
-            copy(i).wait()
-
-    pk_shape = ((nblocks, bl) if single else
-                tuple(x for x, _ in p["outer_rows"]) + (nblocks, bl))
-    call = pl.pallas_call(
-        kern,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        out_shape=jax.ShapeDtypeStruct((p["nrows"], rowstride), jnp.uint8),
-        input_output_aliases={1: 0},
-        scratch_shapes=[pltpu.SemaphoreType.DMA if single
-                        else pltpu.SemaphoreType.DMA((n,))],
-        interpret=_interpret(),
-    )
+    call, pk_shape = _dma_call(p, unpack=True)
 
     def fn(u8, packed):
         return call(packed.reshape(pk_shape),
-                    u8.reshape(p["nrows"], rowstride)).reshape(-1)
+                    u8.reshape(p["nrows"], p["rowstride"])).reshape(-1)
 
     return jax.jit(fn)
 

@@ -47,6 +47,9 @@ class Request:
     comm: Communicator
     buf: Optional[DistBuffer] = None
     done: bool = False
+    # set when the progress engine failed while executing the batch this
+    # request was matched into; wait() re-raises it as the root cause
+    error: Optional[BaseException] = None
 
     def wait(self) -> None:
         wait(self)
@@ -218,12 +221,13 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
             plan = get_plan(comm, messages)
             plan.run(strategy or choose_strategy(comm, messages))
         except Exception as e:
-            # stash BEFORE the lock is released: the consumed ops will never
+            # attach BEFORE the lock is released: the consumed ops will never
             # turn done, and a waiter that acquires the lock the instant this
             # frame unwinds must see the root cause, not conclude "peer never
-            # posted". Sticky on purpose — every request lost in this batch
-            # reports the same cause.
-            comm._progress_error = e
+            # posted". Scoped to the failed batch's requests so an unrelated
+            # later deadlock still gets the deadlock diagnosis.
+            for op in consumed:
+                op.request.error = e
             raise
         for op in consumed:
             op.request.done = True
@@ -236,13 +240,10 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     if not req.done:
         try_progress(req.comm, strategy)
     if not req.done:
-        err = getattr(req.comm, "_progress_error", None)
-        if err is not None:
-            # left sticky: sibling requests consumed by the same failed
-            # batch must report this cause too, not a bogus deadlock
+        if req.error is not None:
             raise RuntimeError(
-                "progress engine failed while executing a matched "
-                "exchange") from err
+                "progress engine failed while executing the exchange this "
+                "request was matched into") from req.error
         raise RuntimeError(
             "wait() on a request whose peer operation was never posted "
             "(deadlock in MPI terms)")

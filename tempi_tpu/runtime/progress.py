@@ -49,11 +49,11 @@ class ProgressPump:
                 if not comm.freed and comm._pending:
                     p2p.try_progress(comm)
             except Exception as e:
-                # try_progress stashes comm._progress_error under the
-                # progress lock before unwinding; this is only a fallback
-                # for failures outside that window (e.g. the freed check)
-                if getattr(comm, "_progress_error", None) is None:
-                    comm._progress_error = e
+                # try_progress attaches the error to every request in the
+                # failed batch (under the progress lock, before unwinding)
+                # for wait() to re-raise; failures outside that window (e.g.
+                # the freed check) consume no ops, so a waiter's own
+                # try_progress call reproduces them directly
                 log.error(f"background progress failed: {e}")
 
     def stop(self) -> bool:

@@ -84,6 +84,55 @@ def test_3d_collapses_to_2d():
     run_both(*args)
 
 
+def test_dma_only_geometry_odd_row_spacing():
+    # object extent of 9 rows: no pipeline tile divides the outer offset
+    # (gcd(512, 9) = 1 < 8 sublanes) so only the direct-DMA kernel can run
+    args = ((3 * 9 + 1) * 256, 0, (128, 4), (1, 256), 9 * 256, 3)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["tile"] is None and p["n_dmas"] == 3
+    run_both(*args)
+
+
+def test_many_objects_use_pipeline_kernel():
+    # 100 outer DMAs exceed _MAX_DMAS: plan must keep a pipeline tile
+    args = (100 * 16 * 256, 0, (128, 4), (1, 256), 16 * 256, 100)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["n_dmas"] == 100 and p["tile"] is not None
+    run_both(*args)
+
+
+def test_unpack_traced_aliased_path():
+    """Inside jit the unpack takes the aliased in-place DMA kernel; output
+    must still byte-match the XLA oracle (gap bytes preserved)."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes, start, counts, strides, extent, incount = \
+        256 * 512, 256 * 4, (128, 64), (1, 256), 128 * 256, 2
+    dst = rand(nbytes, 3)
+    packed = rand(128 * 64 * 2, 4)
+    want = np.asarray(pack_xla.unpack(jnp.asarray(dst), jnp.asarray(packed),
+                                      start, counts, strides, extent,
+                                      incount))
+    traced = jax.jit(lambda d, p: pack_pallas.unpack(
+        d, p, start, counts, strides, extent, incount))
+    got = np.asarray(traced(jnp.asarray(dst), jnp.asarray(packed)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unpack_eager_does_not_consume_dst():
+    """MPI_Unpack does not invalidate its destination: the eager path must
+    leave the caller's array readable (no donation)."""
+    import jax.numpy as jnp
+
+    nbytes = 256 * 512
+    dst_host = rand(nbytes, 5)
+    dst = jnp.asarray(dst_host)
+    packed = jnp.asarray(rand(128 * 256, 6))
+    pack_pallas.unpack(dst, packed, 0, (128, 256), (1, 256), 256 * 256, 1)
+    np.testing.assert_array_equal(np.asarray(dst), dst_host)
+
+
 def test_unaligned_start_falls_back():
     # start not a multiple of the row stride -> plan is None -> pack_xla
     args = (256 * 300, 13, (128, 64), (1, 256), 64 * 256, 1)

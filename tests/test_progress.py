@@ -136,7 +136,13 @@ def test_progress_error_stashed_for_waiters(world8, monkeypatch):
                 as ei:
             p2p.wait(rq)
         assert ei.value.__cause__ is boom
-    comm._progress_error = None  # let finalize proceed
+    # the error is scoped to the failed batch: a fresh unmatched request
+    # must still get the deadlock diagnosis, not the stale cause
+    r3 = p2p.isend(comm, 2, buf, 3, ty)
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="never posted"):
+        p2p.wait(r3)
+    comm._pending.clear()  # drop the deliberately unmatched op
 
 
 def test_post_on_freed_comm_rejected_under_lock(world8):
