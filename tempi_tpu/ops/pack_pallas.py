@@ -13,13 +13,14 @@ Two kernel strategies, fastest first:
    issues one strided ``make_async_copy`` per outer object/plane (all offsets
    are Python ints, so the unrolled starts overlap on the DMA engines) and
    waits on all of them. No VMEM bounce, no pipeline bookkeeping. Measured on
-   a v5e chip at the bench-mpi-pack headline shape (8192x512B blocks at
-   1024B stride): ~470 GB/s packed-bytes vs ~1030 GB/s read+write dense-copy
-   ceiling — i.e. ~91% of the chip's theoretical pack rate.
+   a v5e-class chip at the bench-mpi-pack headline shape (8192x512B blocks at
+   1024B stride), with 8 packs batched per dispatch so per-dispatch gaps
+   don't pollute the number (bench.py's discipline): ~680-760 GB/s
+   packed-bytes; ~470 GB/s when timed one dispatch at a time.
 2. **Pipelined VMEM kernel** (``_build_pack``): each grid step DMAs one
    (TILE, blocklength) sub-block HBM->VMEM->HBM through the Pallas pipeline
-   (~390 GB/s on the same shape). Used when the outer level count is too
-   large to unroll as direct DMAs.
+   (~400 GB/s at dispatch depth 8 on the same shape). Used when the outer
+   level count is too large to unroll as direct DMAs.
 
 Both beat the generic XLA slice/reshape chain (~310 GB/s fused; ~39 GB/s for
 the general slice/pad path the XLA backend uses for arbitrary geometry).
@@ -133,6 +134,16 @@ def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
     if last >= nrows:
         return None
     n_dmas = math.prod(n for n, _ in outer_rows)
+    # Direct-DMA eligibility, measured against Mosaic on v5e: an ANY-memory
+    # (rows, cols) DMA slice compiles only with the row offset a multiple of
+    # 8 sublanes and the column width a multiple of 128 lanes (column offset
+    # is always 0 here; a full-width non-128-multiple slice ALSO fails, so
+    # there is no bl == rowstride exemption on this path — that exemption is
+    # for pipeline BlockSpec blocks). Every combo offset is start_row plus
+    # multiples of the contributing outer strides, so checking those
+    # suffices.
+    dma = (n_dmas <= _MAX_DMAS and bl % 128 == 0 and start_row % 8 == 0
+           and all(s % 8 == 0 for n, s in outer_rows if n > 1))
     # Pipeline tile: must divide every outer row-offset so index_map stays in
     # block units; counts[1] itself may be ragged (edge blocks are clipped).
     # Levels with a single index never contribute an offset. Scale the
@@ -149,27 +160,43 @@ def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
         tile = gcd(tile, start_row) if start_row else tile
         if tile < 8 or tile % 8:  # Mosaic sublane divisibility
             tile = None
-    if tile is None and n_dmas > _MAX_DMAS:
-        return None
+    # the plan stays valid even when no PACK kernel fits (tile None, dma
+    # False): the geometry still powers the Mosaic-free fused unpack splice
     return dict(bl=bl, rowstride=rowstride, nrows=nrows, start_row=start_row,
                 outer_rows=outer_rows, nblocks=counts[1], tile=tile,
-                n_dmas=n_dmas)
+                n_dmas=n_dmas, dma=dma)
+
+
+def _sized_plan(sb: StridedBlock, nbytes: Optional[int],
+                incount: int) -> Optional[dict]:
+    if sb.ndims not in (2, 3):
+        return None
+    if sb.counts[0] < _MIN_BLOCKLEN:
+        return None
+    if sb.packed_size * incount < _MIN_PACKED:
+        return None
+    nb = nbytes if nbytes is not None else sb.start + incount * sb.extent
+    return _plan(nb, sb.start, tuple(sb.counts), tuple(sb.strides),
+                 sb.extent, incount)
 
 
 def supports(sb: StridedBlock, nbytes: Optional[int] = None,
              incount: int = 1) -> bool:
-    """Cheap static check used by PackerND backend selection. When ``nbytes``
-    is unknown the buffer-length condition is assumed to hold for a
-    tight buffer (incount * extent bytes)."""
-    if sb.ndims not in (2, 3):
-        return False
-    if sb.counts[0] < _MIN_BLOCKLEN:
-        return False
-    if sb.packed_size * incount < _MIN_PACKED:
-        return False
-    nb = nbytes if nbytes is not None else sb.start + incount * sb.extent
-    return _plan(nb, sb.start, tuple(sb.counts), tuple(sb.strides),
-                 sb.extent, incount) is not None
+    """Cheap static check used by PackerND backend selection: is a Pallas
+    PACK kernel available? When ``nbytes`` is unknown the buffer-length
+    condition is assumed to hold for a tight buffer (incount * extent
+    bytes)."""
+    p = _sized_plan(sb, nbytes, incount)
+    return p is not None and (p["dma"] or p["tile"] is not None)
+
+
+def supports_unpack(sb: StridedBlock, nbytes: Optional[int] = None,
+                    incount: int = 1) -> bool:
+    """Is this module's unpack faster than the generic XLA path? True for
+    any valid strided-view geometry: the fused splice has no Mosaic
+    constraints, only an unroll budget."""
+    p = _sized_plan(sb, nbytes, incount)
+    return p is not None and p["n_dmas"] <= _MAX_UNPACK_UPDATES
 
 
 def _interpret() -> bool:
@@ -243,7 +270,7 @@ def _build_pack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
                     strides: Tuple[int, ...], extent: int, incount: int):
     """Grid-free kernel: one strided HBM->HBM DMA per outer combo."""
     p = _plan(nbytes, start, counts, strides, extent, incount)
-    assert p is not None and p["n_dmas"] <= _MAX_DMAS
+    assert p is not None and p["dma"]
     call, _ = _dma_call(p, unpack=False)
 
     def fn(u8):
@@ -338,6 +365,12 @@ def _build_pack(nbytes: int, start: int, counts: Tuple[int, ...],
     return jax.jit(fn)
 
 
+# Geometries whose kernel failed to build/compile (e.g. a Mosaic constraint
+# this module's model doesn't know about): consulted before every attempt so
+# a failing compile is paid once, not per message.
+_failed_args: set = set()
+
+
 def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
          strides: Sequence[int], extent: int, incount: int) -> jax.Array:
     """Pack ``incount`` strided objects into a dense uint8 vector.
@@ -348,13 +381,18 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
     args = (src_u8.shape[0], int(start), tuple(map(int, counts)),
             tuple(map(int, strides)), int(extent), int(incount))
     p = _plan(*args)
-    if p is not None:
+    if (p is not None and (p["dma"] or p["tile"] is not None)
+            and args not in _failed_args):
         try:
-            if p["n_dmas"] <= _MAX_DMAS:
+            if p["dma"]:
                 return _build_pack_dma(*args)(src_u8)
             return _build_pack(*args)(src_u8)
         except ImportError:  # pallas unimportable (tpu factory dropped)
             log.warn("pallas unavailable; packing via XLA")
+        except Exception as e:  # Mosaic constraints shift across libtpu
+            _failed_args.add(args)
+            log.warn(f"pallas pack failed for {args}; using XLA from now "
+                     f"on for this geometry: {e}")
     # geometry of THIS buffer unsupported
     from . import pack_xla
     return pack_xla.pack(src_u8, start, counts, strides, extent, incount)
@@ -370,7 +408,7 @@ def _build_unpack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
     DMAed over it, gap bytes are never touched. The caller's ``dst`` operand
     is consumed (XLA inserts a defensive copy when it is still live)."""
     p = _plan(nbytes, start, counts, strides, extent, incount)
-    assert p is not None and p["n_dmas"] <= _MAX_DMAS
+    assert p is not None and p["dma"]
     call, pk_shape = _dma_call(p, unpack=True)
 
     def fn(u8, packed):
@@ -436,16 +474,21 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
     args = (dst_u8.shape[0], int(start), tuple(map(int, counts)),
             tuple(map(int, strides)), int(extent), int(incount))
     p = _plan(*args)
-    if p is not None and p["n_dmas"] <= _MAX_DMAS and _is_tracer(dst_u8):
+    if (p is not None and p["dma"] and _is_tracer(dst_u8)
+            and args not in _failed_args):
         # inside a traced program XLA's copy-insertion keeps the in-place
         # aliasing sound; eagerly it would consume the caller's array
         try:
             return _build_unpack_dma(*args)(dst_u8, packed_u8)
         except ImportError:
             pass
-    n_updates = (0 if p is None else p["n_dmas"])
-    if p is None or n_updates > _MAX_UNPACK_UPDATES:
+        except Exception as e:
+            _failed_args.add(args)
+            log.warn(f"pallas unpack failed for {args}; using the XLA "
+                     f"splice from now on for this geometry: {e}")
+    if p is None or p["n_dmas"] > _MAX_UNPACK_UPDATES:
         from . import pack_xla
         return pack_xla.unpack(dst_u8, packed_u8, start, counts, strides,
                                extent, incount)
+    # fused strided-view splice: Mosaic-free, valid for any plan geometry
     return _build_unpack(*args)(dst_u8, packed_u8)

@@ -103,11 +103,15 @@ class PackerND(Packer):
         return (ctr.counters.pack2d if self.sb.ndims == 2
                 else ctr.counters.pack3d)
 
-    def _backend(self, nbytes: int, incount: int):
+    def _backend(self, nbytes: int, incount: int, unpack: bool = False):
         kernel = envmod.env.pack_kernel
         if kernel in (PackKernel.PALLAS, PackKernel.AUTO):
             from . import pack_pallas
-            if pack_pallas.supports(self.sb, nbytes, incount):
+            # unpack has a Mosaic-free fused path, so its support set is
+            # wider than the pack kernels'
+            sup = (pack_pallas.supports_unpack if unpack
+                   else pack_pallas.supports)
+            if sup(self.sb, nbytes, incount):
                 return pack_pallas
             if kernel is PackKernel.PALLAS:
                 log.warn(f"TEMPI_PACK_KERNEL=pallas but {self.sb} "
@@ -126,7 +130,7 @@ class PackerND(Packer):
         if not _is_tracing(dst_u8):
             self._group.num_unpacks += 1
             self._group.bytes_unpacked += outcount * self.packed_size
-        b = self._backend(dst_u8.shape[0], outcount)
+        b = self._backend(dst_u8.shape[0], outcount, unpack=True)
         return b.unpack(dst_u8, packed_u8, self.sb.start,
                         tuple(self.sb.counts), tuple(self.sb.strides),
                         self.sb.extent, outcount)

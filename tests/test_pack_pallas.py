@@ -84,13 +84,37 @@ def test_3d_collapses_to_2d():
     run_both(*args)
 
 
-def test_dma_only_geometry_odd_row_spacing():
-    # object extent of 9 rows: no pipeline tile divides the outer offset
-    # (gcd(512, 9) = 1 < 8 sublanes) so only the direct-DMA kernel can run
+def test_dma_only_geometry_fat_rows():
+    # 384 KiB blocks: even an 8-row tile would blow the VMEM block budget,
+    # so only the direct-DMA kernel (no VMEM bounce) can run
+    bl, rowstride = 384 * 1024, 512 * 1024
+    args = (16 * rowstride, 0, (bl, 16), (1, rowstride), 16 * rowstride, 1)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["tile"] is None and p["dma"]
+    run_both(*args)
+
+
+def test_odd_row_spacing_no_pack_kernel_keeps_unpack_splice():
+    # object extent of 9 rows: the pipeline can't tile it (gcd < 8 sublanes)
+    # and Mosaic rejects DMA row offsets not divisible by 8 — no PACK kernel,
+    # pack() falls back to XLA rather than crash on TPU. The plan itself
+    # stays valid so unpack keeps the Mosaic-free fused splice.
     args = ((3 * 9 + 1) * 256, 0, (128, 4), (1, 256), 9 * 256, 3)
     p = pack_pallas._plan(*args)
-    assert p is not None and p["tile"] is None and p["n_dmas"] == 3
+    assert p is not None and not p["dma"] and p["tile"] is None
     run_both(*args)
+
+
+def test_supports_split_pack_vs_unpack():
+    from tempi_tpu.ops.strided_block import StridedBlock
+
+    sb = StridedBlock(start=0, extent=9 * 256)
+    sb.add_dim(0, 128, 1)
+    sb.add_dim(0, 4, 256)
+    # no pack kernel for 9-row spacing, but the unpack splice applies
+    # (incount 50 keeps the packed size above the _MIN_PACKED threshold)
+    assert not pack_pallas.supports(sb, (50 * 9 + 1) * 256, 50)
+    assert pack_pallas.supports_unpack(sb, (50 * 9 + 1) * 256, 50)
 
 
 def test_many_objects_use_pipeline_kernel():
