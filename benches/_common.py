@@ -67,10 +67,15 @@ def devices_or_die(min_devices: int = 1):
     return devs
 
 
-def bench_kwargs(quick: bool) -> dict:
+def bench_kwargs(quick: bool, throughput: bool = False) -> dict:
+    """``throughput`` sizes samples for the enqueue-then-flush pattern on a
+    tunneled TPU: the flush round trip (~100 us) must amortize over many
+    launches per sample (see bench.py)."""
     if quick:
         return dict(min_sample_secs=50e-6, max_trial_secs=0.1,
                     max_samples=20, max_trials=2)
+    if throughput:
+        return dict(min_sample_secs=2e-3, max_trial_secs=3.0)
     return {}
 
 

@@ -47,8 +47,12 @@ def main() -> int:
             geom = (0, (bl, nb), (1, args.stride), extent, 1)
             backends = [("xla", pack_xla), ("pallas", pack_pallas)]
             for name, mod in backends:
-                if name == "pallas" and pack_pallas._plan(nbytes,
-                                                          *geom) is None:
+                # a valid plan no longer implies a pack kernel (the plan
+                # also powers the unpack splice) — gate on kernel presence
+                # so a "pallas" row never silently measures the XLA fallback
+                p = pack_pallas._plan(nbytes, *geom)
+                if name == "pallas" and (
+                        p is None or not (p["dma"] or p["tile"] is not None)):
                     continue
                 last = []
 

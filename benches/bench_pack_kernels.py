@@ -38,8 +38,11 @@ def main() -> int:
                 rng.integers(0, 256, nbytes, np.uint8)))
             geom = (0, (bl, nb), (1, GRID_STRIDE), nbytes, 1)
             mods = [("xla", pack_xla)]
-            if pack_pallas._plan(nbytes, geom[0], geom[1], geom[2], geom[3],
-                                 geom[4]) is not None:
+            # gate on kernel presence, not plan validity: a valid plan with
+            # dma=False/tile=None only powers the unpack splice
+            p = pack_pallas._plan(nbytes, geom[0], geom[1], geom[2], geom[3],
+                                  geom[4])
+            if p is not None and (p["dma"] or p["tile"] is not None):
                 mods.append(("pallas", pack_pallas))
             for name, mod in mods:
                 last = []

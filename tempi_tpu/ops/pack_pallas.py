@@ -367,8 +367,12 @@ def _build_pack(nbytes: int, start: int, counts: Tuple[int, ...],
 
 # Geometries whose kernel failed to build/compile (e.g. a Mosaic constraint
 # this module's model doesn't know about): consulted before every attempt so
-# a failing compile is paid once, not per message.
-_failed_args: set = set()
+# a failing compile is paid once, not per message. This safety net only
+# covers EAGER calls — on traced paths the kernel jaxpr is inlined and
+# Mosaic lowering happens at the outer jit's compile, outside any try here;
+# _plan's measured eligibility flags are the primary defense there.
+_failed_dma: set = set()    # direct-DMA kernel failed; pipeline may still work
+_failed_args: set = set()   # no pallas pack kernel works for this geometry
 
 
 def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
@@ -384,9 +388,20 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
     if (p is not None and (p["dma"] or p["tile"] is not None)
             and args not in _failed_args):
         try:
-            if p["dma"]:
-                return _build_pack_dma(*args)(src_u8)
-            return _build_pack(*args)(src_u8)
+            if p["dma"] and args not in _failed_dma:
+                try:
+                    return _build_pack_dma(*args)(src_u8)
+                except ImportError:
+                    raise
+                except Exception as e:
+                    _failed_dma.add(args)
+                    if p["tile"] is None:
+                        raise
+                    log.warn(f"direct-DMA pack failed for {args}; trying "
+                             f"the pipeline kernel: {e}")
+            if p["tile"] is not None:
+                return _build_pack(*args)(src_u8)
+            raise RuntimeError("no eligible pallas kernel")
         except ImportError:  # pallas unimportable (tpu factory dropped)
             log.warn("pallas unavailable; packing via XLA")
         except Exception as e:  # Mosaic constraints shift across libtpu
