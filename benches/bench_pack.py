@@ -47,12 +47,11 @@ def main() -> int:
             geom = (0, (bl, nb), (1, args.stride), extent, 1)
             backends = [("xla", pack_xla), ("pallas", pack_pallas)]
             for name, mod in backends:
-                # a valid plan no longer implies a pack kernel (the plan
-                # also powers the unpack splice) — gate on kernel presence
-                # so a "pallas" row never silently measures the XLA fallback
-                p = pack_pallas._plan(nbytes, *geom)
-                if name == "pallas" and (
-                        p is None or not (p["dma"] or p["tile"] is not None)):
+                # gate on kernel presence so a "pallas" row never silently
+                # measures the XLA fallback (a valid plan may only power
+                # the unpack splice)
+                if name == "pallas" and not pack_pallas.has_pack_kernel(
+                        pack_pallas._plan(nbytes, *geom)):
                     continue
                 last = []
 

@@ -40,9 +40,8 @@ def main() -> int:
             mods = [("xla", pack_xla)]
             # gate on kernel presence, not plan validity: a valid plan with
             # dma=False/tile=None only powers the unpack splice
-            p = pack_pallas._plan(nbytes, geom[0], geom[1], geom[2], geom[3],
-                                  geom[4])
-            if p is not None and (p["dma"] or p["tile"] is not None):
+            if pack_pallas.has_pack_kernel(pack_pallas._plan(
+                    nbytes, geom[0], geom[1], geom[2], geom[3], geom[4])):
                 mods.append(("pallas", pack_pallas))
             for name, mod in mods:
                 last = []

@@ -42,6 +42,13 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
 
     if sp is None:
         sp = msys.load_cached() or SystemPerformance()
+    plat = msys.current_platform()
+    if sp.platform and sp.platform != plat:
+        # curves from another system must not be "completed" with this
+        # one's — start a fresh sheet (load_cached also refuses these)
+        log.warn(f"discarding {sp.platform!r} curves; measuring {plat!r}")
+        sp = SystemPerformance()
+    sp.platform = plat
     if device is None:
         device = jax.devices()[0]
     kw = _bench_kwargs(quick)

@@ -45,8 +45,24 @@ GRID_BLOCKLEN = [1 << j for j in range(9)]             # 1 .. 256 B
 GRID_STRIDE = 512
 
 
+def current_platform() -> str:
+    """Identity of the system the curves describe. The reference scopes
+    perf.json per machine via TEMPI_CACHE_DIR (env.cpp:87-106); here one
+    machine exposes both a CPU mesh and the accelerator, so the cache must
+    carry which one it measured — TPU curves steering the CPU mesh (or vice
+    versa) picks pathological strategies."""
+    import jax
+    backend = jax.default_backend()
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return f"{backend}/{kind}"
+
+
 @dataclass
 class SystemPerformance:
+    platform: str = ""
     device_launch: float = 0.0
     d2h: List[Tuple[int, float]] = field(default_factory=list)
     h2d: List[Tuple[int, float]] = field(default_factory=list)
@@ -60,6 +76,7 @@ class SystemPerformance:
 
     def to_json(self) -> dict:
         return {
+            "platform": self.platform,
             "device_launch": self.device_launch,
             **{k: [[int(b), t] for b, t in getattr(self, k)]
                for k in ("d2h", "h2d", "intra_node_pingpong",
@@ -75,6 +92,7 @@ class SystemPerformance:
     @staticmethod
     def from_json(d: dict) -> "SystemPerformance":
         sp = SystemPerformance()
+        sp.platform = str(d.get("platform", ""))
         sp.device_launch = float(d.get("device_launch", 0.0))
         for k in ("d2h", "h2d", "intra_node_pingpong", "inter_node_pingpong",
                   "host_pingpong"):
@@ -121,6 +139,11 @@ def load_cached() -> Optional[SystemPerformance]:
     try:
         with open(path) as f:
             sp = SystemPerformance.from_json(json.load(f))
+        plat = current_platform()
+        if sp.platform != plat:  # unstamped caches are refused too
+            log.debug(f"ignoring {path}: measured on {sp.platform!r}, "
+                      f"running on {plat!r}")
+            return None
         set_system(sp)
         log.debug(f"loaded system performance cache from {path}")
         return sp

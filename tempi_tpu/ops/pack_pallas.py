@@ -180,14 +180,19 @@ def _sized_plan(sb: StridedBlock, nbytes: Optional[int],
                  sb.extent, incount)
 
 
+def has_pack_kernel(p: Optional[dict]) -> bool:
+    """Does a plan come with an actual Pallas PACK kernel? (A valid plan
+    with neither dma nor tile only powers the unpack splice.)"""
+    return p is not None and (p["dma"] or p["tile"] is not None)
+
+
 def supports(sb: StridedBlock, nbytes: Optional[int] = None,
              incount: int = 1) -> bool:
     """Cheap static check used by PackerND backend selection: is a Pallas
     PACK kernel available? When ``nbytes`` is unknown the buffer-length
     condition is assumed to hold for a tight buffer (incount * extent
     bytes)."""
-    p = _sized_plan(sb, nbytes, incount)
-    return p is not None and (p["dma"] or p["tile"] is not None)
+    return has_pack_kernel(_sized_plan(sb, nbytes, incount))
 
 
 def supports_unpack(sb: StridedBlock, nbytes: Optional[int] = None,
@@ -385,8 +390,7 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
     args = (src_u8.shape[0], int(start), tuple(map(int, counts)),
             tuple(map(int, strides)), int(extent), int(incount))
     p = _plan(*args)
-    if (p is not None and (p["dma"] or p["tile"] is not None)
-            and args not in _failed_args):
+    if has_pack_kernel(p) and args not in _failed_args:
         try:
             if p["dma"] and args not in _failed_dma:
                 try:

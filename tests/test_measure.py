@@ -86,6 +86,7 @@ def test_perf_json_roundtrip(tmp_path, monkeypatch):
     from tempi_tpu.utils import env as envmod
     monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
     sp = SystemPerformance()
+    sp.platform = msys.current_platform()
     sp.device_launch = 1e-5
     sp.d2h = [(1, 1e-6), (1024, 2e-6)]
     sp.pack_device = [[1e-6, 2e-6], [3e-6, 4e-6]]
@@ -96,6 +97,26 @@ def test_perf_json_roundtrip(tmp_path, monkeypatch):
     assert loaded.d2h == sp.d2h
     assert loaded.pack_device == sp.pack_device
     assert loaded.device_launch == sp.device_launch
+
+
+def test_cache_from_other_platform_refused(tmp_path, monkeypatch):
+    """TPU-measured curves must not steer the CPU mesh (and vice versa):
+    AUTO picking a host-staged strategy from the wrong system's timings is
+    exactly the pathology the model exists to avoid."""
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = SystemPerformance()
+    sp.platform = "tpu/TPU v5 lite"
+    sp.d2h = [(1, 1e-6)]
+    msys.save(sp)
+    assert msys.load_cached() is None  # tests run on the CPU mesh
+
+    # a sweep over the stale cache starts a fresh sheet for this platform
+    from tempi_tpu.measure import sweep
+    out = sweep.measure_all(SystemPerformance.from_json(sp.to_json()),
+                            quick=True)
+    assert out.platform == msys.current_platform()
+    assert out.d2h != [(1, 1e-6)]
 
 
 def test_quick_sweep_fills_sections(tmp_path, monkeypatch):
