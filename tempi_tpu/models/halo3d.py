@@ -120,27 +120,41 @@ class HaloExchange:
 
     def __init__(self, comm: Communicator, X, radius: int = 1,
                  reorder: bool = False,
-                 dims: Optional[Tuple[int, int, int]] = None):
+                 dims: Optional[Tuple[int, int, int]] = None,
+                 periodic: bool = False):
         self.radius = r = radius
         shape = (X, X, X) if isinstance(X, int) else tuple(X)
         self.X = shape[0]
+        self.periodic = periodic
         if dims is not None:
             self.boxes = decompose_regular(dims, shape)
         else:
             self.boxes = decompose(comm.size, shape)
-        exts = {tuple(b[1][d] - b[0][d] for d in range(3))
-                for b in self.boxes}
-        if len(exts) != 1:
+        if any(b[1][d] <= b[0][d] for b in self.boxes for d in range(3)):
             raise ValueError(
-                f"non-uniform decomposition {exts}: rank count must evenly "
-                "bisect the grid (use a power-of-two rank count)")
-        self.local = next(iter(exts))          # (lx, ly, lz)
-        # allocated array shape (z, y, x) with ghost ring, C order
-        self.alloc = tuple(self.local[2 - d] + 2 * r for d in range(3))
-        self.nbytes = int(np.prod(self.alloc)) * self.ELEM.size
+                f"grid {shape} over-decomposed across {comm.size} ranks: "
+                "some ranks would own zero cells")
+        # Per-rank allocated shapes (z, y, x) with ghost ring, C order. Boxes
+        # may be uneven — the reference's decomposition handles any rank
+        # count with uneven boxes (bench_halo_exchange.cpp:211-236); the
+        # shared DistBuffer row is sized for the largest rank.
+        self.allocs: List[Tuple[int, int, int]] = [
+            tuple(b[1][2 - d] - b[0][2 - d] + 2 * r for d in range(3))
+            for b in self.boxes]
+        self.nbytes = max(int(np.prod(a)) for a in self.allocs) \
+            * self.ELEM.size
 
-        # edges: for each adjacent ordered pair, subarray types over the
-        # allocated shape selecting the send (interior) / recv (ghost) region
+        # edges: for each adjacent ordered pair, subarray types over each
+        # owner's allocated shape selecting the send (interior) / recv
+        # (ghost) region. With ``periodic`` the neighbor relation wraps: a
+        # box is adjacent to every periodic image of its peers, so even a
+        # single rank exchanges its 26 wrap edges with itself.
+        shifts: List[Tuple[int, int, int]] = [(0, 0, 0)]
+        if periodic:
+            shifts = [(sx, sy, sz)
+                      for sx in (-shape[0], 0, shape[0])
+                      for sy in (-shape[1], 0, shape[1])
+                      for sz in (-shape[2], 0, shape[2])]
         self.edges: List[_Edge] = []
         sources: List[List[int]] = [[] for _ in range(comm.size)]
         dests: List[List[int]] = [[] for _ in range(comm.size)]
@@ -148,31 +162,49 @@ class HaloExchange:
         dweights: List[List[int]] = [[] for _ in range(comm.size)]
         for a in range(comm.size):
             for b in range(comm.size):
-                if a == b:
-                    continue
-                region = _overlap(self.boxes[a], self.boxes[b], r)
-                if region is None:
-                    continue
-                cells = int(np.prod([region[1][d] - region[0][d]
-                                     for d in range(3)]))
-                st = self._subarray(region, self.boxes[a])
-                rt = self._subarray(region, self.boxes[b])
-                self.edges.append(_Edge(a, b, st, rt, cells))
-                dests[a].append(b)
-                dweights[a].append(cells)
-                sources[b].append(a)
-                sweights[b].append(cells)
+                for s in shifts:
+                    if a == b and s == (0, 0, 0):
+                        continue
+                    bshift = (tuple(self.boxes[b][0][d] + s[d]
+                                    for d in range(3)),
+                              tuple(self.boxes[b][1][d] + s[d]
+                                    for d in range(3)))
+                    region = _overlap(self.boxes[a], bshift, r)
+                    if region is None:
+                        continue
+                    cells = int(np.prod([region[1][d] - region[0][d]
+                                         for d in range(3)]))
+                    st = self._subarray(region, self.boxes[a], a)
+                    # unshift into b's own frame: the ghost cells b fills
+                    rregion = (tuple(region[0][d] - s[d] for d in range(3)),
+                               tuple(region[1][d] - s[d] for d in range(3)))
+                    rt = self._subarray(rregion, self.boxes[b], b)
+                    self.edges.append(_Edge(a, b, st, rt, cells))
+                    dests[a].append(b)
+                    dweights[a].append(cells)
+                    sources[b].append(a)
+                    sweights[b].append(cells)
 
         self.comm = dist_graph_create_adjacent(
             comm, sources, dests, sweights=sweights, dweights=dweights,
             reorder=reorder)
 
-    def _subarray(self, region: Box, box: Box) -> dt.Datatype:
+    @property
+    def alloc(self) -> Tuple[int, int, int]:
+        """Uniform allocated shape; only meaningful when every rank's box is
+        the same size (use ``allocs[rank]`` otherwise)."""
+        shapes = set(self.allocs)
+        if len(shapes) != 1:
+            raise ValueError(
+                "non-uniform decomposition: use allocs[rank], not alloc")
+        return self.allocs[0]
+
+    def _subarray(self, region: Box, box: Box, owner: int) -> dt.Datatype:
         """Subarray datatype selecting ``region`` (global coords) inside the
         allocated local array of ``box`` (its owner's frame, ghost offset
         applied). C order: sizes are (z, y, x)."""
         r = self.radius
-        sizes = list(self.alloc)
+        sizes = list(self.allocs[owner])
         subsizes = [region[1][2 - d] - region[0][2 - d] for d in range(3)]
         starts = [region[0][2 - d] - box[0][2 - d] + r for d in range(3)]
         return dt.subarray(sizes, subsizes, starts, self.ELEM)
@@ -182,11 +214,14 @@ class HaloExchange:
         if fill is not None:
             rows = []
             for rank in range(self.comm.size):
-                a = np.zeros(self.alloc, dtype=np.float32)
-                a[...] = fill(rank, self.alloc)
-                rows.append(a.astype(np.float32).tobytes())
-            buf = self.comm.buffer_from_host(
-                [np.frombuffer(x, dtype=np.uint8) for x in rows])
+                a = np.zeros(self.allocs[rank], dtype=np.float32)
+                a[...] = fill(rank, self.allocs[rank])
+                row = np.zeros(self.nbytes, dtype=np.uint8)
+                rb = np.frombuffer(a.astype(np.float32).tobytes(),
+                                   dtype=np.uint8)
+                row[: len(rb)] = rb
+                rows.append(row)
+            buf = self.comm.buffer_from_host(rows)
         return buf
 
     def exchange(self, buf: DistBuffer, strategy: Optional[str] = None) -> None:
@@ -203,25 +238,51 @@ class HaloExchange:
     # -- stencil compute (the "model" forward) -------------------------------
 
     def stencil_fn(self):
-        """Jitted 7-point Jacobi update over the mesh (interior only)."""
+        """Jitted 7-point Jacobi update over the mesh (interior only).
+
+        Per-rank box shapes may differ (uneven decomposition): each distinct
+        allocated shape becomes one ``lax.switch`` branch, selected by the
+        device's library rank — the same uniform-program-with-divergent-
+        branches pattern the exchange plans use."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        az, ay, ax = self.alloc
         r = self.radius
         nbytes = self.nbytes
+        shapes = sorted(set(self.allocs))
+        # library rank -> shape class of the application rank it runs
+        table = np.array(
+            [shapes.index(self.allocs[self.comm.application_rank(lib)])
+             for lib in range(self.comm.size)], dtype=np.int32)
+
+        def mk(shape):
+            az, ay, ax = shape
+            n = az * ay * ax * self.ELEM.size
+
+            def f(u8):
+                x = jax.lax.bitcast_convert_type(
+                    u8[:n].reshape(-1, 4), jnp.float32).reshape(az, ay, ax)
+                c = x[r:-r, r:-r, r:-r]
+                nb = (x[2 * r:, r:-r, r:-r] + x[: az - 2 * r, r:-r, r:-r]
+                      + x[r:-r, 2 * r:, r:-r] + x[r:-r, : ay - 2 * r, r:-r]
+                      + x[r:-r, r:-r, 2 * r:] + x[r:-r, r:-r, : ax - 2 * r])
+                x = x.at[r:-r, r:-r, r:-r].set((c + nb) / 7.0)
+                out = jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+                if n < nbytes:
+                    out = jnp.concatenate([out, u8[n:]])
+                return out
+            return f
+
+        branches = [mk(s) for s in shapes]
 
         def step_u8(local):
             u8 = local.reshape(-1)
-            x = jax.lax.bitcast_convert_type(
-                u8.reshape(-1, 4), jnp.float32).reshape(az, ay, ax)
-            c = x[r:-r, r:-r, r:-r]
-            nb = (x[2 * r:, r:-r, r:-r] + x[: az - 2 * r, r:-r, r:-r]
-                  + x[r:-r, 2 * r:, r:-r] + x[r:-r, : ay - 2 * r, r:-r]
-                  + x[r:-r, r:-r, 2 * r:] + x[r:-r, r:-r, : ax - 2 * r])
-            x = x.at[r:-r, r:-r, r:-r].set((c + nb) / 7.0)
-            out = jax.lax.bitcast_convert_type(x, jnp.uint8)
+            if len(branches) == 1:
+                out = branches[0](u8)
+            else:
+                lib = jax.lax.axis_index(AXIS)
+                out = jax.lax.switch(jnp.asarray(table)[lib], branches, u8)
             return out.reshape(1, nbytes)
 
         sm = jax.shard_map(step_u8, mesh=self.comm.mesh,

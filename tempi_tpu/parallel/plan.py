@@ -53,13 +53,20 @@ class Message:
 
 def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
     """Greedy round assignment: each rank sends at most one and receives at
-    most one message per round; program order is preserved per (src,dst)."""
+    most one message per round; program order is preserved per (src,dst).
+
+    Self-messages (src == dst, e.g. periodic wrap edges) are kept in
+    self-only rounds: those rounds execute as local pack->unpack with no
+    ppermute, so XLA fuses them instead of serializing tiny collectives."""
     rounds: List[List[Message]] = []
     busy_s: List[set] = []
     busy_r: List[set] = []
+    is_self: List[bool] = []
     for m in messages:
         placed = False
         for k in range(len(rounds)):
+            if is_self[k] != (m.src == m.dst):
+                continue
             if m.src not in busy_s[k] and m.dst not in busy_r[k]:
                 rounds[k].append(m)
                 busy_s[k].add(m.src)
@@ -70,6 +77,7 @@ def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
             rounds.append([m])
             busy_s.append({m.src})
             busy_r.append({m.dst})
+            is_self.append(m.src == m.dst)
     return rounds
 
 
@@ -176,8 +184,9 @@ class ExchangePlan:
                 sbr, stab = self._send_branches(rnd, maxb)
                 rbr, rtab = self._recv_branches(rnd, maxb)
                 payload = jax.lax.switch(jnp.asarray(stab)[r], sbr, locs)
-                perm = [(m.src, m.dst) for m in rnd]
-                payload = jax.lax.ppermute(payload, AXIS, perm)
+                if any(m.src != m.dst for m in rnd):
+                    perm = [(m.src, m.dst) for m in rnd]
+                    payload = jax.lax.ppermute(payload, AXIS, perm)
                 locs = jax.lax.switch(jnp.asarray(rtab)[r], rbr, payload, locs)
             return tuple(l.reshape(1, -1) for l in locs)
 

@@ -25,11 +25,6 @@ def test_decompose_uniform_pow2():
     assert vol == 512
 
 
-def test_decompose_rejects_uneven(world):
-    with pytest.raises(ValueError, match="non-uniform"):
-        halo3d.HaloExchange(world, X=7)  # 7^3 over 8 ranks: uneven cuts
-
-
 def _global_reference(X, iters):
     """Numpy oracle: zero-padded global grid, 7-point Jacobi on interior."""
     g = np.zeros((X + 2, X + 2, X + 2), dtype=np.float32)
@@ -43,6 +38,95 @@ def _global_reference(X, iters):
               + g[1:-1, 1:-1, 2:] + g[1:-1, 1:-1, :-2])
         g[1:-1, 1:-1, 1:-1] = (c + nb) / 7.0
     return g[1:-1, 1:-1, 1:-1]
+
+
+def _global_reference_periodic(X, iters):
+    """Numpy oracle with wrap-around (periodic) boundaries."""
+    z, y, x = np.meshgrid(np.arange(X), np.arange(X), np.arange(X),
+                          indexing="ij")
+    g = (z * 10000 + y * 100 + x).astype(np.float32)
+    for _ in range(iters):
+        nb = sum(np.roll(g, sh, axis=ax)
+                 for ax in range(3) for sh in (1, -1))
+        g = (g + nb) / 7.0
+    return g
+
+
+def _coord_fill(ex):
+    """alloc_grid fill callback: interior set to global coordinates."""
+    def fill(rank, shape):
+        (lo, hi) = ex.boxes[rank]
+        a = np.zeros(shape, dtype=np.float32)
+        z, y, x = np.meshgrid(np.arange(lo[2], hi[2]),
+                              np.arange(lo[1], hi[1]),
+                              np.arange(lo[0], hi[0]), indexing="ij")
+        a[1:-1, 1:-1, 1:-1] = (z * 10000 + y * 100 + x).astype(np.float32)
+        return a
+    return fill
+
+
+def _rank_interior(ex, buf, rank):
+    shape = ex.allocs[rank]
+    n = int(np.prod(shape)) * 4
+    got = np.frombuffer(buf.get_rank(rank).tobytes()[:n],
+                        dtype=np.float32).reshape(shape)
+    return got[1:-1, 1:-1, 1:-1]
+
+
+def test_halo_rejects_overdecomposition(world):
+    with pytest.raises(ValueError, match="over-decomposed"):
+        halo3d.HaloExchange(world, X=1)  # 1 cell over 8 ranks
+
+
+def test_halo_nonuniform_x7(world):
+    """7^3 over 8 ranks: uneven boxes, per-rank shapes, still exact
+    (reference handles any rank count, bench_halo_exchange.cpp:211-236)."""
+    X, iters = 7, 2
+    ex = halo3d.HaloExchange(world, X=X)
+    assert len(set(ex.allocs)) > 1  # genuinely non-uniform
+    buf = ex.alloc_grid(fill=_coord_fill(ex))
+    stencil = ex.stencil_fn()
+    for _ in range(iters):
+        ex.run_iteration(buf, stencil)
+    want = _global_reference(X, iters)
+    for rank in range(world.size):
+        (lo, hi) = ex.boxes[rank]
+        np.testing.assert_allclose(
+            _rank_interior(ex, buf, rank),
+            want[lo[2]:hi[2], lo[1]:hi[1], lo[0]:hi[0]],
+            rtol=1e-5, err_msg=f"rank {rank} interior diverges")
+
+
+def test_halo_periodic_single_rank(world):
+    """One rank with wrap-around: all 26 edges are self-edges (the matched
+    per-device-bytes single-chip benchmark config)."""
+    from tempi_tpu.parallel.communicator import Communicator
+
+    comm = Communicator(world.devices[:1])
+    X = 6
+    ex = halo3d.HaloExchange(comm, X=X, periodic=True)
+    assert len(ex.edges) == 26
+    assert all(e.src == 0 and e.dst == 0 for e in ex.edges)
+    buf = ex.alloc_grid(fill=_coord_fill(ex))
+    ex.run_iteration(buf, ex.stencil_fn())
+    want = _global_reference_periodic(X, 1)
+    np.testing.assert_allclose(_rank_interior(ex, buf, 0), want, rtol=1e-5)
+
+
+def test_halo_periodic_multirank(world):
+    X, iters = 8, 2
+    ex = halo3d.HaloExchange(world, X=X, periodic=True)
+    buf = ex.alloc_grid(fill=_coord_fill(ex))
+    stencil = ex.stencil_fn()
+    for _ in range(iters):
+        ex.run_iteration(buf, stencil)
+    want = _global_reference_periodic(X, iters)
+    for rank in range(world.size):
+        (lo, hi) = ex.boxes[rank]
+        np.testing.assert_allclose(
+            _rank_interior(ex, buf, rank),
+            want[lo[2]:hi[2], lo[1]:hi[1], lo[0]:hi[0]],
+            rtol=1e-5, err_msg=f"rank {rank} interior diverges")
 
 
 def test_halo_exchange_matches_global_stencil(world):
