@@ -1,13 +1,29 @@
 #!/usr/bin/env python
-"""Driver benchmark: one JSON line with the headline metric.
+"""Driver benchmark: one JSON line covering the judged configs.
 
-Headline: 2-D subarray MPI_Pack bandwidth on the accelerator (BASELINE.json
-metric #1, reference workload /root/reference/bin/bench_mpi_pack.cpp at the
-4 MiB target). ``vs_baseline`` compares against the reference's CUDA pack on
-a Summit V100 at the same shape; the repo publishes charts, not tables
-(BASELINE.md), so the denominator is a documented estimate from the TEMPI
-paper's pack-bandwidth chart scale: ~50 GB/s for large 2-D objects with
-512 B block length.
+Headline value: 2-D subarray MPI_Pack bandwidth on the accelerator
+(BASELINE.json metric #1, reference workload
+/root/reference/bin/bench_mpi_pack.cpp at the 4 MiB target). ``vs_baseline``
+compares against the reference's CUDA pack on a Summit V100 at the same
+shape; the repo publishes charts, not tables (BASELINE.md), so the
+denominator is a documented estimate from the TEMPI paper's pack-bandwidth
+chart scale: ~50 GB/s for large 2-D objects with 512 B block length.
+
+The same line carries the other judged metrics as extra fields:
+
+* ``pingpong_nd_p50_us`` — 2-D strided send/recv one-way p50 latency
+  (reference bin/bench_mpi_pingpong_nd.cpp:30-99). With one chip the pair is
+  rank 0 with itself (pack -> transport -> unpack round, the reference's
+  1-rank self-messaging pattern, test/isend.cu); with >= 2 devices it is the
+  usual 0<->1 pair.
+* ``halo_iters_per_s`` — 3-D halo exchange iterations/s (reference
+  bin/bench_halo_exchange.cpp:977-1006). With one chip: X=256 periodic on a
+  single rank, whose 26 wrap edges carry the same per-device halo bytes as
+  an interior rank of the judged 512^3-over-8 config; with n >= 8 devices:
+  the full 512^3 over 8 ranks.
+
+Methodology fields (``batch_k``, ``sample_ms``) record the pack batching
+discipline so numbers are comparable only within the same discipline.
 """
 
 import json
@@ -15,9 +31,11 @@ import sys
 import time
 
 REFERENCE_V100_PACK_GBS = 50.0
+PACK_BATCH_K = 8
+PACK_SAMPLE_MS = 2.0
 
 
-def _accelerator_usable(timeout_s: int = 120) -> bool:
+def _probe_once(timeout_s: int) -> bool:
     """Probe jax.devices() in a child process with a hard kill: a wedged
     remote-TPU tunnel blocks in PJRT C code where even SIGALRM can't fire,
     so an in-process guard cannot work."""
@@ -34,20 +52,23 @@ def _accelerator_usable(timeout_s: int = 120) -> bool:
         return False
 
 
-def main() -> int:
-    platform = "tpu"
-    if not _accelerator_usable():
-        print("accelerator unavailable (tunnel down or wedged); "
-              "falling back to CPU", file=sys.stderr)
-        from tempi_tpu.utils.platform import force_cpu
+def _accelerator_usable() -> bool:
+    """Retry with backoff: a tunnel that is down at capture time often comes
+    back within minutes, and one 120 s shot forfeits the whole round's TPU
+    evidence (round-1 failure mode)."""
+    plan = [(90, 15), (90, 30), (120, 60), (120, 120), (180, 0)]
+    for i, (timeout_s, sleep_s) in enumerate(plan):
+        if _probe_once(timeout_s):
+            return True
+        print(f"accelerator probe {i + 1}/{len(plan)} failed "
+              f"(timeout {timeout_s}s); retrying in {sleep_s}s",
+              file=sys.stderr)
+        if sleep_s:
+            time.sleep(sleep_s)
+    return False
 
-        force_cpu(device_count=1)
-        platform = "cpu-fallback"
-    import jax
 
-    devices = jax.devices()
-
-    import jax
+def bench_pack(jax, devices):
     import jax.numpy as jnp
     import numpy as np
 
@@ -60,21 +81,16 @@ def main() -> int:
     ty = dt.subarray([nblocks, stride], [nblocks, bl], [0, 0], dt.BYTE)
     rec = type_cache.get_or_commit(ty)
     packer = rec.best_packer()
-    buf = jax.device_put(
-        jnp.asarray(np.random.default_rng(0).integers(0, 256, ty.extent,
-                                                      np.uint8)),
-        devices[0])
     # Throughput discipline for a tunneled TPU: (a) jit the full pack call —
     # the eager path re-runs ~25 us of Python strategy/counter logic per
     # call, slower than the ~7 us kernel; (b) batch K independent packs per
     # dispatch — per-dispatch gaps otherwise add ~6 us/op; (c) 2 ms samples
     # so the ~100 us flush round trip amortizes below 1%.
-    K = 8
-    bufs = [buf] + [
-        jax.device_put(
-            jnp.asarray(np.random.default_rng(i).integers(
-                0, 256, ty.extent, np.uint8)), devices[0])
-        for i in range(1, K)]
+    K = PACK_BATCH_K
+    bufs = [jax.device_put(
+        jnp.asarray(np.random.default_rng(i).integers(0, 256, ty.extent,
+                                                      np.uint8)),
+        devices[0]) for i in range(K)]
     mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
     jax.block_until_ready(mega(bufs))  # compile
     last = []
@@ -83,13 +99,116 @@ def main() -> int:
         last[:] = [mega(bufs)]
 
     r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
-                  min_sample_secs=2e-3, max_trial_secs=3.0)
-    gbs = ty.size * K / r.trimean / 1e9
+                  min_sample_secs=PACK_SAMPLE_MS * 1e-3, max_trial_secs=3.0)
+    return ty.size * K / r.trimean / 1e9
+
+
+def bench_pingpong_nd(jax, quick: bool):
+    """One-way p50 of a 2-D strided exchange (1 MiB, 256 B blocks)."""
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    comm = api.comm_world()
+    a, b = (0, 1) if comm.size >= 2 else (0, 0)
+    nblocks, bl, stride = 4096, 256, 512
+    ty = dt.subarray([nblocks, stride], [nblocks, bl], [0, 0], dt.BYTE)
+    buf = comm.alloc(ty.extent)
+
+    def pingpong():
+        r1 = p2p.isend(comm, a, buf, b, ty)
+        r2 = p2p.irecv(comm, b, buf, a, ty)
+        p2p.waitall([r1, r2])
+        if a != b:
+            r3 = p2p.isend(comm, b, buf, a, ty)
+            r4 = p2p.irecv(comm, a, buf, b, ty)
+            p2p.waitall([r3, r4])
+        buf.data.block_until_ready()
+
+    pingpong()  # compile
+    kw = dict(max_trial_secs=0.3, max_samples=30) if quick else \
+        dict(max_trial_secs=1.5)
+    r = benchmark(pingpong, **kw)
+    hops = 2 if a != b else 1
+    return r.stats.med() / hops, ("pair" if a != b else "self")
+
+
+def bench_halo(jax, n_devices: int, quick: bool):
+    """Halo-exchange iterations/s at matched per-device bytes."""
+    from tempi_tpu import api
+    from tempi_tpu.models import halo3d
+    from tempi_tpu.parallel.communicator import Communicator
+
+    world = api.comm_world()
+    if n_devices >= 8:
+        comm = Communicator(world.devices[:8])
+        X, periodic = 512 if not quick else 64, False
+    else:
+        comm = Communicator(world.devices[:1])
+        # 512^3 / 8 ranks = 256^3 cells per rank; periodic wrap gives this
+        # one rank the full 26-edge exchange of an interior rank
+        X, periodic = 256 if not quick else 32, True
+    ex = halo3d.HaloExchange(comm, X=X, periodic=periodic)
+    buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
+    ex.exchange(buf)
+    buf.data.block_until_ready()  # compile
+    iters = 5 if quick else 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ex.exchange(buf)
+    buf.data.block_until_ready()
+    dt_s = time.perf_counter() - t0
+    return iters / dt_s, f"X={X} ranks={comm.size} periodic={periodic}"
+
+
+def main() -> int:
+    import os
+
+    platform = "tpu"
+    forced = os.environ.get("TEMPI_BENCH_FORCE", "")
+    if forced == "cpu" or (forced != "tpu" and not _accelerator_usable()):
+        print("accelerator unavailable (tunnel down or wedged) after "
+              "retries; falling back to CPU", file=sys.stderr)
+        from tempi_tpu.utils.platform import force_cpu
+
+        force_cpu(device_count=1)
+        platform = "cpu-fallback"
+    import jax
+
+    from tempi_tpu import api
+
+    devices = jax.devices()
+    api.init(devices)
+    quick = platform != "tpu"
+
+    gbs = bench_pack(jax, devices)
+    try:
+        pp_p50, pp_mode = bench_pingpong_nd(jax, quick)
+    except Exception as e:  # never lose the headline to a secondary metric
+        print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
+        pp_p50, pp_mode = None, "failed"
+    try:
+        halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
+    except Exception as e:
+        print(f"halo failed: {e!r}", file=sys.stderr)
+        halo_ips, halo_cfg = None, "failed"
+    api.finalize()
+
     print(json.dumps({
         "metric": f"bench-mpi-pack 2D subarray pack bandwidth ({platform})",
         "value": round(gbs, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbs / REFERENCE_V100_PACK_GBS, 3),
+        "platform": platform,
+        "batch_k": PACK_BATCH_K,
+        "sample_ms": PACK_SAMPLE_MS,
+        "pingpong_nd_p50_us": (round(pp_p50 * 1e6, 2)
+                               if pp_p50 is not None else None),
+        "pingpong_nd_mode": pp_mode,
+        "halo_iters_per_s": (round(halo_ips, 2)
+                             if halo_ips is not None else None),
+        "halo_config": halo_cfg,
     }))
     return 0
 

@@ -187,8 +187,12 @@ def interp_2d(grid: List[List[float]], nbytes: int, block_length: int) -> float:
     by = [math.log2(b) for b in GRID_BLOCKLEN[: len(grid[0])]]
     x = min(max(math.log2(max(nbytes, 1)), bx[0]), bx[-1])
     y = min(max(math.log2(max(block_length, 1)), by[0]), by[-1])
-    i = min(int((x - bx[0]) / 2), len(bx) - 2) if len(bx) > 1 else 0
-    j = min(int(y - by[0]), len(by) - 2) if len(by) > 1 else 0
+    # search for the cell instead of assuming the grid's log2 spacing: the
+    # index math must follow GRID_BYTES/GRID_BLOCKLEN if they ever change
+    i = max(k for k in range(len(bx) - 1) if bx[k] <= x) \
+        if len(bx) > 1 else 0
+    j = max(k for k in range(len(by) - 1) if by[k] <= y) \
+        if len(by) > 1 else 0
     fx = 0.0 if len(bx) == 1 else (x - bx[i]) / (bx[i + 1] - bx[i])
     fy = 0.0 if len(by) == 1 else (y - by[j]) / (by[j + 1] - by[j])
     i1 = min(i + 1, len(bx) - 1)

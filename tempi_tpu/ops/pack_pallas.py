@@ -79,6 +79,51 @@ _MAX_DMAS = 64
 _MAX_UNPACK_UPDATES = 64
 
 
+@functools.lru_cache(maxsize=1)
+def _multi_dma_supported() -> bool:
+    """One-time hardware probe: do multi-combo direct-DMA kernels (strided
+    copies through an indexed rank-3 ANY-memory ref, the ``pk_ref.at[i]``
+    pattern of ``_dma_call``) lower on this backend?  The project's measured
+    Mosaic constraints saw rank-3 DMA slices rejected in every variant tried,
+    and on traced paths (jitted exchange plans) such a rejection bypasses the
+    eager ``_failed_dma`` safety net and fails the whole exchange at compile
+    time — so the flag must be decided eagerly, before any plan is traced.
+    CPU interpret mode enforces no Mosaic constraints and always passes."""
+    if _interpret():
+        return True
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        nblocks, bl = 8, 128
+
+        def kern(view_ref, pk_ref, sems):
+            copies = [
+                pltpu.make_async_copy(
+                    view_ref.at[pl.ds(i * 16, nblocks), pl.ds(0, bl)],
+                    pk_ref.at[i], sems.at[i])
+                for i in range(2)]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
+
+        call = pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((2, nblocks, bl), jnp.uint8),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        )
+        jax.jit(call).lower(
+            jax.ShapeDtypeStruct((32, 128), jnp.uint8)).compile()
+        return True
+    except Exception as e:
+        log.debug(f"multi-combo direct-DMA probe failed; gating those "
+                  f"geometries to the pipeline/XLA kernels: {e}")
+        return False
+
+
 @functools.lru_cache(maxsize=8192)
 def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
           strides: Tuple[int, ...], extent: int,
@@ -143,7 +188,8 @@ def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
     # multiples of the contributing outer strides, so checking those
     # suffices.
     dma = (n_dmas <= _MAX_DMAS and bl % 128 == 0 and start_row % 8 == 0
-           and all(s % 8 == 0 for n, s in outer_rows if n > 1))
+           and all(s % 8 == 0 for n, s in outer_rows if n > 1)
+           and (n_dmas == 1 or _multi_dma_supported()))
     # Pipeline tile: must divide every outer row-offset so index_map stays in
     # block units; counts[1] itself may be ragged (edge blocks are clipped).
     # Levels with a single index never contribute an offset. Scale the
@@ -378,6 +424,7 @@ def _build_pack(nbytes: int, start: int, counts: Tuple[int, ...],
 # _plan's measured eligibility flags are the primary defense there.
 _failed_dma: set = set()    # direct-DMA kernel failed; pipeline may still work
 _failed_args: set = set()   # no pallas pack kernel works for this geometry
+_failed_unpack_dma: set = set()  # in-place unpack DMA failed; splice instead
 
 
 def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
@@ -494,7 +541,7 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
             tuple(map(int, strides)), int(extent), int(incount))
     p = _plan(*args)
     if (p is not None and p["dma"] and _is_tracer(dst_u8)
-            and args not in _failed_args):
+            and args not in _failed_unpack_dma):
         # inside a traced program XLA's copy-insertion keeps the in-place
         # aliasing sound; eagerly it would consume the caller's array
         try:
@@ -502,7 +549,9 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
         except ImportError:
             pass
         except Exception as e:
-            _failed_args.add(args)
+            # memo separate from _failed_args: a broken in-place unpack says
+            # nothing about the pack kernels for the same geometry
+            _failed_unpack_dma.add(args)
             log.warn(f"pallas unpack failed for {args}; using the XLA "
                      f"splice from now on for this geometry: {e}")
     if p is None or p["n_dmas"] > _MAX_UNPACK_UPDATES:

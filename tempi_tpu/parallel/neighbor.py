@@ -36,10 +36,11 @@ def _graph(comm: Communicator):
 def neighbor_alltoallw(comm: Communicator, sendbuf: DistBuffer,
                        sendcounts, sdispls, sendtypes,
                        recvbuf: DistBuffer, recvcounts, rdispls, recvtypes,
-                       strategy: str = "device") -> None:
+                       strategy: str = None) -> None:
     """Per-rank lists indexed by neighbor order; displacements in bytes
     (MPI_Neighbor_alltoallw semantics; reference builds Isend/Irecv per
-    neighbor at the reserved tag)."""
+    neighbor at the reserved tag). ``strategy=None`` asks the measured
+    model, like the Isend/Irecv fan-out the reference lowers to."""
     graph = _graph(comm)
     msgs = []
     for ar in range(comm.size):
@@ -92,13 +93,19 @@ def neighbor_alltoallw(comm: Communicator, sendbuf: DistBuffer,
             f"neighbor_alltoallw: {leftover} receive edge(s) with no matching "
             "send")
     if out:
-        get_plan(comm, out).run(strategy)
+        if strategy is None:
+            from .p2p import choose_strategy
+            strategy = choose_strategy(comm, out)
+        # under the progress lock: a TEMPI_PROGRESS_THREAD pump shares the
+        # plan cache and must not race a cached ExchangePlan mid-execution
+        with comm._progress_lock:
+            get_plan(comm, out).run(strategy)
 
 
 def neighbor_alltoallv(comm: Communicator, sendbuf: DistBuffer,
                        sendcounts, sdispls, recvbuf: DistBuffer,
                        recvcounts, rdispls, datatype: Datatype = dtypes.BYTE,
-                       strategy: str = "device") -> None:
+                       strategy: str = None) -> None:
     """MPI_Neighbor_alltoallv: like alltoallw with one dense datatype and
     element displacements."""
     graph = _graph(comm)

@@ -19,8 +19,9 @@ cache.
 from __future__ import annotations
 
 import itertools
+import math
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..ops import type_cache
 from ..ops.dtypes import Datatype
@@ -161,11 +162,13 @@ def _match(pending: List[Op]):
     return messages, consumed, leftover
 
 
-def choose_strategy(comm: Communicator, messages) -> str:
-    """DEVICE/ONESHOT forced by env; AUTO asks the measured model per the
-    largest message, with the decision cached per {colocated, bytes,
+def choose_strategy_message(comm: Communicator, m: Message) -> str:
+    """Per-MESSAGE strategy: DEVICE/ONESHOT forced by env; AUTO asks the
+    measured model, with the decision cached per {colocated, bytes,
     blockLength} like SendRecvND's model-choice cache (sender.cpp:259-277,
-    sender.hpp:104-122)."""
+    sender.hpp:104-122). The reference decides per message, not per batch
+    (sender.cpp:251-328) — a 64 B and a 4 MiB message in one exchange may
+    ride different transports."""
     method = envmod.env.datatype
     if method is DatatypeMethod.DEVICE:
         return "device"
@@ -174,7 +177,6 @@ def choose_strategy(comm: Communicator, messages) -> str:
     # AUTO
     try:
         from ..measure import system as msys
-        m = max(messages, key=lambda m: m.nbytes)
         colocated = comm.is_colocated(m.src, m.dst)
         block = min(max(_block_length(m), 1), 512)
         cache = comm.__dict__.setdefault("_strategy_cache", {})
@@ -184,14 +186,29 @@ def choose_strategy(comm: Communicator, messages) -> str:
             ctr.counters.modeling.cache_hit += 1
             return hit
         ctr.counters.modeling.cache_miss += 1
-        t_dev = msys.model_device(m.nbytes, block, colocated)
-        t_one = msys.model_oneshot(m.nbytes, block, colocated)
-        choice = "oneshot" if t_one < t_dev else "device"
+        with ctr.timed(ctr.counters.modeling, "wall_time"):
+            t_dev = msys.model_device(m.nbytes, block, colocated)
+            t_one = msys.model_oneshot(m.nbytes, block, colocated)
+        if not (t_dev < math.inf or t_one < math.inf):
+            choice = "device"  # no curves at all: unmeasured system
+        else:
+            choice = "oneshot" if t_one < t_dev else "device"
         cache[key] = choice
         return choice
-    except Exception:
-        pass
-    return "device"
+    except Exception as e:
+        # a broken model/cache must be visible, not indistinguishable from
+        # a decision (round-1 review finding)
+        ctr.counters.send.num_fallback += 1
+        log.warn(f"strategy model failed for {m.nbytes}B "
+                 f"{m.src}->{m.dst}; defaulting to device: {e!r}")
+        return "device"
+
+
+def choose_strategy(comm: Communicator, messages) -> str:
+    """Batch-level strategy (collective paths that need ONE transport for a
+    whole plan): the per-message model applied to the largest message."""
+    return choose_strategy_message(comm,
+                                   max(messages, key=lambda m: m.nbytes))
 
 
 def _block_length(m: Message) -> int:
@@ -217,20 +234,38 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
         if not messages:
             return 0
         comm._pending = leftover
-        try:
-            plan = get_plan(comm, messages)
-            plan.run(strategy or choose_strategy(comm, messages))
-        except Exception as e:
-            # attach BEFORE the lock is released: the consumed ops will never
-            # turn done, and a waiter that acquires the lock the instant this
-            # frame unwinds must see the root cause, not conclude "peer never
-            # posted". Scoped to the failed batch's requests so an unrelated
-            # later deadlock still gets the deadlock diagnosis.
-            for op in consumed:
-                op.request.error = e
-            raise
-        for op in consumed:
-            op.request.done = True
+        # group per-message strategy decisions: each group is one compiled
+        # plan on its own transport (messages[i] pairs with consumed[2i],
+        # consumed[2i+1])
+        groups: Dict[str, List[int]] = {}
+        for i, m in enumerate(messages):
+            s = strategy or choose_strategy_message(comm, m)
+            groups.setdefault(s, []).append(i)
+        order = list(groups.items())
+        for gi, (strat, idxs) in enumerate(order):
+            batch = [messages[i] for i in idxs]
+            ops = [op for i in idxs for op in (consumed[2 * i],
+                                               consumed[2 * i + 1])]
+            try:
+                plan = get_plan(comm, batch)
+                plan.run(strat)
+            except Exception as e:
+                # attach BEFORE the lock is released: these ops will never
+                # turn done, and a waiter that acquires the lock the instant
+                # this frame unwinds must see the root cause, not conclude
+                # "peer never posted". Covers the failed group AND the
+                # not-yet-run groups (their ops are already consumed from
+                # pending, so they too will never complete); scoped to this
+                # batch so an unrelated later deadlock still gets the
+                # deadlock diagnosis.
+                abandoned = [op for _, rest in order[gi + 1:]
+                             for i in rest
+                             for op in (consumed[2 * i], consumed[2 * i + 1])]
+                for op in ops + abandoned:
+                    op.request.error = e
+                raise
+            for op in ops:
+                op.request.done = True
         return len(messages)
 
 

@@ -133,5 +133,10 @@ def test_quick_sweep_fills_sections(tmp_path, monkeypatch):
     assert out.device_launch > 0
     assert len(out.pack_device) == 3 and len(out.pack_device[0]) == 3
     assert out.intra_node_pingpong  # 8 CPU devices available
+    # off-node curve is measured (simulated DCN: D2H -> host -> H2D), so
+    # model_device is finite for non-colocated pairs (round-1 finding)
+    assert out.inter_node_pingpong
+    msys.set_system(out)
+    assert msys.model_device(1024, 64, False) < math.inf
     msys.save(out)
     assert msys.load_cached() is not None

@@ -167,3 +167,39 @@ def test_contiguous_sweep(world):
         api.send(world, 6, s, 7, ty)
         api.recv(world, 7, r, 6, ty)
         np.testing.assert_array_equal(r.get_rank(7), rows[6])
+
+
+def test_auto_picks_per_message_strategy(world):
+    """AUTO consults the model PER MESSAGE (reference sender.cpp:251-328):
+    with curves where the host path wins small messages and the device path
+    wins large ones, one exchange carrying both sizes uses both transports."""
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.utils import counters as ctr
+
+    sp = msys.SystemPerformance()
+    cheap = [[1e-7] * 9 for _ in range(9)]
+    sp.pack_device = sp.unpack_device = cheap
+    sp.pack_host = sp.unpack_host = cheap
+    # device transport: flat 1 ms; host transport: ns for small, 10 s for big
+    sp.intra_node_pingpong = [(1, 1e-3), (1 << 23, 1e-3)]
+    sp.host_pingpong = [(1, 1e-9), (1 << 10, 1e-9), (1 << 11, 10.0),
+                        (1 << 23, 10.0)]
+    msys.set_system(sp)
+    world.__dict__.pop("_strategy_cache", None)
+
+    small = dt.contiguous(64, dt.BYTE)
+    big = dt.contiguous(1 << 20, dt.BYTE)
+    sbuf, rows = fill(world, big.extent)
+    rbuf = world.alloc(big.extent)
+    d0, o0 = ctr.counters.send.num_device, ctr.counters.send.num_oneshot
+    api.isend(world, 0, sbuf, 1, small)
+    api.irecv(world, 1, rbuf, 0, small)
+    api.isend(world, 2, sbuf, 3, big)
+    api.irecv(world, 3, rbuf, 2, big)
+    from tempi_tpu.parallel import p2p as p2p_mod
+    p2p_mod.try_progress(world)
+    assert ctr.counters.send.num_device == d0 + 1   # the big message
+    assert ctr.counters.send.num_oneshot == o0 + 1  # the small message
+    np.testing.assert_array_equal(rbuf.get_rank(1)[:64], rows[0][:64])
+    np.testing.assert_array_equal(rbuf.get_rank(3), rows[2])
+    msys.set_system(msys.SystemPerformance())

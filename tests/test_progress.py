@@ -175,3 +175,44 @@ def test_machine_queries(world8_2nodes):
     assert m.node_of_rank(comm.size - 1) == 1
     from tempi_tpu.parallel import tags
     assert m.tag_ub() == tags.RESERVED_BASE - 1
+
+
+def test_pump_enabled_collective_no_race(world8):
+    """Collectives take the progress lock around cached-plan execution, so a
+    running pump thread and a direct collective cannot race one ExchangePlan
+    (round-1 finding). Drives concurrent p2p traffic (pump-completed) and
+    neighbor_alltoallv calls on the same communicator."""
+    from tempi_tpu.parallel import dist_graph, p2p
+    from tempi_tpu.runtime import progress
+
+    comm = world8
+    size = comm.size
+    # ring graph; every rank sends 32 B to its successor
+    sources = [[(r - 1) % size] for r in range(size)]
+    dests = [[(r + 1) % size] for r in range(size)]
+    g = dist_graph.dist_graph_create_adjacent(comm, sources, dests)
+    sendbuf = g.buffer_from_host(
+        [np.full(32, r + 1, np.uint8) for r in range(size)])
+    recvbuf = g.alloc(32)
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel.neighbor import neighbor_alltoallv
+
+    ty = dt.contiguous(64, dt.BYTE)
+    pbuf = g.buffer_from_host(
+        [np.full(64, r + 101, np.uint8) for r in range(size)])
+    progress.start()
+    try:
+        for _ in range(5):
+            reqs = []
+            for r in range(size):
+                reqs.append(p2p.isend(g, r, pbuf, (r + 3) % size, ty))
+                reqs.append(p2p.irecv(g, (r + 3) % size, pbuf, r, ty))
+            neighbor_alltoallv(g, sendbuf, [[32]] * size, [[0]] * size,
+                               recvbuf, [[32]] * size, [[0]] * size)
+            p2p.waitall(reqs)
+        for r in range(size):
+            np.testing.assert_array_equal(
+                recvbuf.get_rank((r + 1) % size),
+                np.full(32, r + 1, np.uint8))
+    finally:
+        progress.stop()
