@@ -213,6 +213,22 @@ def model_oneshot(nbytes: int, block_length: int, colocated: bool) -> float:
     return ph + send + uh
 
 
+def model_staged_1d(nbytes: int) -> float:
+    """Contiguous staged path: D2H, host-side move, H2D (reference:
+    SendRecv1DStaged, sender.cpp:34-61; modeled per call by SendRecv1D,
+    sender.cpp:63-86)."""
+    sp = get()
+    return (interp_time(sp.d2h, nbytes) + interp_time(sp.host_pingpong, nbytes)
+            + interp_time(sp.h2d, nbytes))
+
+
+def model_direct_1d(nbytes: int, colocated: bool) -> float:
+    """Contiguous direct path: the device-device transport, no pack step."""
+    sp = get()
+    return interp_time(sp.intra_node_pingpong if colocated
+                       else sp.inter_node_pingpong, nbytes)
+
+
 def model_device(nbytes: int, block_length: int, colocated: bool) -> float:
     sp = get()
     pd = interp_2d(sp.pack_device, nbytes, block_length)

@@ -3,9 +3,10 @@
 Re-design of the reference's typeCache + MPI_Type_commit interposer
 (/root/reference/include/type_cache.hpp, src/type_commit.cpp): committing a
 datatype runs decode -> simplify -> to_strided_block -> plan_pack and caches a
-TypeRecord {strided block, packer, sender, recver}. Sender/recver strategy
-objects are attached by the parallel layer (type_commit.cpp:52-108 analog in
-parallel/p2p.py) the first time the type is used for communication.
+TypeRecord {strided block, packer}. The reference also binds sender/recver
+strategy objects at commit (type_commit.cpp:52-108); here strategy is chosen
+per message at exchange time (parallel/p2p.py choose_strategy_message), so
+the record carries the geometry those decisions key on, not strategy objects.
 """
 
 from __future__ import annotations
@@ -26,8 +27,6 @@ class TypeRecord:
     desc: StridedBlock = field(default_factory=StridedBlock)
     packer: Optional[Packer] = None      # fast strided packer, if plannable
     fallback: Optional[Packer] = None    # typemap packer, always available
-    sender: object = None                # attached by parallel/p2p.py
-    recver: object = None
 
     def best_packer(self) -> Packer:
         if self.packer is not None and not envmod.env.no_pack:

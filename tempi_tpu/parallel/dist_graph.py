@@ -26,7 +26,7 @@ from ..utils import logging as log
 from ..utils.env import PlacementMethod
 from . import partition as part_mod
 from .communicator import Communicator
-from .topology import make_placement
+from .topology import Placement, make_placement
 
 
 def _build_edges(sources, sweights, destinations, dweights, size):
@@ -94,14 +94,38 @@ def dist_graph_create_adjacent(comm: Communicator, sources, destinations,
     method = method if method is not None else envmod.env.placement
 
     # gates mirrored from the reference: env method NONE (:62-69), or a
-    # topology where movement is meaningless (:91-98)
+    # topology where movement is meaningless (:91-98). Unlike the reference
+    # (node movement only), an ICI torus makes single-node reordering
+    # meaningful too — but only the KAHIP process-mapping path can exploit
+    # it; node-partition methods (METIS/RANDOM) would degenerate to an
+    # identity placement on one node, so they keep the reference's gate.
+    node_movement = comm.num_nodes >= 2 and comm.ranks_per_node >= 2
+    torus_movement = (comm.topology.has_ici_distances and size > 2
+                      and method is PlacementMethod.KAHIP)
     if (not reorder or method is PlacementMethod.NONE
-            or comm.num_nodes < 2 or comm.ranks_per_node < 2):
+            or not (node_movement or torus_movement)):
         return Communicator(comm.devices, placement=comm.placement,
                             graph=graph, parent=comm)
 
     if method is PlacementMethod.RANDOM:
         res = part_mod.random_partition(comm.num_nodes, size)
+    elif method is PlacementMethod.KAHIP:
+        # the reference's strongest mode: KaHIP process mapping against the
+        # hardware hierarchy (partition_kahip_process_mapping.cpp:95-135);
+        # here a full rank->slot permutation against the ICI/DCN distance
+        # matrix, so the result is a Placement directly
+        sym = _build_edges(sources, sweights, destinations, dweights, size)
+        csr = _to_csr(sym, size)
+        slot_of, obj = part_mod.process_mapping(
+            csr, comm.topology.distance_matrix())
+        log.debug(f"dist_graph process mapping objective = {obj}")
+        lib_rank = [int(s) for s in slot_of]
+        app_rank = [0] * size
+        for ar, lib in enumerate(lib_rank):
+            app_rank[lib] = ar
+        placement = Placement(app_rank=app_rank, lib_rank=lib_rank)
+        return Communicator(comm.devices, placement=placement, graph=graph,
+                            parent=comm)
     else:
         sym = _build_edges(sources, sweights, destinations, dweights, size)
         csr = _to_csr(sym, size)

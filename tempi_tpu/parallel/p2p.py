@@ -28,7 +28,7 @@ from ..ops.dtypes import Datatype
 from ..utils import counters as ctr
 from ..utils import env as envmod
 from ..utils import logging as log
-from ..utils.env import DatatypeMethod
+from ..utils.env import ContiguousMethod, DatatypeMethod
 from .communicator import Communicator, DistBuffer
 from .plan import Message, get_plan
 
@@ -169,6 +169,37 @@ def choose_strategy_message(comm: Communicator, m: Message) -> str:
     sender.hpp:104-122). The reference decides per message, not per batch
     (sender.cpp:251-328) — a 64 B and a 4 MiB message in one exchange may
     ride different transports."""
+    # contiguous (1-D) messages honor TEMPI_CONTIGUOUS_* first, like the
+    # reference instantiating SendRecv1DStaged/SendRecv1D at type commit
+    # (type_commit.cpp:52-73)
+    from ..ops.packer import Packer1D
+    if isinstance(m.spacker, Packer1D):
+        cm = envmod.env.contiguous
+        if cm is ContiguousMethod.STAGED:
+            return "staged"
+        if cm is ContiguousMethod.AUTO:
+            try:
+                from ..measure import system as msys
+                colocated = comm.is_colocated(m.src, m.dst)
+                cache = comm.__dict__.setdefault("_strategy_cache", {})
+                key = ("1d", colocated, m.nbytes)
+                hit = cache.get(key)
+                if hit is not None:
+                    ctr.counters.modeling.cache_hit += 1
+                    return hit
+                ctr.counters.modeling.cache_miss += 1
+                with ctr.timed(ctr.counters.modeling, "wall_time"):
+                    t_staged = msys.model_staged_1d(m.nbytes)
+                    t_direct = msys.model_direct_1d(m.nbytes, colocated)
+                if t_staged < math.inf or t_direct < math.inf:
+                    choice = "staged" if t_staged < t_direct else "device"
+                    cache[key] = choice
+                    return choice
+            except Exception as e:
+                ctr.counters.send.num_fallback += 1
+                log.warn(f"contiguous model failed for {m.nbytes}B; "
+                         f"defaulting to device: {e!r}")
+                return "device"
     method = envmod.env.datatype
     if method is DatatypeMethod.DEVICE:
         return "device"

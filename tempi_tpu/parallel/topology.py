@@ -7,10 +7,16 @@ reference allgathers processor names and labels nodes by name equality
 rank comes from the platform:
 
   * multi-host: ``device.process_index`` (one node per host — DCN boundary)
-  * single-host TPU slice: devices grouped by ICI neighborhood using device
-    coords when available (``TEMPI_RANKS_PER_NODE`` overrides the group size)
   * CPU test mesh: ``TEMPI_RANKS_PER_NODE`` chunking (simulating multi-node
     the way the reference's single-node mpiexec tests simulate it)
+
+Beyond the node map, the topology carries the **ICI torus geometry**: per-
+device coords (real TPU ``device.coords``, or a simulated ``TEMPI_TORUS``
+shape on a CPU mesh) and wrap-around hop distances, so placement can
+minimize weighted hops on the torus — the analog of the reference's KaHIP
+process-mapping hierarchy with distances {1, 5}
+(partition_kahip_process_mapping.cpp:95-135), refined from two levels to
+actual per-link hop counts.
 
 ``Placement`` and ``make_placement`` keep the reference's exact appRank/libRank
 greedy node-slot semantics (topology.cpp:97-144): given the target node of
@@ -20,16 +26,28 @@ each application rank, assign it the next free library rank on that node.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..utils import env as envmod
 from ..utils import logging as log
+
+# Reference distance ratio: inter-node traffic costs 5x an intra-node hop
+# (partition_kahip_process_mapping.cpp:95-135 hierarchy distances {1,5});
+# here intra-node is refined to torus hops, inter-node stays 5x the diameter
+# so crossing DCN always dominates any on-torus rearrangement.
+DCN_FACTOR = 5
 
 
 @dataclass
 class Topology:
     node_of_rank: List[int]
     ranks_of_node: List[List[int]]
+    # ICI torus geometry: coords[rank] on a torus of shape torus_dims, or
+    # None when the platform exposes no coordinates
+    coords: Optional[List[Tuple[int, ...]]] = None
+    torus_dims: Optional[Tuple[int, ...]] = None
 
     @property
     def num_nodes(self) -> int:
@@ -39,6 +57,38 @@ class Topology:
         """Same-node query (reference: is_colocated, topology.cpp:191-196).
         On TPU, same node = same host (ICI reachable without DCN)."""
         return self.node_of_rank[a] == self.node_of_rank[b]
+
+    @property
+    def has_ici_distances(self) -> bool:
+        return self.coords is not None
+
+    def ici_hops(self, a: int, b: int) -> int:
+        """Wrap-around manhattan hop count on the ICI torus."""
+        assert self.coords is not None
+        ca, cb = self.coords[a], self.coords[b]
+        return sum(min(abs(x - y), d - abs(x - y))
+                   for x, y, d in zip(ca, cb, self.torus_dims))
+
+    def distance_matrix(self) -> np.ndarray:
+        """Pairwise placement distances: torus hops within a node (1 when no
+        coords are known), DCN_FACTOR x diameter across nodes."""
+        n = len(self.node_of_rank)
+        if self.coords is not None:
+            diam = max(1, sum(d // 2 for d in self.torus_dims))
+        else:
+            diam = 1
+        dcn = DCN_FACTOR * diam
+        dist = np.zeros((n, n), dtype=np.int64)
+        for a in range(n):
+            for b in range(a + 1, n):
+                if self.node_of_rank[a] != self.node_of_rank[b]:
+                    d = dcn
+                elif self.coords is not None:
+                    d = max(1, self.ici_hops(a, b))
+                else:
+                    d = 1
+                dist[a, b] = dist[b, a] = d
+        return dist
 
 
 def _node_keys(devices: Sequence) -> List:
@@ -54,6 +104,32 @@ def _node_keys(devices: Sequence) -> List:
     return [0] * len(devices)
 
 
+def _device_coords(devices: Sequence):
+    """(coords, torus_dims) from the platform, or (None, None).
+
+    Priority: real TPU ``device.coords`` (the torus shape taken as the
+    coordinate bounding box); the simulated TEMPI_TORUS shape only stands in
+    when the hardware exposes no coordinates (CPU meshes — ranks laid out
+    row-major). A stale TEMPI_TORUS from a test script must never replace
+    physical ICI topology."""
+    coords = [getattr(d, "coords", None) for d in devices]
+    if len(devices) > 1 and all(
+            c is not None and len(c) > 0 for c in coords):
+        arr = np.asarray(coords, dtype=np.int64)
+        dims = tuple(int(arr[:, k].max()) + 1 for k in range(arr.shape[1]))
+        return [tuple(map(int, c)) for c in coords], dims
+    shape = envmod.env.torus
+    if shape:
+        if int(np.prod(shape)) < len(devices):
+            log.warn(f"TEMPI_TORUS {shape} smaller than {len(devices)} "
+                     "devices; ignoring")
+        else:
+            coords = [tuple(map(int, np.unravel_index(i, shape)))
+                      for i in range(len(devices))]
+            return coords, tuple(shape)
+    return None, None
+
+
 def discover(devices: Sequence) -> Topology:
     """Build the node map for a device list (cache_communicator analog)."""
     keys = _node_keys(devices)
@@ -66,7 +142,9 @@ def discover(devices: Sequence) -> Topology:
     ranks_of_node: List[List[int]] = [[] for _ in range(len(labels))]
     for r, n in enumerate(node_of_rank):
         ranks_of_node[n].append(r)
-    return Topology(node_of_rank, ranks_of_node)
+    coords, dims = _device_coords(devices)
+    return Topology(node_of_rank, ranks_of_node, coords=coords,
+                    torus_dims=dims)
 
 
 @dataclass

@@ -8,6 +8,8 @@ TPU-native re-design of the reference's env subsystem
 Extra knobs with no reference analog (documented where used):
   TEMPI_PACK_KERNEL   = pallas | xla | auto   (packer backend selection)
   TEMPI_RANKS_PER_NODE                        (simulated node size on a CPU mesh)
+  TEMPI_TORUS         = e.g. 4x2 or 4x4x4     (simulated ICI torus shape on a
+                                               CPU mesh; real TPU coords win)
 """
 
 from __future__ import annotations
@@ -93,6 +95,7 @@ class Environment:
     cache_dir: str = ""
     pack_kernel: PackKernel = PackKernel.AUTO
     ranks_per_node: int = 0  # 0 = discover from the platform
+    torus: tuple = ()        # () = discover from device coords
     # background progress thread (no reference analog: the reference's
     # queue.hpp/waitall sketch show one was intended but never landed)
     progress_thread: bool = False
@@ -149,6 +152,14 @@ class Environment:
             e.ranks_per_node = int(getenv("TEMPI_RANKS_PER_NODE") or 0)
         except ValueError:
             e.ranks_per_node = 0
+
+        try:
+            spec = (getenv("TEMPI_TORUS") or "").lower()
+            e.torus = tuple(int(x) for x in spec.split("x")) if spec else ()
+            if any(d <= 0 for d in e.torus):
+                e.torus = ()
+        except ValueError:
+            e.torus = ()
 
         e.progress_thread = getenv("TEMPI_PROGRESS_THREAD") is not None
         return e

@@ -203,3 +203,43 @@ def test_auto_picks_per_message_strategy(world):
     np.testing.assert_array_equal(rbuf.get_rank(1)[:64], rows[0][:64])
     np.testing.assert_array_equal(rbuf.get_rank(3), rows[2])
     msys.set_system(msys.SystemPerformance())
+
+
+def test_contiguous_method_knobs(world, monkeypatch):
+    """TEMPI_CONTIGUOUS_STAGED forces the staged transport for 1-D types;
+    AUTO consults the staged-vs-direct model (reference type_commit.cpp:52-73,
+    sender.cpp:34-86)."""
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.utils import counters as ctr
+    from tempi_tpu.utils import env as envmod
+    from tempi_tpu.parallel import p2p as p2p_mod
+
+    ty = dt.contiguous(512, dt.BYTE)
+    sbuf, rows = fill(world, 512)
+    rbuf = world.alloc(512)
+
+    monkeypatch.setenv("TEMPI_CONTIGUOUS_STAGED", "1")
+    envmod.read_environment()
+    s0 = ctr.counters.send.num_staged
+    api.isend(world, 0, sbuf, 1, ty)
+    api.irecv(world, 1, rbuf, 0, ty)
+    p2p_mod.try_progress(world)
+    assert ctr.counters.send.num_staged == s0 + 1
+    np.testing.assert_array_equal(rbuf.get_rank(1), rows[0])
+
+    # AUTO with curves that make the direct path win
+    monkeypatch.delenv("TEMPI_CONTIGUOUS_STAGED")
+    monkeypatch.setenv("TEMPI_CONTIGUOUS_AUTO", "1")
+    envmod.read_environment()
+    sp = msys.SystemPerformance()
+    sp.d2h = sp.h2d = [(1, 1.0), (1 << 23, 1.0)]
+    sp.host_pingpong = [(1, 1.0), (1 << 23, 1.0)]
+    sp.intra_node_pingpong = [(1, 1e-6), (1 << 23, 1e-6)]
+    msys.set_system(sp)
+    world.__dict__.pop("_strategy_cache", None)
+    d0 = ctr.counters.send.num_device
+    api.isend(world, 2, sbuf, 3, ty)
+    api.irecv(world, 3, rbuf, 2, ty)
+    p2p_mod.try_progress(world)
+    assert ctr.counters.send.num_device == d0 + 1
+    msys.set_system(msys.SystemPerformance())
