@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Block-diagonal communication pattern across the pattern methods.
+
+Re-design of /root/reference/bin/bench_mpi_pattern_blockdiagonal.cpp: a
+block-diagonal counts matrix (random block sizes in [0,6), values in
+[1,10) x scale, support/squaremat.cpp make_block_diagonal) is executed by
+every pattern method (alltoallv, isend/irecv, sparse isend/irecv,
+reorder+neighbor_alltoallv) over scales 1..1M, reporting the min iteration
+time and aggregate MiB/s per (method, scale) like the reference's CSV.
+
+The block structure is the placement-friendly case: traffic clusters on the
+diagonal, so the reorder method's remap can keep whole blocks on one node.
+"""
+
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def make_block_diagonal(size, b_lb, b_ub, lb, ub, scale, seed=101):
+    """Random-size diagonal blocks of random values (make_block_diagonal,
+    support/squaremat.cpp:77-107)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    mat = np.zeros((size, size), dtype=np.int64)
+    d = 0
+    while d < size:
+        bsz = int(rng.integers(b_lb, b_ub))
+        if d + bsz >= size:
+            bsz = size - d
+        if bsz > 0:
+            mat[d:d + bsz, d:d + bsz] = rng.integers(
+                lb, ub, (bsz, bsz)) * scale
+        d += max(bsz, 1)
+    np.fill_diagonal(mat, 0)  # self traffic is not communication
+    return mat
+
+
+def run_patterns(permute: bool) -> int:
+    p = base_parser("block-diagonal pattern methods")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--scales", type=int, nargs="*",
+                   default=[1, 10, 100, 1000, 10 * 1000, 100 * 1000,
+                            1000 * 1000])
+    p.add_argument("--ranks-per-node", type=int, default=2)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import os
+
+    import numpy as np
+
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+
+    from method import (MethodAlltoallv, MethodIsendIrecv,
+                        MethodNeighborAlltoallv, MethodSparseIsendIrecv)
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+
+    devices_or_die(1)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    scales = args.scales[:3] if args.quick else args.scales
+
+    rows = []
+    for scale in scales:
+        mat = make_block_diagonal(size, 0, 6, 1, 10, scale)
+        if permute:
+            # destroy the block locality with a fixed shuffle
+            # (bench_mpi_pattern_permblockdiagonal.cpp: make_permutation)
+            perm = np.random.default_rng(0).permutation(size)
+            mat = mat[np.ix_(perm, perm)]
+        num_bytes = int(mat.sum())
+        methods = [
+            ("alltoallv", lambda: MethodAlltoallv(comm, mat)),
+            ("isend_irecv", lambda: MethodIsendIrecv(comm, mat)),
+            ("sparse_isend_irecv",
+             lambda: MethodSparseIsendIrecv(comm, mat)),
+            ("reorder_neighbor_alltoallv",
+             lambda: MethodNeighborAlltoallv(comm, mat, reorder=True)),
+        ]
+        for name, make in methods:
+            m = make()
+            m.run()  # compile
+            r = benchmark(m.run, **kw)
+            t_min = r.stats.min()
+            rows.append((f"{name}|{scale}", name, scale, num_bytes, t_min,
+                         num_bytes / 1024 / 1024 / t_min))
+    emit_csv(("description", "name", "scale", "B", "min_iter_s",
+              "agg_MiB_per_s"), rows)
+    api.finalize()
+    return 0
+
+
+def main() -> int:
+    return run_patterns(permute=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

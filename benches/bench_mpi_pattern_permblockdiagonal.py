@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Permuted block-diagonal pattern across the pattern methods.
+
+Re-design of /root/reference/bin/bench_mpi_pattern_permblockdiagonal.cpp:
+identical to bench_mpi_pattern_blockdiagonal except the counts matrix is
+shuffled by a fixed permutation (support/squaremat.cpp make_permutation), so
+block locality is destroyed — the case where reorder+neighbor_alltoallv's
+rank remap must re-discover the hidden block structure to win.
+"""
+
+import sys
+
+from bench_mpi_pattern_blockdiagonal import run_patterns
+
+
+def main() -> int:
+    return run_patterns(permute=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

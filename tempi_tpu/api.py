@@ -30,9 +30,18 @@ def init(devices=None) -> Communicator:
         return _world
     envmod.read_environment()
     counters.init()
-    log.world_rank = 0  # single controller drives all ranks
     if devices is None:
+        # multi-host path (SURVEY §5 backend trait (b)): join the
+        # jax.distributed world first so jax.devices() spans every host.
+        # A no-op without a configured coordinator; with one configured, a
+        # failure is FATAL — continuing would run N independent single-host
+        # worlds whose matched sends silently pair the wrong ranks.
+        from .parallel import multihost
+        pidx, _ = multihost.init_distributed()
+        log.world_rank = pidx
         devices = jax.devices()
+    else:
+        log.world_rank = 0  # single controller drives all ranks
     _world = Communicator(devices)
     type_cache.init()
     if envmod.env.progress_thread:

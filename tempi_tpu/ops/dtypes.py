@@ -88,11 +88,13 @@ class Datatype:
         if c == CONTIGUOUS:
             return np.arange(p["count"], dtype=np.int64) * oe
         if c == VECTOR:
-            blk = np.arange(p["count"], dtype=np.int64) * (p["stride"] * oe)
+            blk = (np.arange(p["count"], dtype=np.int64) * (p["stride"] * oe)
+                   - p.get("lb", 0))
             elem = np.arange(p["blocklength"], dtype=np.int64) * oe
             return (blk[:, None] + elem[None, :]).reshape(-1)
         if c == HVECTOR:
-            blk = np.arange(p["count"], dtype=np.int64) * p["stride"]
+            blk = (np.arange(p["count"], dtype=np.int64) * p["stride"]
+                   - p.get("lb", 0))
             elem = np.arange(p["blocklength"], dtype=np.int64) * oe
             return (blk[:, None] + elem[None, :]).reshape(-1)
         if c == SUBARRAY:
@@ -167,27 +169,40 @@ def contiguous(count: int, oldtype: Datatype) -> Datatype:
                     {"count": count, "oldtype": oldtype})
 
 
+def _vector_bounds(count: int, blocklength: int, stride_bytes: int,
+                   old_extent: int):
+    """MPI lb/extent for a (h)vector with any stride sign/overlap: block i
+    starts at i*stride_bytes; lb = min start, ub = max start + block bytes
+    (MPI-3.1 §4.1.7; the reference decodes these too, types.cpp:56-167)."""
+    blk = blocklength * old_extent
+    last = (count - 1) * stride_bytes
+    lb = min(0, last)
+    ub = max(0, last) + blk
+    return lb, max(0, ub - lb)
+
+
 def vector(count: int, blocklength: int, stride: int,
            oldtype: Datatype) -> Datatype:
-    """stride in elements of oldtype (MPI_Type_vector)."""
-    assert count >= 1 and blocklength >= 0 and stride >= blocklength, \
-        "only non-overlapping forward vectors are supported"
-    extent = ((count - 1) * stride + blocklength) * oldtype.extent
+    """stride in elements of oldtype (MPI_Type_vector). Negative and
+    overlapping strides are allowed; the datatype origin is the LOWEST byte
+    touched (lb folded in), so buffers index from 0."""
+    assert count >= 1 and blocklength >= 0
+    lb, extent = _vector_bounds(count, blocklength, stride * oldtype.extent,
+                                oldtype.extent)
     return Datatype(VECTOR, extent, count * blocklength * oldtype.size,
                     {"count": count, "blocklength": blocklength,
-                     "stride": stride, "oldtype": oldtype})
+                     "stride": stride, "oldtype": oldtype, "lb": lb})
 
 
 def hvector(count: int, blocklength: int, stride: int,
             oldtype: Datatype) -> Datatype:
-    """stride in bytes (MPI_Type_create_hvector)."""
+    """stride in bytes (MPI_Type_create_hvector). Negative and overlapping
+    strides are allowed (see vector)."""
     assert count >= 1 and blocklength >= 0
-    assert stride >= blocklength * oldtype.extent, \
-        "only non-overlapping forward hvectors are supported"
-    extent = (count - 1) * stride + blocklength * oldtype.extent
+    lb, extent = _vector_bounds(count, blocklength, stride, oldtype.extent)
     return Datatype(HVECTOR, extent, count * blocklength * oldtype.size,
                     {"count": count, "blocklength": blocklength,
-                     "stride": stride, "oldtype": oldtype})
+                     "stride": stride, "oldtype": oldtype, "lb": lb})
 
 
 def subarray(sizes: Sequence[int], subsizes: Sequence[int],

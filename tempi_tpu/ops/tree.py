@@ -111,6 +111,13 @@ def traverse(datatype: dtypes.Datatype) -> Optional[TypeTree]:
         # block (types.cpp:56-111 for vector, :113-167 for hvector)
         stride_bytes = (p["stride"] * old.extent if c == dtypes.VECTOR
                         else p["stride"])
+        if stride_bytes < p["blocklength"] * old.extent:
+            # negative or overlapping stride: a valid MPI type (decoded by
+            # the reference too), but the strided pack planner only models
+            # forward non-overlapping blocks — the typemap fallback packs it
+            log.spew(f"{c} stride {stride_bytes}B overlaps/reverses; "
+                     "using the typemap fallback")
+            return None
         child = TypeTree(
             StreamData(off=0, stride=old.extent, count=p["blocklength"]),
             children=[gchild])

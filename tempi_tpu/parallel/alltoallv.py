@@ -106,42 +106,36 @@ def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
             lsd[lr, lp] = sd[ar, pr]
             lrd[lr, lp] = rd[ar, pr]
 
+    # Vectorized ragged layout: the count/displacement tables are device
+    # arrays indexed by the traced rank, so the program is ONE masked gather,
+    # ONE fused all_to_all, and ONE masked scatter regardless of mesh size —
+    # no per-rank lax.switch branches (the round-1 design unrolled
+    # O(size^2) pad/slice branches and blew up compile time past 8 ranks).
+    LSC = jnp.asarray(lsc)
+    LSD = jnp.asarray(lsd)
+    LRD = jnp.asarray(lrd)
+
     def step(s, r):
         sloc = s.reshape(-1)
         rloc = r.reshape(-1)
         me = jax.lax.axis_index(AXIS)
-
-        def gather_branch(rank):
-            def f(x):
-                rows = [
-                    jax.lax.pad(
-                        x[lsd[rank, j]: lsd[rank, j] + lsc[rank, j]],
-                        jnp.zeros((), jnp.uint8),
-                        [(0, M - int(lsc[rank, j]), 0)])
-                    for j in range(size)
-                ]
-                return jnp.stack(rows)
-            return f
-
-        out = jax.lax.switch(me, [gather_branch(k) for k in range(size)],
-                             sloc)
+        k = jnp.arange(M)
+        # rows for each destination j: sloc[lsd[me,j] : +lsc[me,j]], padded
+        idx = LSD[me][:, None] + k[None, :]
+        mask = k[None, :] < LSC[me][:, None]
+        out = jnp.where(mask,
+                        sloc[jnp.clip(idx, 0, sloc.shape[0] - 1)],
+                        jnp.uint8(0))
         # one fused collective: row j of ``out`` goes to rank j; received
         # row i comes from rank i
         got = jax.lax.all_to_all(out, AXIS, split_axis=0, concat_axis=0,
                                  tiled=True)
-
-        def scatter_branch(rank):
-            def f(g, x):
-                for i in range(size):
-                    n = int(lsc[i, rank])
-                    if n:
-                        x = jax.lax.dynamic_update_slice(
-                            x, g[i, :n], (lrd[rank, i],))
-                return x
-            return f
-
-        rloc = jax.lax.switch(me, [scatter_branch(k) for k in range(size)],
-                              got, rloc)
+        # scatter row i at lrd[me,i], first lsc[i,me] bytes; masked-out
+        # lanes point past the buffer and are dropped
+        pos = LRD[me][:, None] + k[None, :]
+        rmask = k[None, :] < LSC[:, me][:, None]
+        pos = jnp.where(rmask, pos, rloc.shape[0])
+        rloc = rloc.at[pos.reshape(-1)].set(got.reshape(-1), mode="drop")
         return rloc.reshape(1, -1)
 
     fn = comm._plan_cache.get(("a2av", M, sendbuf.nbytes, recvbuf.nbytes,

@@ -1,0 +1,116 @@
+"""Multi-host (DCN) backend scaffolding.
+
+SURVEY §5 names two backend traits for the distributed communication layer:
+(a) in-process multi-device over ICI (the default everywhere in this tree)
+and (b) multi-host over DCN via ``jax.distributed`` — the analog of the
+reference reaching a network-capable MPI through its dlsym table
+(/root/reference/src/internal/symbols.cpp:23-51). This module is trait (b):
+
+* ``init_distributed`` wires ``jax.distributed.initialize`` into the
+  framework's init path. After it runs, ``jax.devices()`` spans every host,
+  each device carries its owning ``process_index``, and the topology layer
+  (parallel/topology.py ``_node_keys``) labels process boundaries as node
+  (DCN) boundaries with no further changes — colocated queries, the {1,5}
+  distance hierarchy, and the staged/oneshot off-node transports all follow.
+
+* ``dryrun_dcn`` is the documented no-hardware rehearsal: a CPU mesh split
+  into simulated nodes (TEMPI_RANKS_PER_NODE), driving a boundary-crossing
+  exchange over the staged host transport — the same code path DCN traffic
+  takes, minus the wire.
+
+This cannot be hardware-tested in a single-host environment; the seam is
+deliberately thin so a real multi-host launch only needs the coordinator
+address.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..utils import logging as log
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> Tuple[int, int]:
+    """Join (or skip joining) a multi-host JAX world.
+
+    Explicit arguments win; otherwise ``TEMPI_COORDINATOR`` /
+    ``TEMPI_NUM_PROCESSES`` / ``TEMPI_PROCESS_ID`` are consulted (falling
+    back to JAX's own ``JAX_COORDINATOR_ADDRESS`` convention). With no
+    coordinator configured this is a no-op — the single-host path.
+    Returns (process_index, process_count)."""
+    global _initialized
+    import jax
+
+    addr = (coordinator_address
+            or os.environ.get("TEMPI_COORDINATOR")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if addr and not _initialized:
+        def _int_env(name):
+            v = os.environ.get(name)
+            return int(v) if v else None
+
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=(num_processes
+                           if num_processes is not None
+                           else _int_env("TEMPI_NUM_PROCESSES")),
+            process_id=(process_id if process_id is not None
+                        else _int_env("TEMPI_PROCESS_ID")))
+        _initialized = True
+        log.debug(f"joined multi-host world at {addr}: "
+                  f"process {jax.process_index()}/{jax.process_count()}")
+    return jax.process_index(), jax.process_count()
+
+
+def dryrun_dcn(ranks_per_node: int = 4) -> dict:
+    """Simulated-DCN rehearsal on the current (CPU) mesh: split the devices
+    into nodes of ``ranks_per_node``, send a message across the node
+    boundary on the staged transport, and report what moved. Returns a
+    summary dict (num_nodes, offnode pairs exercised, ok)."""
+    import numpy as np
+
+    from .. import api
+    from ..ops import dtypes as dt
+    from ..utils import env as envmod
+    from . import p2p
+
+    os.environ["TEMPI_RANKS_PER_NODE"] = str(ranks_per_node)
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        if comm.num_nodes < 2:
+            return dict(num_nodes=comm.num_nodes, pairs=0, ok=False,
+                        reason=f"{comm.size} devices can't split into "
+                               f"nodes of {ranks_per_node}")
+        ty = dt.contiguous(256, dt.BYTE)
+        sbuf = comm.buffer_from_host(
+            [np.full(256, r + 1, np.uint8) for r in range(comm.size)])
+        rbuf = comm.alloc(256)
+        # every rank sends to its cross-node mirror
+        pairs = 0
+        reqs = []
+        for r in range(comm.size):
+            peer = (r + ranks_per_node) % comm.size
+            if comm.is_colocated(comm.library_rank(r),
+                                 comm.library_rank(peer)):
+                continue
+            pairs += 1
+            reqs.append(p2p.isend(comm, r, sbuf, peer, ty))
+            reqs.append(p2p.irecv(comm, peer, rbuf, r, ty))
+        p2p.try_progress(comm, strategy="staged")  # the DCN transport
+        p2p.waitall(reqs)
+        ok = all(
+            bool((rbuf.get_rank((r + ranks_per_node) % comm.size)
+                  == r + 1).all())
+            for r in range(comm.size)
+            if not comm.is_colocated(
+                comm.library_rank(r),
+                comm.library_rank((r + ranks_per_node) % comm.size)))
+        return dict(num_nodes=comm.num_nodes, pairs=pairs, ok=ok)
+    finally:
+        api.finalize()

@@ -72,13 +72,13 @@ class Op:
 
 def _packer_for(datatype: Datatype):
     rec = type_cache.get_or_commit(datatype)
-    return rec.best_packer()
+    return rec.best_packer(), rec
 
 
 def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
           offset: int) -> Request:
-    packer = _packer_for(datatype)
+    packer, rec = _packer_for(datatype)
     req = Request(next(_req_ids), comm, buf=buf)
     op = Op(kind=kind, rank=comm.library_rank(app_rank),
             peer=comm.library_rank(peer_app), tag=tag, buf=buf, offset=offset,
@@ -94,6 +94,10 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
     progress.notify(comm)
     group = ctr.counters.isend if kind == "send" else ctr.counters.irecv
     group.num_device += 1
+    if packer is rec.fallback and rec.packer is not None:
+        # a plannable type forced onto the typemap fallback (TEMPI_NO_PACK
+        # or backend gate) — the reference counts SendRecvFallback sends
+        group.num_fallback += 1
     return req
 
 
@@ -162,6 +166,26 @@ def _match(pending: List[Op]):
     return messages, consumed, leftover
 
 
+def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str]:
+    """Shared decision cache for model-driven strategy picks: ``models`` is
+    an ordered {strategy: thunk-returning-seconds} dict (first entry wins
+    ties). Returns the cached or freshly modeled winner, or None when every
+    model is infinite (unmeasured system — caller decides the default)."""
+    cache = comm.__dict__.setdefault("_strategy_cache", {})
+    hit = cache.get(key)
+    if hit is not None:
+        ctr.counters.modeling.cache_hit += 1
+        return hit
+    ctr.counters.modeling.cache_miss += 1
+    with ctr.timed(ctr.counters.modeling, "wall_time"):
+        times = {name: fn() for name, fn in models.items()}
+    if not any(t < math.inf for t in times.values()):
+        return None
+    choice = min(times, key=times.get)
+    cache[key] = choice
+    return choice
+
+
 def choose_strategy_message(comm: Communicator, m: Message) -> str:
     """Per-MESSAGE strategy: DEVICE/ONESHOT forced by env; AUTO asks the
     measured model, with the decision cached per {colocated, bytes,
@@ -181,20 +205,14 @@ def choose_strategy_message(comm: Communicator, m: Message) -> str:
             try:
                 from ..measure import system as msys
                 colocated = comm.is_colocated(m.src, m.dst)
-                cache = comm.__dict__.setdefault("_strategy_cache", {})
-                key = ("1d", colocated, m.nbytes)
-                hit = cache.get(key)
-                if hit is not None:
-                    ctr.counters.modeling.cache_hit += 1
-                    return hit
-                ctr.counters.modeling.cache_miss += 1
-                with ctr.timed(ctr.counters.modeling, "wall_time"):
-                    t_staged = msys.model_staged_1d(m.nbytes)
-                    t_direct = msys.model_direct_1d(m.nbytes, colocated)
-                if t_staged < math.inf or t_direct < math.inf:
-                    choice = "staged" if t_staged < t_direct else "device"
-                    cache[key] = choice
+                choice = _cached_model_choice(
+                    comm, ("1d", colocated, m.nbytes),
+                    {"device": lambda: msys.model_direct_1d(m.nbytes,
+                                                            colocated),
+                     "staged": lambda: msys.model_staged_1d(m.nbytes)})
+                if choice is not None:
                     return choice
+                # unmeasured: fall through to the TEMPI_DATATYPE logic
             except Exception as e:
                 ctr.counters.send.num_fallback += 1
                 log.warn(f"contiguous model failed for {m.nbytes}B; "
@@ -210,22 +228,12 @@ def choose_strategy_message(comm: Communicator, m: Message) -> str:
         from ..measure import system as msys
         colocated = comm.is_colocated(m.src, m.dst)
         block = min(max(_block_length(m), 1), 512)
-        cache = comm.__dict__.setdefault("_strategy_cache", {})
-        key = (colocated, m.nbytes, block)
-        hit = cache.get(key)
-        if hit is not None:
-            ctr.counters.modeling.cache_hit += 1
-            return hit
-        ctr.counters.modeling.cache_miss += 1
-        with ctr.timed(ctr.counters.modeling, "wall_time"):
-            t_dev = msys.model_device(m.nbytes, block, colocated)
-            t_one = msys.model_oneshot(m.nbytes, block, colocated)
-        if not (t_dev < math.inf or t_one < math.inf):
-            choice = "device"  # no curves at all: unmeasured system
-        else:
-            choice = "oneshot" if t_one < t_dev else "device"
-        cache[key] = choice
-        return choice
+        choice = _cached_model_choice(
+            comm, (colocated, m.nbytes, block),
+            {"device": lambda: msys.model_device(m.nbytes, block, colocated),
+             "oneshot": lambda: msys.model_oneshot(m.nbytes, block,
+                                                   colocated)})
+        return choice if choice is not None else "device"
     except Exception as e:
         # a broken model/cache must be visible, not indistinguishable from
         # a decision (round-1 review finding)

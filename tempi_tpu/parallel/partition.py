@@ -226,10 +226,14 @@ def process_mapping(csr: Csr, dist: np.ndarray, seed: int = 0,
     if n <= 1:
         return np.zeros(n, dtype=np.int64), 0
     W = _dense_weights(csr)
-    best_slot, best_obj = None, None
+    # the identity permutation is always a candidate start, so the returned
+    # mapping can never be worse than not reordering at all
+    starts = [np.arange(n, dtype=np.int64)]
     for s in range(nseeds):
         rng = np.random.default_rng(seed + s)
-        slot_of = _greedy_place(W, dist, rng)
+        starts.append(_greedy_place(W, dist, rng))
+    best_slot, best_obj = None, None
+    for slot_of in starts:
         slot_of, obj = _swap_refine(W, dist, slot_of, max_swaps=4 * n)
         if best_obj is None or obj < best_obj:
             best_slot, best_obj = slot_of, obj

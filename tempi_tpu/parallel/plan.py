@@ -201,7 +201,9 @@ class ExchangePlan:
         """Execute fully on-device (DEVICE strategy)."""
         if self._device_fn is None:
             self._device_fn = self._build_device_fn()
-        outs = self._device_fn(*[b.data for b in self.bufs])
+        ctr.counters.device.num_launches += 1
+        with ctr.timed(ctr.counters.device, "launch_time"):
+            outs = self._device_fn(*[b.data for b in self.bufs])
         for b, o in zip(self.bufs, outs):
             b.data = o
 
@@ -277,11 +279,15 @@ class ExchangePlan:
                     return self.run_staged(host_kind=None)
             else:
                 payload = pf(*datas)
-            host = np.asarray(payload)            # D2H (packed bytes only)
+            ctr.counters.device.num_transfers += 1
+            with ctr.timed(ctr.counters.device, "transfer_time"):
+                host = np.asarray(payload)        # D2H (packed bytes only)
             moved = self._staging_for(host.shape, host.dtype)
             for m in rnd:                          # host-side transport
                 moved[m.dst, : m.nbytes] = host[m.src, : m.nbytes]
-            dev = jax.device_put(moved, comm.sharding())   # H2D
+            ctr.counters.device.num_transfers += 1
+            with ctr.timed(ctr.counters.device, "transfer_time"):
+                dev = jax.device_put(moved, comm.sharding())   # H2D
             self._staging_inflight = dev
             datas = list(uf(dev, *datas))
         for b, d in zip(self.bufs, datas):
@@ -330,7 +336,12 @@ class ExchangePlan:
         from ..runtime import events
         scope = events.kern_stream if strategy == "device" \
             else events.comm_stream
-        with scope(), jax.named_scope(f"tempi.exchange.{strategy}"):
+        # lib counters: time spent inside the "underlying library" — here
+        # the compiled XLA programs the exchange dispatches into (reference
+        # counts time under libmpi calls, counters.hpp libCalls)
+        ctr.counters.lib.num_calls += 1
+        with scope(), jax.named_scope(f"tempi.exchange.{strategy}"), \
+                ctr.timed(ctr.counters.lib, "wall_time"):
             if strategy == "device":
                 ctr.counters.send.num_device += len(self.messages)
                 self.run_device()

@@ -71,23 +71,24 @@ class Topology:
 
     def distance_matrix(self) -> np.ndarray:
         """Pairwise placement distances: torus hops within a node (1 when no
-        coords are known), DCN_FACTOR x diameter across nodes."""
-        n = len(self.node_of_rank)
+        coords are known), DCN_FACTOR x diameter across nodes. Vectorized —
+        the reorder path calls this once per dist-graph creation and pod
+        scale is n^2 pairs."""
+        node = np.asarray(self.node_of_rank)
+        n = len(node)
         if self.coords is not None:
-            diam = max(1, sum(d // 2 for d in self.torus_dims))
+            dims = np.asarray(self.torus_dims, dtype=np.int64)
+            diam = max(1, int((dims // 2).sum()))
+            c = np.asarray(self.coords, dtype=np.int64)
+            d = np.abs(c[:, None, :] - c[None, :, :])
+            hops = np.minimum(d, dims[None, None, :] - d).sum(axis=-1)
+            intra = np.maximum(hops, 1)
         else:
             diam = 1
-        dcn = DCN_FACTOR * diam
-        dist = np.zeros((n, n), dtype=np.int64)
-        for a in range(n):
-            for b in range(a + 1, n):
-                if self.node_of_rank[a] != self.node_of_rank[b]:
-                    d = dcn
-                elif self.coords is not None:
-                    d = max(1, self.ici_hops(a, b))
-                else:
-                    d = 1
-                dist[a, b] = dist[b, a] = d
+            intra = np.ones((n, n), dtype=np.int64)
+        dist = np.where(node[:, None] != node[None, :],
+                        DCN_FACTOR * diam, intra).astype(np.int64)
+        np.fill_diagonal(dist, 0)
         return dist
 
 
@@ -116,8 +117,12 @@ def _device_coords(devices: Sequence):
     if len(devices) > 1 and all(
             c is not None and len(c) > 0 for c in coords):
         arr = np.asarray(coords, dtype=np.int64)
+        # normalize to the slice origin: a slice carved out of a pod keeps
+        # pod-space coords, and sizing the torus by raw max+1 would inflate
+        # the wrap distance everywhere
+        arr = arr - arr.min(axis=0)
         dims = tuple(int(arr[:, k].max()) + 1 for k in range(arr.shape[1]))
-        return [tuple(map(int, c)) for c in coords], dims
+        return [tuple(map(int, c)) for c in arr], dims
     shape = envmod.env.torus
     if shape:
         if int(np.prod(shape)) < len(devices):

@@ -142,3 +142,57 @@ def test_neighbor_alltoallw_types(world):
     for r in range(size):
         want = st.oracle_pack(rows[(r - 1) % size], ty, 1)
         np.testing.assert_array_equal(rbuf.get_rank(r), want)
+
+
+def test_alltoallv_32_ranks_compiles_fast():
+    """Config-5 scale (32 ranks): the vectorized device_fused program must
+    compile in seconds, not minutes (round-1's branch-unrolled design was
+    O(size^2) in program size). Runs in a subprocess so the 32-device CPU
+    mesh doesn't disturb this process's 8-device world."""
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import time
+        from tempi_tpu.utils.platform import force_cpu
+        force_cpu(device_count=32)
+        import numpy as np
+        from tempi_tpu import api
+        comm = api.init()
+        size = comm.size
+        rng = np.random.default_rng(0)
+        counts = rng.integers(0, 64, (size, size))
+        sdis = np.zeros_like(counts); rdis = np.zeros_like(counts)
+        for r in range(size):
+            sdis[r] = np.concatenate([[0], np.cumsum(counts[r][:-1])])
+            rdis[r] = np.concatenate([[0], np.cumsum(counts.T[r][:-1])])
+        nb = int(max(counts.sum(1).max(), counts.sum(0).max()))
+        sbuf = comm.buffer_from_host(
+            [rng.integers(0, 256, nb, np.uint8) for _ in range(size)])
+        rbuf = comm.alloc(nb)
+        t0 = time.perf_counter()
+        api.alltoallv(comm, sbuf, counts, sdis, rbuf, counts.T, rdis)
+        rbuf.data.block_until_ready()
+        compile_s = time.perf_counter() - t0
+        # oracle
+        host_s = [sbuf.get_rank(r) for r in range(size)]
+        for r in range(size):
+            got = rbuf.get_rank(r)
+            for i in range(size):
+                n = counts[i, r]
+                a = got[rdis[r, i]: rdis[r, i] + n]
+                b = host_s[i][sdis[i, r]: sdis[i, r] + n]
+                assert np.array_equal(a, b), (r, i)
+        print(f"COMPILE_S={compile_s:.2f}")
+        api.finalize()
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300,
+                       env={**__import__("os").environ,
+                            "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("COMPILE_S=")]
+    compile_s = float(line[0].split("=")[1])
+    print(f"32-rank alltoallv compile+run: {compile_s:.2f}s")
+    assert compile_s < 60, f"compile too slow: {compile_s:.1f}s"

@@ -1,0 +1,32 @@
+"""Multi-host backend scaffolding (SURVEY §5 backend trait (b)).
+
+Real DCN can't be exercised in a single-host environment; these tests pin
+down the seam: the no-op single-host path through init_distributed, and the
+simulated-DCN dryrun that drives a node-boundary exchange over the staged
+transport (the code path DCN traffic takes)."""
+
+import pytest
+
+from tempi_tpu.parallel import multihost
+
+
+def test_init_distributed_single_host_noop(monkeypatch):
+    monkeypatch.delenv("TEMPI_COORDINATOR", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    pidx, pcount = multihost.init_distributed()
+    assert pidx == 0 and pcount == 1
+    assert not multihost._initialized
+
+
+def test_dryrun_dcn(monkeypatch):
+    out = multihost.dryrun_dcn(ranks_per_node=4)
+    assert out["num_nodes"] == 2
+    assert out["pairs"] == 8  # every rank's mirror is off-node
+    assert out["ok"]
+
+
+def test_dryrun_dcn_degenerate(monkeypatch):
+    """ranks_per_node >= device count: one node, dryrun reports why."""
+    out = multihost.dryrun_dcn(ranks_per_node=64)
+    assert out["num_nodes"] == 1
+    assert not out["ok"] and "can't split" in out["reason"]

@@ -96,3 +96,60 @@ def test_event_tracks_device_array():
 def test_event_leak_detected():
     events.request()
     assert events._pool.finalize() == 1
+
+
+def test_exchange_counters_wired():
+    """Device launch/transfer and lib-call counters increment on the hot
+    paths (round-1 finding: several fields were never incremented)."""
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.utils import counters as ctr
+
+    comm = api.init()
+    try:
+        ty = dt.contiguous(64, dt.BYTE)
+        s = comm.buffer_from_host(
+            [np.full(64, r, np.uint8) for r in range(comm.size)])
+        r_ = comm.alloc(64)
+        c = ctr.counters
+        l0, t0, lib0 = (c.device.num_launches, c.device.num_transfers,
+                        c.lib.num_calls)
+        api.isend(comm, 0, s, 1, ty)
+        api.irecv(comm, 1, r_, 0, ty)
+        p2p.try_progress(comm, strategy="device")
+        assert c.device.num_launches == l0 + 1
+        assert c.lib.num_calls == lib0 + 1
+        assert c.device.launch_time > 0 and c.lib.wall_time > 0
+        api.isend(comm, 2, s, 3, ty)
+        api.irecv(comm, 3, r_, 2, ty)
+        p2p.try_progress(comm, strategy="staged")
+        assert c.device.num_transfers >= t0 + 2
+        assert c.device.transfer_time > 0
+    finally:
+        api.finalize()
+
+
+def test_fallback_packer_counter(monkeypatch):
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.utils import counters as ctr
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.setenv("TEMPI_NO_PACK", "1")
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        ty = dt.vector(4, 8, 32, dt.BYTE)  # plannable, but NO_PACK forces
+        s = comm.buffer_from_host(         # the typemap fallback
+            [np.zeros(ty.extent, np.uint8) for _ in range(comm.size)])
+        f0 = ctr.counters.isend.num_fallback
+        req = api.isend(comm, 0, s, 1, ty)
+        assert ctr.counters.isend.num_fallback == f0 + 1
+        comm._pending.clear()
+    finally:
+        api.finalize()

@@ -5,6 +5,7 @@ test/type_commit.cpp): equivalent spellings of an object canonicalize to the
 same StridedBlock, and every factory type commits cleanly.
 """
 
+import numpy as np
 import pytest
 
 import support_types as st
@@ -131,3 +132,39 @@ def test_commit_respects_no_type_commit(monkeypatch):
     rec = type_cache.commit(st.make_2d_byte_vector(4, 8, 32))
     assert rec.packer is None and rec.fallback is not None
     type_cache.clear()
+
+
+def test_negative_stride_vector_packs_via_fallback():
+    """MPI allows negative vector strides (reference decodes them,
+    types.cpp:56-167). The origin is the lowest byte touched: vector(3, 2,
+    stride=-4) has blocks at byte offsets 8, 4, 0 in pack order."""
+    import jax.numpy as jnp
+
+    from tempi_tpu.ops import type_cache
+
+    ty = dt.vector(3, 2, -4, dt.BYTE)
+    assert ty.extent == 10 and ty.size == 6
+    rec = type_cache.commit(ty)
+    assert rec.packer is None  # strided planner declines; typemap packs
+    src = np.arange(10, dtype=np.uint8)
+    got = np.asarray(rec.best_packer().pack(jnp.asarray(src), 1))
+    np.testing.assert_array_equal(got, [8, 9, 4, 5, 0, 1])
+    out = np.asarray(rec.best_packer().unpack(
+        jnp.zeros(10, jnp.uint8), jnp.asarray(got), 1))
+    want = np.zeros(10, np.uint8)
+    want[[8, 9, 4, 5, 0, 1]] = [8, 9, 4, 5, 0, 1]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_overlapping_hvector_packs_via_fallback():
+    """Overlapping strides re-read source bytes (legal for pack)."""
+    import jax.numpy as jnp
+
+    from tempi_tpu.ops import type_cache
+
+    ty = dt.hvector(2, 4, 2, dt.BYTE)
+    assert ty.extent == 6 and ty.size == 8
+    rec = type_cache.commit(ty)
+    src = np.arange(6, dtype=np.uint8)
+    got = np.asarray(rec.best_packer().pack(jnp.asarray(src), 1))
+    np.testing.assert_array_equal(got, [0, 1, 2, 3, 2, 3, 4, 5])
