@@ -53,19 +53,38 @@ def _probe_once(timeout_s: int) -> bool:
 
 
 def _accelerator_usable() -> bool:
-    """Retry with backoff: a tunnel that is down at capture time often comes
-    back within minutes, and one 120 s shot forfeits the whole round's TPU
-    evidence (round-1 failure mode)."""
-    plan = [(90, 15), (90, 30), (120, 60), (120, 120), (180, 0)]
-    for i, (timeout_s, sleep_s) in enumerate(plan):
+    """Retry with backoff under a total time budget: a tunnel that is down
+    at capture time often comes back within minutes, and one 120 s shot
+    forfeits the whole round's TPU evidence (round-1 failure mode) — but
+    unbounded retries risk blowing the driver's own timeout and losing even
+    the CPU-fallback line. TEMPI_BENCH_PROBE_BUDGET (seconds) bounds it."""
+    import os
+
+    try:
+        budget = float(os.environ.get("TEMPI_BENCH_PROBE_BUDGET", "300"))
+    except ValueError:
+        budget = 300.0  # malformed knob must not cost the JSON line
+    deadline = time.monotonic() + budget
+    attempt, sleep_s = 0, 10
+    probe_timeouts = [90, 90, 120, 120, 180]  # slow tunnels need >90 s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            return False
+        attempt += 1
+        want = probe_timeouts[min(attempt - 1, len(probe_timeouts) - 1)]
+        timeout_s = int(min(want, remaining))
         if _probe_once(timeout_s):
             return True
-        print(f"accelerator probe {i + 1}/{len(plan)} failed "
-              f"(timeout {timeout_s}s); retrying in {sleep_s}s",
-              file=sys.stderr)
-        if sleep_s:
-            time.sleep(sleep_s)
-    return False
+        remaining = deadline - time.monotonic()
+        print(f"accelerator probe {attempt} failed (timeout {timeout_s}s); "
+              f"{remaining:.0f}s of probe budget left", file=sys.stderr)
+        if remaining - 5 <= 5:
+            return False  # no room for another attempt after any sleep
+        # at least 5 s between attempts (an instant probe failure must not
+        # busy-spin the budget away), never sleeping past the deadline
+        time.sleep(max(5.0, min(sleep_s, remaining - 5)))
+        sleep_s = min(sleep_s * 2, 60)
 
 
 def bench_pack(jax, devices):
