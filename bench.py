@@ -181,6 +181,53 @@ def bench_halo(jax, n_devices: int, quick: bool):
     return iters / dt_s, f"X={X} ranks={comm.size} periodic={periodic}"
 
 
+def bench_alltoallv_sparse(jax, quick: bool, reorder: bool):
+    """Random sparse alltoallv time, optionally after the KaHIP remap
+    (BASELINE configs 4/5 shape). Needs >= 8 devices to mean anything."""
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils.env import PlacementMethod
+
+    comm = api.comm_world()
+    if comm.size < 8:
+        raise RuntimeError(f"needs >= 8 ranks, have {comm.size}")
+    size = comm.size
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 1 << 12, (size, size))
+    counts[rng.random((size, size)) > 0.3] = 0
+    np.fill_diagonal(counts, 0)
+    sdis = np.zeros_like(counts)
+    rdis = np.zeros_like(counts)
+    for r in range(size):
+        sdis[r] = np.concatenate([[0], np.cumsum(counts[r][:-1])])
+        rdis[r] = np.concatenate([[0], np.cumsum(counts.T[r][:-1])])
+    c = comm
+    if reorder:
+        sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+                   for r in range(size)]
+        dests = [[int(d) for d in np.nonzero(counts[r])[0]]
+                 for r in range(size)]
+        sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+        dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+        c = api.dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sw, dweights=dw, reorder=True,
+            method=PlacementMethod.KAHIP)
+    sb = c.alloc(max(1, int(counts.sum(1).max())))
+    rb = c.alloc(max(1, int(counts.sum(0).max())))
+
+    def run():
+        api.alltoallv(c, sb, counts, sdis, rb, counts.T, rdis)
+        rb.data.block_until_ready()
+
+    run()  # compile
+    kw = dict(max_trial_secs=0.3, max_samples=20) if quick else \
+        dict(max_trial_secs=1.5)
+    r = benchmark(run, **kw)
+    return r.trimean
+
+
 def main() -> int:
     import os
 
@@ -212,6 +259,15 @@ def main() -> int:
     except Exception as e:
         print(f"halo failed: {e!r}", file=sys.stderr)
         halo_ips, halo_cfg = None, "failed"
+    a2av = {}
+    for label, reorder in (("alltoallv_sparse_s", False),
+                           ("alltoallv_sparse_remap_s", True)):
+        try:
+            a2av[label] = round(
+                bench_alltoallv_sparse(jax, quick, reorder), 6)
+        except Exception as e:  # single chip: configs 4/5 are multi-rank
+            print(f"{label} skipped: {e!r}", file=sys.stderr)
+            a2av[label] = None
     api.finalize()
 
     print(json.dumps({
@@ -228,6 +284,7 @@ def main() -> int:
         "halo_iters_per_s": (round(halo_ips, 2)
                              if halo_ips is not None else None),
         "halo_config": halo_cfg,
+        **a2av,
     }))
     return 0
 
