@@ -188,6 +188,8 @@ class HaloExchange:
         self.comm = dist_graph_create_adjacent(
             comm, sources, dests, sweights=sweights, dweights=dweights,
             reorder=reorder)
+        # persistent-request batches per (buffer, strategy) exchange pattern
+        self._persistent: dict = {}
 
     @property
     def alloc(self) -> Tuple[int, int, int]:
@@ -225,15 +227,30 @@ class HaloExchange:
         return buf
 
     def exchange(self, buf: DistBuffer, strategy: Optional[str] = None) -> None:
-        """One full halo exchange: every edge as isend/irecv, then waitall
-        (the reference's default packed Isend/Irecv path, :986)."""
-        reqs = []
-        for e in self.edges:
-            reqs.append(p2p.isend(self.comm, e.src, buf, e.dst, e.send_type,
-                                  tag=0))
-            reqs.append(p2p.irecv(self.comm, e.dst, buf, e.src, e.recv_type,
-                                  tag=0))
-        p2p.waitall(reqs, strategy)
+        """One full halo exchange: every edge as a send/recv pair, completed
+        before return (the reference's default packed Isend/Irecv path,
+        :986). Internally the edge set is a persistent-request batch
+        (MPI_Send_init/MPI_Startall analog, which the reference's async
+        engine also builds on, async_operation.cpp:124-130): matching and
+        strategy selection are paid on the first exchange of each (buffer,
+        strategy) pattern, replays dispatch the cached compiled plans."""
+        key = (id(buf), strategy)
+        preqs = self._persistent.get(key)
+        if preqs is None:
+            preqs = []
+            for e in self.edges:
+                preqs.append(p2p.send_init(self.comm, e.src, buf, e.dst,
+                                           e.send_type, tag=0))
+                preqs.append(p2p.recv_init(self.comm, e.dst, buf, e.src,
+                                           e.recv_type, tag=0))
+            # bounded FIFO cache: each entry pins its buffer (the requests
+            # hold it), so an app cycling fresh grids per iteration must not
+            # accumulate them — the steady-state pattern is 1-2 buffers
+            while len(self._persistent) >= 4:
+                self._persistent.pop(next(iter(self._persistent)))
+            self._persistent[key] = preqs
+        p2p.startall(preqs, strategy)
+        p2p.waitall_persistent(preqs, strategy)
 
     # -- stencil compute (the "model" forward) -------------------------------
 

@@ -243,3 +243,255 @@ def test_contiguous_method_knobs(world, monkeypatch):
     p2p_mod.try_progress(world)
     assert ctr.counters.send.num_device == d0 + 1
     msys.set_system(msys.SystemPerformance())
+
+
+# -- persistent requests (MPI_Send_init/Startall analogs) ---------------------
+
+
+def test_persistent_ring_replay(world):
+    """A persistent batch replays correctly: match/strategy/plan are paid at
+    the first start, later starts dispatch the cached plans (reference
+    internally builds every Isend on MPI_Send_init + MPI_Start,
+    async_operation.cpp:124-130)."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.vector(4, 16, 64, dt.BYTE)
+    sbuf, rows = fill(world, ty.extent)
+    rbuf = world.alloc(ty.extent)
+    preqs = []
+    for r in range(world.size):
+        preqs.append(p2p.send_init(world, r, sbuf, (r + 1) % world.size, ty))
+        preqs.append(p2p.recv_init(world, (r + 1) % world.size, rbuf, r, ty))
+    for _ in range(3):
+        p2p.startall(preqs)
+        p2p.waitall_persistent(preqs)
+        for r in range(world.size):
+            got = rbuf.get_rank((r + 1) % world.size)
+            want = st.oracle_unpack(np.zeros(ty.extent, np.uint8),
+                                    st.oracle_pack(rows[r], ty, 1), ty, 1)
+            np.testing.assert_array_equal(got, want)
+    batch = preqs[0].batch
+    assert batch is not None and all(p.batch is batch for p in preqs)
+
+
+def test_persistent_replay_not_aliased_by_same_shape_exchange(world):
+    """Regression: the plan cache rebinds a structurally-identical plan to
+    the latest caller's buffers; a persistent replay must restore its OWN
+    binding or it would read/write a foreign exchange's buffers."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(128, dt.BYTE)
+    sbuf1, rows1 = fill(world, 128, seed=1)
+    rbuf1 = world.alloc(128)
+    preqs = [p2p.send_init(world, 0, sbuf1, 1, ty),
+             p2p.recv_init(world, 1, rbuf1, 0, ty)]
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+
+    # interleave an eager exchange with the SAME structural signature but
+    # different buffers: this rebinds the cached plan's buffers
+    sbuf2, rows2 = fill(world, 128, seed=2)
+    rbuf2 = world.alloc(128)
+    api.isend(world, 0, sbuf2, 1, ty)
+    api.irecv(world, 1, rbuf2, 0, ty)
+    from tempi_tpu.parallel import p2p as p2p_mod
+    p2p_mod.try_progress(world)
+    np.testing.assert_array_equal(rbuf2.get_rank(1), rows2[0])
+
+    # mutate the persistent source, replay, and check the replay moved THIS
+    # batch's data and did not touch the eager exchange's buffers
+    rows1b = [np.full(128, 7 + r, np.uint8) for r in range(world.size)]
+    sbuf1.data = world.buffer_from_host(rows1b).data
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rbuf1.get_rank(1), rows1b[0])
+    np.testing.assert_array_equal(rbuf2.get_rank(1), rows2[0])
+
+
+def test_persistent_start_errors(world):
+    """MPI semantics: starting an active request errors; waiting an inactive
+    one errors."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(32, dt.BYTE)
+    sbuf, _ = fill(world, 32)
+    rbuf = world.alloc(32)
+    preqs = [p2p.send_init(world, 3, sbuf, 4, ty),
+             p2p.recv_init(world, 4, rbuf, 3, ty)]
+    with pytest.raises(RuntimeError, match="inactive"):
+        p2p.waitall_persistent(preqs)
+    p2p.startall(preqs)
+    with pytest.raises(RuntimeError, match="already-active"):
+        p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    # restartable after wait
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+
+
+def test_persistent_start_with_pending_eager_op(world):
+    """Non-overtaking across persistent/eager interleavings: an eager send
+    posted BEFORE the batch's first start must match the persistent recv
+    (FIFO), and the batch must not cache a poisoned pairing."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(96, dt.BYTE)
+    sbufE, rowsE = fill(world, 96, seed=11)
+    sbufP, rowsP = fill(world, 96, seed=12)
+    rbufP = world.alloc(96)
+    rbufL = world.alloc(96)
+
+    # eager send 0->1 posted first, its recv not yet posted
+    api.isend(world, 0, sbufE, 1, ty)
+    preqs = [p2p.send_init(world, 0, sbufP, 1, ty),
+             p2p.recv_init(world, 1, rbufP, 0, ty)]
+    p2p.startall(preqs)
+    # the persistent recv takes the EAGER payload (posted earlier)
+    # and the persistent send pairs with this later eager recv
+    api.irecv(world, 1, rbufL, 0, ty)
+    p2p.try_progress(world)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rbufP.get_rank(1), rowsE[0])
+    np.testing.assert_array_equal(rbufL.get_rank(1), rowsP[0])
+    # the interleaved start must not have been cached as a replayable batch
+    assert preqs[0].batch is None
+
+    # a clean start afterwards caches and replays the right pairing
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rbufP.get_rank(1), rowsP[0])
+    assert preqs[0].batch is not None
+
+
+def test_persistent_replay_with_pending_eager_op(world):
+    """Same non-overtaking rule on the REPLAY path: a cached batch started
+    while a matchable eager op is pending must fall back to the engine."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(80, dt.BYTE)
+    sbufE, rowsE = fill(world, 80, seed=21)
+    sbufP, rowsP = fill(world, 80, seed=22)
+    rbufP = world.alloc(80)
+    rbufL = world.alloc(80)
+
+    preqs = [p2p.send_init(world, 2, sbufP, 3, ty),
+             p2p.recv_init(world, 3, rbufP, 2, ty)]
+    p2p.startall(preqs)          # clean first start -> batch cached
+    p2p.waitall_persistent(preqs)
+    assert preqs[0].batch is not None
+    np.testing.assert_array_equal(rbufP.get_rank(3), rowsP[2])
+
+    api.isend(world, 2, sbufE, 3, ty)   # eager send, still pending
+    p2p.startall(preqs)                 # must NOT replay over it
+    api.irecv(world, 3, rbufL, 2, ty)
+    p2p.try_progress(world)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rbufP.get_rank(3), rowsE[2])
+    np.testing.assert_array_equal(rbufL.get_rank(3), rowsP[2])
+
+
+def test_persistent_subset_start_moves_only_subset(world):
+    """MPI_Start on a subset of init'ed requests is legal and must move only
+    that subset (review regression: the replay fast path used to re-run the
+    whole batch's plans)."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(64, dt.BYTE)
+    sA, rowsA = fill(world, 64, seed=31)
+    sB, rowsB = fill(world, 64, seed=32)
+    rA, rB = world.alloc(64), world.alloc(64)
+    preqs = [p2p.send_init(world, 0, sA, 1, ty),
+             p2p.recv_init(world, 1, rA, 0, ty),
+             p2p.send_init(world, 2, sB, 3, ty),
+             p2p.recv_init(world, 3, rB, 2, ty)]
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rA.get_rank(1), rowsA[0])
+    np.testing.assert_array_equal(rB.get_rank(3), rowsB[2])
+
+    # mutate BOTH sources, start only the first pair
+    rowsA2 = [np.full(64, 40 + r, np.uint8) for r in range(world.size)]
+    rowsB2 = [np.full(64, 50 + r, np.uint8) for r in range(world.size)]
+    sA.data = world.buffer_from_host(rowsA2).data
+    sB.data = world.buffer_from_host(rowsB2).data
+    p2p.startall(preqs[:2])
+    p2p.waitall_persistent(preqs[:2])
+    np.testing.assert_array_equal(rA.get_rank(1), rowsA2[0])
+    # the unstarted pair's receive buffer must be untouched
+    np.testing.assert_array_equal(rB.get_rank(3), rowsB[2])
+
+
+def test_persistent_start_failure_is_retryable(world, monkeypatch):
+    """A failed start leaves the requests INACTIVE (startable again) and
+    reports the root cause once (review regression: a transient failure
+    used to wedge the batch with 'already-active' forever)."""
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.parallel import plan as plan_mod
+
+    ty = dt.contiguous(48, dt.BYTE)
+    sbuf, rows = fill(world, 48, seed=41)
+    rbuf = world.alloc(48)
+    preqs = [p2p.send_init(world, 4, sbuf, 5, ty),
+             p2p.recv_init(world, 5, rbuf, 4, ty)]
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+
+    boom = RuntimeError("transient backend failure")
+    orig = plan_mod.ExchangePlan.run
+
+    def failing(self, strategy="device"):
+        raise boom
+
+    monkeypatch.setattr(plan_mod.ExchangePlan, "run", failing)
+    with pytest.raises(RuntimeError, match="transient backend failure"):
+        p2p.startall(preqs)
+    assert all(p.active is None for p in preqs)  # inactive, not wedged
+
+    monkeypatch.setattr(plan_mod.ExchangePlan, "run", orig)
+    p2p.startall(preqs)  # retry succeeds
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rbuf.get_rank(5), rows[4])
+
+
+def test_persistent_eager_fallback_failure_is_retryable(world, monkeypatch):
+    """When a start falls back to the eager engine (pending op interleave)
+    and the exchange fails, the batch's posted ops must be withdrawn and
+    the requests returned to inactive — a retry must not double-post."""
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.parallel import plan as plan_mod
+
+    ty = dt.contiguous(56, dt.BYTE)
+    sE, rowsE = fill(world, 56, seed=51)
+    sP, rowsP = fill(world, 56, seed=52)
+    rP, rL = world.alloc(56), world.alloc(56)
+    preqs = [p2p.send_init(world, 6, sP, 7, ty),
+             p2p.recv_init(world, 7, rP, 6, ty)]
+    # cache a clean batch first so the replay path is also exercised
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+
+    orig = plan_mod.ExchangePlan.run
+
+    def failing(self, strategy="device"):
+        raise RuntimeError("transient fallback failure")
+
+    # pending eager op forces the _start_eager fallback on the replay path
+    api.isend(world, 6, sE, 7, ty)
+    monkeypatch.setattr(plan_mod.ExchangePlan, "run", failing)
+    with pytest.raises(RuntimeError, match="transient fallback failure"):
+        p2p.startall(preqs)
+    assert all(p.active is None for p in preqs)  # inactive again
+    assert not world._pending  # our unmatched ops were withdrawn
+    monkeypatch.setattr(plan_mod.ExchangePlan, "run", orig)
+
+    # retry with a balanced eager pair (the failed exchange consumed the
+    # original eager send): no duplicate of OUR ops may be pending, so the
+    # new eager pair and the persistent pair must both match cleanly
+    api.isend(world, 6, sE, 7, ty)
+    api.irecv(world, 7, rL, 6, ty)
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    np.testing.assert_array_equal(rL.get_rank(7), rowsE[6])
+    np.testing.assert_array_equal(rP.get_rank(7), rowsP[6])
+    # no stale ops may remain pending (finalize's leak check would trip)
+    assert not world._pending
