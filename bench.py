@@ -123,7 +123,12 @@ def bench_pack(jax, devices):
 
 
 def bench_pingpong_nd(jax, quick: bool):
-    """One-way p50 of a 2-D strided exchange (1 MiB, 256 B blocks)."""
+    """One-way p50 of a 2-D strided exchange (1 MiB, 256 B blocks).
+
+    Returns (eager_p50, mode, persistent_p50): the headline number uses the
+    eager isend/irecv path (parity with the reference bench's plain
+    Send/Recv); the extra persistent figure uses send_init/startall replay,
+    the fastest supported pattern for a fixed exchange."""
     from tempi_tpu import api
     from tempi_tpu.measure.benchmark import benchmark
     from tempi_tpu.ops import dtypes as dt
@@ -150,7 +155,42 @@ def bench_pingpong_nd(jax, quick: bool):
         dict(max_trial_secs=1.5)
     r = benchmark(pingpong, **kw)
     hops = 2 if a != b else 1
-    return r.stats.med() / hops, ("pair" if a != b else "self")
+
+    # two direction batches started SEQUENTIALLY so the persistent figure
+    # is a true round trip like the eager one (a single 4-request batch
+    # would run both directions in one concurrent round — not comparable)
+    fwd = [p2p.send_init(comm, a, buf, b, ty),
+           p2p.recv_init(comm, b, buf, a, ty)]
+    rev = ([p2p.send_init(comm, b, buf, a, ty),
+            p2p.recv_init(comm, a, buf, b, ty)] if a != b else None)
+
+    def persistent(strat=None):
+        p2p.startall(fwd, strat)
+        p2p.waitall_persistent(fwd, strat)
+        if rev is not None:
+            p2p.startall(rev, strat)
+            p2p.waitall_persistent(rev, strat)
+        buf.data.block_until_ready()
+
+    persistent()  # build the batches
+    rp = benchmark(persistent, **kw)
+
+    # per-strategy p50s: the reference bench exists to compare DEVICE vs
+    # STAGED vs ONESHOT (bench_mpi_pingpong_nd.cpp); report each transport
+    per_strategy = {}
+    for strat in ("staged", "oneshot"):
+        def strat_pp(strat=strat):
+            persistent(strat)
+
+        try:
+            strat_pp()  # compile
+            rs = benchmark(strat_pp, **kw)
+            per_strategy[strat] = rs.stats.med() / hops
+        except Exception as e:
+            print(f"pingpong {strat} failed: {e!r}", file=sys.stderr)
+            per_strategy[strat] = None
+    return (r.stats.med() / hops, ("pair" if a != b else "self"),
+            rp.stats.med() / hops, per_strategy)
 
 
 def bench_halo(jax, n_devices: int, quick: bool):
@@ -170,15 +210,19 @@ def bench_halo(jax, n_devices: int, quick: bool):
         X, periodic = 256 if not quick else 32, True
     ex = halo3d.HaloExchange(comm, X=X, periodic=periodic)
     buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
-    ex.exchange(buf)
-    buf.data.block_until_ready()  # compile
-    iters = 5 if quick else 50
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(3):  # compile + settle the tunnel
         ex.exchange(buf)
-    buf.data.block_until_ready()
-    dt_s = time.perf_counter() - t0
-    return iters / dt_s, f"X={X} ranks={comm.size} periodic={periodic}"
+        buf.data.block_until_ready()
+    iters = 5 if quick else 50
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        ex.exchange(buf)
+        buf.data.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    med = times[len(times) // 2]  # median: robust to tunnel hiccups
+    return 1.0 / med, f"X={X} ranks={comm.size} periodic={periodic}"
 
 
 def bench_alltoallv_sparse(jax, quick: bool, reorder: bool):
@@ -250,10 +294,10 @@ def main() -> int:
 
     gbs = bench_pack(jax, devices)
     try:
-        pp_p50, pp_mode = bench_pingpong_nd(jax, quick)
+        pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
     except Exception as e:  # never lose the headline to a secondary metric
         print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
-        pp_p50, pp_mode = None, "failed"
+        pp_p50, pp_mode, pp_pers, pp_strat = None, "failed", None, {}
     try:
         halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
     except Exception as e:
@@ -281,6 +325,14 @@ def main() -> int:
         "pingpong_nd_p50_us": (round(pp_p50 * 1e6, 2)
                                if pp_p50 is not None else None),
         "pingpong_nd_mode": pp_mode,
+        "pingpong_nd_persistent_p50_us": (round(pp_pers * 1e6, 2)
+                                          if pp_pers is not None else None),
+        "pingpong_nd_staged_p50_us": (
+            round(pp_strat["staged"] * 1e6, 2)
+            if pp_strat.get("staged") is not None else None),
+        "pingpong_nd_oneshot_p50_us": (
+            round(pp_strat["oneshot"] * 1e6, 2)
+            if pp_strat.get("oneshot") is not None else None),
         "halo_iters_per_s": (round(halo_ips, 2)
                              if halo_ips is not None else None),
         "halo_config": halo_cfg,

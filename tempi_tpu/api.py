@@ -130,6 +130,15 @@ waitall = p2p.waitall
 Request = p2p.Request
 ANY_TAG = p2p.ANY_TAG
 
+# persistent requests (MPI_Send_init/Recv_init/Startall analogs): repeated
+# exchange patterns pay matching + strategy selection once and replay the
+# compiled plans on every later start
+send_init = p2p.send_init
+recv_init = p2p.recv_init
+startall = p2p.startall
+waitall_persistent = p2p.waitall_persistent
+PersistentRequest = p2p.PersistentRequest
+
 
 # -- collectives & graph communicators ---------------------------------------
 

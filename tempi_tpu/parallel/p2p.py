@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import itertools
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
@@ -503,27 +503,34 @@ def startall(preqs: Sequence[PersistentRequest],
                 # a mixed match set would also poison the replay cache
                 _start_eager(comm, preqs, strategy)
                 return
-            reqs = [_post(comm, p.kind, p.app_rank, p.buf, p.peer,
-                          p.datatype, p.count, p.tag, p.offset)
-                    for p in preqs]
+            reqs: List[Request] = []
             plans: List = []
-            messages, consumed, leftover = _match(comm._pending)
-            if {id(c.request) for c in consumed} != {id(r) for r in reqs}:
-                # the batch doesn't pair up exactly with itself (e.g. a
-                # send with no matching recv in the set); replay caching
-                # would be unsound — leave the ops pending (_match did not
-                # mutate comm._pending) and fall back to the engine
-                for p, r in zip(preqs, reqs):
-                    p.active = r
-                try:
+            try:
+                for p in preqs:
+                    reqs.append(_post(comm, p.kind, p.app_rank, p.buf,
+                                      p.peer, p.datatype, p.count, p.tag,
+                                      p.offset))
+                messages, consumed, leftover = _match(comm._pending)
+                if ({id(c.request) for c in consumed}
+                        != {id(r) for r in reqs}):
+                    # the batch doesn't pair up exactly with itself (e.g. a
+                    # send with no matching recv in the set); replay caching
+                    # would be unsound — leave the ops pending (_match did
+                    # not mutate comm._pending) and fall back to the engine
+                    for p, r in zip(preqs, reqs):
+                        p.active = r
                     try_progress(comm, strategy)
-                except BaseException:
-                    _withdraw_pending(comm, reqs)
-                    raise  # outer except resets the actives
-                return
-            comm._pending = leftover
-            _execute_matched(comm, messages, consumed, strategy,
-                             plans_out=plans)
+                    return
+                comm._pending = leftover
+                _execute_matched(comm, messages, consumed, strategy,
+                                 plans_out=plans)
+            except BaseException:
+                # any failure (a _post mid-batch, _match size mismatch, a
+                # plan) must withdraw whatever this start posted, or the
+                # stale ops would poison every later match on the
+                # communicator (the retryable-start contract)
+                _withdraw_pending(comm, reqs)
+                raise  # outer except resets the actives
     except BaseException:
         # BaseException: a KeyboardInterrupt mid-exchange must not leave
         # the batch marked active (the inner fallback re-raises through

@@ -495,3 +495,29 @@ def test_persistent_eager_fallback_failure_is_retryable(world, monkeypatch):
     np.testing.assert_array_equal(rP.get_rank(7), rowsP[6])
     # no stale ops may remain pending (finalize's leak check would trip)
     assert not world._pending
+
+
+def test_persistent_first_start_match_error_withdraws_ops(world):
+    """A first start whose matching fails (size mismatch) must withdraw its
+    posted ops: stale ops would otherwise re-raise on every later
+    try_progress and trip finalize's leak check."""
+    from tempi_tpu.parallel import p2p
+
+    ty64 = dt.contiguous(64, dt.BYTE)
+    ty32 = dt.contiguous(32, dt.BYTE)
+    s64, rows64 = fill(world, 64, seed=61)
+    r32 = world.alloc(32)
+    preqs = [p2p.send_init(world, 0, s64, 1, ty64),
+             p2p.recv_init(world, 1, r32, 0, ty32)]
+    with pytest.raises(ValueError, match="sizes differ"):
+        p2p.startall(preqs)
+    assert all(p.active is None for p in preqs)
+    assert not world._pending  # the communicator is clean
+
+    # unrelated well-formed traffic still works
+    ty = dt.contiguous(64, dt.BYTE)
+    rbuf = world.alloc(64)
+    api.isend(world, 2, s64, 3, ty)
+    api.irecv(world, 3, rbuf, 2, ty)
+    p2p.try_progress(world)
+    np.testing.assert_array_equal(rbuf.get_rank(3), rows64[2])
