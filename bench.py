@@ -125,10 +125,11 @@ def bench_pack(jax, devices):
 def bench_pingpong_nd(jax, quick: bool):
     """One-way p50 of a 2-D strided exchange (1 MiB, 256 B blocks).
 
-    Returns (eager_p50, mode, persistent_p50): the headline number uses the
-    eager isend/irecv path (parity with the reference bench's plain
-    Send/Recv); the extra persistent figure uses send_init/startall replay,
-    the fastest supported pattern for a fixed exchange."""
+    Returns (eager_p50, mode, persistent_p50, per_strategy_p50s): the
+    headline number uses the eager isend/irecv path (parity with the
+    reference bench's plain Send/Recv); the persistent figure uses
+    send_init/startall replay, the fastest supported pattern for a fixed
+    exchange; per_strategy_p50s maps "staged"/"oneshot" to their p50s."""
     from tempi_tpu import api
     from tempi_tpu.measure.benchmark import benchmark
     from tempi_tpu.ops import dtypes as dt

@@ -42,6 +42,10 @@ def init(devices=None) -> Communicator:
         devices = jax.devices()
     else:
         log.world_rank = 0  # single controller drives all ranks
+    # AFTER the multihost join: jax.distributed.initialize must run before
+    # anything initializes the XLA backend, and the cache probe reads
+    # jax.default_backend()
+    _enable_compile_cache()
     _world = Communicator(devices)
     type_cache.init()
     if envmod.env.progress_thread:
@@ -55,6 +59,36 @@ def init(devices=None) -> Communicator:
     log.debug(f"tempi init: {_world.size} ranks, "
               f"{_world.num_nodes} node(s)")
     return _world
+
+
+def _enable_compile_cache() -> None:
+    """Persist compiled XLA executables under TEMPI_CACHE_DIR.
+
+    Extends the reference's cache-dir concept (perf.json measurement cache,
+    env.cpp:87-106) to compiled programs: a halo-exchange plan or pack
+    kernel compiled once on this machine is reloaded on the next process
+    instead of recompiled (~tens of seconds for a 26-edge exchange).
+    Accelerator backends only — CPU test meshes recompile in milliseconds
+    and tests intentionally vary knobs that would churn the cache."""
+    import os
+
+    cache_dir = envmod.env.cache_dir
+    if not cache_dir or os.environ.get("TEMPI_NO_COMPILE_CACHE"):
+        return
+    try:
+        if jax.default_backend() == "cpu":
+            return
+        path = os.path.join(cache_dir, "xla_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took meaningful compile time (default
+        # thresholds skip sub-second programs — exactly our many small
+        # per-edge kernels, which is the sum that hurts)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        log.debug(f"XLA compilation cache at {path}")
+    except Exception as e:  # never let cache config break init
+        log.warn(f"compilation cache unavailable: {e!r}")
 
 
 def finalize() -> None:

@@ -124,6 +124,60 @@ def _multi_dma_supported() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=1)
+def _dyn_dma_supported() -> bool:
+    """One-time probe: do scalar-prefetch DYNAMIC-offset DMA kernels lower
+    on this backend? When they do, pack kernels are keyed by structure only
+    (nrows, rowstride, nblocks, bl, combo shape) and the row offsets ride
+    in as runtime scalars — so the 26 edges of a halo exchange share ~7
+    Mosaic compiles instead of 26 (compile time is the sum that hurts).
+    Probed eagerly for the same reason as _multi_dma_supported: a traced
+    rejection would fail a whole exchange plan at compile time."""
+    if _interpret():
+        return True
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        nblocks, bl = 8, 128
+
+        def kern(off_ref, view_ref, pk_ref, sems):
+            copies = [
+                pltpu.make_async_copy(
+                    view_ref.at[pl.ds(off_ref[i], nblocks), pl.ds(0, bl)],
+                    pk_ref.at[i], sems.at[i])
+                for i in range(2)]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        )
+        call = pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((2, nblocks, bl), jnp.uint8))
+        # execute and CHECK BYTES, not just compile: a silently mis-lowered
+        # dynamic offset would corrupt every packed message
+        import numpy as _np
+        src = _np.arange(32 * 128, dtype=_np.uint8).reshape(32, 128)
+        offs = _np.asarray([8, 16], dtype=_np.int32)
+        out = _np.asarray(jax.jit(call)(jnp.asarray(offs),
+                                        jnp.asarray(src)))
+        want = _np.stack([src[8:8 + nblocks, :bl], src[16:16 + nblocks, :bl]])
+        if not (out == want).all():
+            raise RuntimeError("dynamic-offset DMA produced wrong bytes")
+        return True
+    except Exception as e:
+        log.debug(f"dynamic-offset DMA probe failed; pack kernels stay "
+                  f"per-geometry: {e}")
+        return False
+
+
 @functools.lru_cache(maxsize=8192)
 def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
           strides: Tuple[int, ...], extent: int,
@@ -267,12 +321,14 @@ def _outer_offsets(p: dict):
             for o in range(n_o) for k in range(n_k)]
 
 
-def _dma_call(p: dict, unpack: bool):
+def _dma_call(p: dict, unpack: bool, dynamic: bool = False):
     """Shared scaffolding of the grid-free DMA kernels: one strided
-    ``make_async_copy`` per outer combo (all offsets Python ints, started
-    together so they overlap on the DMA engines), then wait on all. ``unpack``
-    flips the direction — packed matrix into the strided columns of an output
-    that aliases the destination operand — everything else is identical."""
+    ``make_async_copy`` per outer combo, started together so they overlap
+    on the DMA engines, then wait on all. ``unpack`` flips the direction —
+    packed matrix into the strided columns of an output that aliases the
+    destination operand. ``dynamic`` moves the per-combo row offsets from
+    baked Python ints into a scalar-prefetch operand (``off_ref``), so the
+    compiled kernel is keyed by structure only and shared across starts."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -283,36 +339,49 @@ def _dma_call(p: dict, unpack: bool):
     pk_shape = ((nblocks, bl) if single else
                 tuple(x for x, _ in p["outer_rows"]) + (nblocks, bl))
 
-    def copies(pk_ref, view_ref, sems):
+    def copies(pk_ref, view_ref, sems, off_ref):
         for i, (idx, r0) in enumerate(combos):
             pk_at = pk_ref if single else pk_ref.at[idx]
-            view_at = view_ref.at[pl.ds(r0, nblocks), pl.ds(0, bl)]
+            row0 = off_ref[i] if dynamic else r0
+            view_at = view_ref.at[pl.ds(row0, nblocks), pl.ds(0, bl)]
             src, dst = (pk_at, view_at) if unpack else (view_at, pk_at)
             yield pltpu.make_async_copy(src, dst,
                                         sems if single else sems.at[i])
 
     def kern(*refs):
+        off_ref = None
+        if dynamic:
+            off_ref, *refs = refs
         if unpack:
             pk_ref, _dst_in, view_ref, sems = refs  # out aliases _dst_in
         else:
             view_ref, pk_ref, sems = refs
-        for cp in copies(pk_ref, view_ref, sems):
+        for cp in copies(pk_ref, view_ref, sems, off_ref):
             cp.start()
-        for cp in copies(pk_ref, view_ref, sems):
+        for cp in copies(pk_ref, view_ref, sems, off_ref):
             cp.wait()
 
     anyspec = pl.BlockSpec(memory_space=pl.ANY)
     out_shape = (p["nrows"], p["rowstride"]) if unpack else pk_shape
-    call = pl.pallas_call(
-        kern,
-        in_specs=[anyspec, anyspec] if unpack else [anyspec],
-        out_specs=anyspec,
-        out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint8),
-        input_output_aliases={1: 0} if unpack else {},
-        scratch_shapes=[pltpu.SemaphoreType.DMA if single
-                        else pltpu.SemaphoreType.DMA((n,))],
-        interpret=_interpret(),
-    )
+    in_specs = [anyspec, anyspec] if unpack else [anyspec]
+    sems = (pltpu.SemaphoreType.DMA if single
+            else pltpu.SemaphoreType.DMA((n,)))
+    # aliasing indices count the scalar-prefetch operand
+    aliases = ({1 + dynamic: 0} if unpack else {})
+    if dynamic:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, in_specs=in_specs, out_specs=anyspec,
+            scratch_shapes=[sems])
+        call = pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint8),
+            input_output_aliases=aliases, interpret=_interpret())
+    else:
+        call = pl.pallas_call(
+            kern, in_specs=in_specs, out_specs=anyspec,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint8),
+            input_output_aliases=aliases, scratch_shapes=[sems],
+            interpret=_interpret())
     return call, pk_shape
 
 
@@ -327,6 +396,116 @@ def _build_pack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
     def fn(u8):
         view = u8.reshape(p["nrows"], p["rowstride"])
         return call(view).reshape(-1)
+
+    return jax.jit(fn)
+
+
+def _structural_plan(nrows: int, rowstride: int, nblocks: int, bl: int,
+                     combo_shape: Tuple[int, ...]) -> dict:
+    """Synthetic plan carrying only the structure a dynamic-offset kernel
+    needs: the baked per-combo offsets in outer_rows are ignored (the
+    runtime ``off_ref`` supplies them)."""
+    outer = [(x, 0) for x in combo_shape] if combo_shape else [(1, nblocks)]
+    return dict(bl=bl, nblocks=nblocks, nrows=nrows, rowstride=rowstride,
+                start_row=0, outer_rows=outer)
+
+
+@functools.lru_cache(maxsize=512)
+def _build_pack_dma_shared(nrows: int, rowstride: int, nblocks: int, bl: int,
+                           combo_shape: Tuple[int, ...]):
+    """Structure-keyed grid-free DMA kernel: row offsets are runtime
+    scalars (scalar prefetch), so geometries differing only in start/outer
+    strides share ONE Mosaic compile. The _plan gate still guarantees every
+    offset value is 8-sublane-aligned at call time."""
+    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape)
+    call, _ = _dma_call(p, unpack=False, dynamic=True)
+
+    def fn(u8, offs):
+        return call(offs, u8.reshape(nrows, rowstride)).reshape(-1)
+
+    return jax.jit(fn)
+
+
+def _shared_pack_args(p: dict):
+    """(structural key, offsets) for the shared kernel."""
+    combos = _outer_offsets(p)
+    combo_shape = (() if len(combos) == 1
+                   else tuple(x for x, _ in p["outer_rows"]))
+    import numpy as _np
+    offs = _np.asarray([r0 for _, r0 in combos], dtype=_np.int32)
+    return ((p["nrows"], p["rowstride"], p["nblocks"], p["bl"], combo_shape),
+            offs)
+
+
+@functools.lru_cache(maxsize=1)
+def _dyn_unpack_dma_supported() -> bool:
+    """Probe the aliased (in-place) unpack variant of the dynamic-offset
+    kernel: input_output_aliases counts the scalar-prefetch operand, so the
+    destination is call operand 2 aliased to output 0."""
+    if _interpret():
+        return True
+    if not _dyn_dma_supported():
+        return False
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        nblocks, bl = 8, 128
+
+        def kern(off_ref, pk_ref, _dst, view_ref, sems):
+            copies = [
+                pltpu.make_async_copy(
+                    pk_ref.at[i],
+                    view_ref.at[pl.ds(off_ref[i], nblocks), pl.ds(0, bl)],
+                    sems.at[i])
+                for i in range(2)]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        )
+        call = pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((32, 128), jnp.uint8),
+            input_output_aliases={2: 0})
+        # execute and check: unpacked columns land at the offsets, gap
+        # bytes of the aliased destination survive
+        import numpy as _np
+        pk = _np.arange(2 * nblocks * bl, dtype=_np.uint8).reshape(
+            2, nblocks, bl)
+        dst = _np.full((32, 128), 0xEE, dtype=_np.uint8)
+        out = _np.asarray(jax.jit(call)(
+            jnp.asarray(_np.asarray([8, 16], _np.int32)),
+            jnp.asarray(pk), jnp.asarray(dst)))
+        want = dst.copy()
+        want[8:8 + nblocks, :bl] = pk[0]
+        want[16:16 + nblocks, :bl] = pk[1]
+        if not (out == want).all():
+            raise RuntimeError("aliased dynamic unpack produced wrong bytes")
+        return True
+    except Exception as e:
+        log.debug(f"dynamic-offset aliased unpack probe failed; unpack "
+                  f"kernels stay per-geometry: {e}")
+        return False
+
+
+@functools.lru_cache(maxsize=512)
+def _build_unpack_dma_shared(nrows: int, rowstride: int, nblocks: int,
+                             bl: int, combo_shape: Tuple[int, ...]):
+    """Structure-keyed in-place unpack: packed columns DMAed over the
+    aliased destination at runtime row offsets."""
+    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape)
+    call, pk_shape = _dma_call(p, unpack=True, dynamic=True)
+
+    def fn(u8, packed, offs):
+        return call(offs, packed.reshape(pk_shape),
+                    u8.reshape(nrows, rowstride)).reshape(-1)
 
     return jax.jit(fn)
 
@@ -441,6 +620,18 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
         try:
             if p["dma"] and args not in _failed_dma:
                 try:
+                    if _dyn_dma_supported():
+                        try:
+                            key, offs = _shared_pack_args(p)
+                            return _build_pack_dma_shared(*key)(src_u8, offs)
+                        except ImportError:
+                            raise
+                        except Exception as e:
+                            # the probe can't exercise every geometry: a
+                            # shared-kernel rejection must not disable the
+                            # proven per-geometry static kernel
+                            log.warn(f"shared DMA pack failed for {args}; "
+                                     f"trying the static kernel: {e}")
                     return _build_pack_dma(*args)(src_u8)
                 except ImportError:
                     raise
@@ -545,6 +736,16 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
         # inside a traced program XLA's copy-insertion keeps the in-place
         # aliasing sound; eagerly it would consume the caller's array
         try:
+            if _dyn_unpack_dma_supported():
+                try:
+                    key, offs = _shared_pack_args(p)
+                    return _build_unpack_dma_shared(*key)(dst_u8, packed_u8,
+                                                          offs)
+                except ImportError:
+                    raise
+                except Exception as e:
+                    log.warn(f"shared DMA unpack failed for {args}; "
+                             f"trying the static kernel: {e}")
             return _build_unpack_dma(*args)(dst_u8, packed_u8)
         except ImportError:
             pass
