@@ -73,7 +73,7 @@ def _enable_compile_cache() -> None:
     import os
 
     cache_dir = envmod.env.cache_dir
-    if not cache_dir or os.environ.get("TEMPI_NO_COMPILE_CACHE"):
+    if not cache_dir or envmod.env.no_compile_cache:
         return
     try:
         if jax.default_backend() == "cpu":

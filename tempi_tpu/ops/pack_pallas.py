@@ -136,39 +136,19 @@ def _dyn_dma_supported() -> bool:
     if _interpret():
         return True
     try:
-        from jax.experimental import pallas as pl
-        from jax.experimental.pallas import tpu as pltpu
-
-        nblocks, bl = 8, 128
-
-        def kern(off_ref, view_ref, pk_ref, sems):
-            copies = [
-                pltpu.make_async_copy(
-                    view_ref.at[pl.ds(off_ref[i], nblocks), pl.ds(0, bl)],
-                    pk_ref.at[i], sems.at[i])
-                for i in range(2)]
-            for cp in copies:
-                cp.start()
-            for cp in copies:
-                cp.wait()
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
-        )
-        call = pl.pallas_call(
-            kern, grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((2, nblocks, bl), jnp.uint8))
-        # execute and CHECK BYTES, not just compile: a silently mis-lowered
-        # dynamic offset would corrupt every packed message
+        # build through the PRODUCTION path (_build_pack_dma_shared →
+        # _dma_call(dynamic=True)) so the probe exercises the exact kernel
+        # construction later messages will use, then CHECK BYTES — a
+        # silently mis-lowered dynamic offset would corrupt every message
         import numpy as _np
-        src = _np.arange(32 * 128, dtype=_np.uint8).reshape(32, 128)
+        nblocks, bl = 8, 128
+        fn = _build_pack_dma_shared(32, 128, nblocks, bl, (2,))
+        src = _np.arange(32 * 128, dtype=_np.uint8).reshape(-1)
         offs = _np.asarray([8, 16], dtype=_np.int32)
-        out = _np.asarray(jax.jit(call)(jnp.asarray(offs),
-                                        jnp.asarray(src)))
-        want = _np.stack([src[8:8 + nblocks, :bl], src[16:16 + nblocks, :bl]])
+        out = _np.asarray(fn(jnp.asarray(src), jnp.asarray(offs)))
+        s2d = src.reshape(32, 128)
+        want = _np.concatenate([s2d[8:8 + nblocks, :bl].reshape(-1),
+                                s2d[16:16 + nblocks, :bl].reshape(-1)])
         if not (out == want).all():
             raise RuntimeError("dynamic-offset DMA produced wrong bytes")
         return True
@@ -447,45 +427,20 @@ def _dyn_unpack_dma_supported() -> bool:
     if not _dyn_dma_supported():
         return False
     try:
-        from jax.experimental import pallas as pl
-        from jax.experimental.pallas import tpu as pltpu
-
-        nblocks, bl = 8, 128
-
-        def kern(off_ref, pk_ref, _dst, view_ref, sems):
-            copies = [
-                pltpu.make_async_copy(
-                    pk_ref.at[i],
-                    view_ref.at[pl.ds(off_ref[i], nblocks), pl.ds(0, bl)],
-                    sems.at[i])
-                for i in range(2)]
-            for cp in copies:
-                cp.start()
-            for cp in copies:
-                cp.wait()
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
-        )
-        call = pl.pallas_call(
-            kern, grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((32, 128), jnp.uint8),
-            input_output_aliases={2: 0})
-        # execute and check: unpacked columns land at the offsets, gap
-        # bytes of the aliased destination survive
+        # production-path probe (see _dyn_dma_supported): unpacked columns
+        # must land at the offsets, gap bytes of the aliased dest survive
         import numpy as _np
-        pk = _np.arange(2 * nblocks * bl, dtype=_np.uint8).reshape(
-            2, nblocks, bl)
-        dst = _np.full((32, 128), 0xEE, dtype=_np.uint8)
-        out = _np.asarray(jax.jit(call)(
-            jnp.asarray(_np.asarray([8, 16], _np.int32)),
-            jnp.asarray(pk), jnp.asarray(dst)))
-        want = dst.copy()
-        want[8:8 + nblocks, :bl] = pk[0]
-        want[16:16 + nblocks, :bl] = pk[1]
+        nblocks, bl = 8, 128
+        fn = _build_unpack_dma_shared(32, 128, nblocks, bl, (2,))
+        pk = _np.arange(2 * nblocks * bl, dtype=_np.uint8)
+        dst = _np.full(32 * 128, 0xEE, dtype=_np.uint8)
+        offs = _np.asarray([8, 16], _np.int32)
+        out = _np.asarray(fn(jnp.asarray(dst), jnp.asarray(pk),
+                             jnp.asarray(offs))).reshape(32, 128)
+        want = dst.reshape(32, 128).copy()
+        pk3 = pk.reshape(2, nblocks, bl)
+        want[8:8 + nblocks, :bl] = pk3[0]
+        want[16:16 + nblocks, :bl] = pk3[1]
         if not (out == want).all():
             raise RuntimeError("aliased dynamic unpack produced wrong bytes")
         return True
@@ -604,6 +559,11 @@ def _build_pack(nbytes: int, start: int, counts: Tuple[int, ...],
 _failed_dma: set = set()    # direct-DMA kernel failed; pipeline may still work
 _failed_args: set = set()   # no pallas pack kernel works for this geometry
 _failed_unpack_dma: set = set()  # in-place unpack DMA failed; splice instead
+# structural keys whose SHARED dynamic-offset kernel failed (the probe can't
+# exercise every geometry): pay the failed compile once per structure, then
+# go straight to the static per-geometry kernel
+_failed_shared: set = set()
+_failed_shared_unpack: set = set()
 
 
 def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
@@ -620,18 +580,19 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
         try:
             if p["dma"] and args not in _failed_dma:
                 try:
-                    if _dyn_dma_supported():
+                    key, offs = _shared_pack_args(p)
+                    if _dyn_dma_supported() and key not in _failed_shared:
                         try:
-                            key, offs = _shared_pack_args(p)
                             return _build_pack_dma_shared(*key)(src_u8, offs)
                         except ImportError:
                             raise
                         except Exception as e:
-                            # the probe can't exercise every geometry: a
-                            # shared-kernel rejection must not disable the
-                            # proven per-geometry static kernel
-                            log.warn(f"shared DMA pack failed for {args}; "
-                                     f"trying the static kernel: {e}")
+                            # a shared-kernel rejection must not disable the
+                            # proven per-geometry static kernel — and must
+                            # be paid once per structure, not per message
+                            _failed_shared.add(key)
+                            log.warn(f"shared DMA pack failed for {key}; "
+                                     f"static kernel from now on: {e}")
                     return _build_pack_dma(*args)(src_u8)
                 except ImportError:
                     raise
@@ -736,16 +697,18 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
         # inside a traced program XLA's copy-insertion keeps the in-place
         # aliasing sound; eagerly it would consume the caller's array
         try:
-            if _dyn_unpack_dma_supported():
+            key, offs = _shared_pack_args(p)
+            if (_dyn_unpack_dma_supported()
+                    and key not in _failed_shared_unpack):
                 try:
-                    key, offs = _shared_pack_args(p)
                     return _build_unpack_dma_shared(*key)(dst_u8, packed_u8,
                                                           offs)
                 except ImportError:
                     raise
                 except Exception as e:
-                    log.warn(f"shared DMA unpack failed for {args}; "
-                             f"trying the static kernel: {e}")
+                    _failed_shared_unpack.add(key)
+                    log.warn(f"shared DMA unpack failed for {key}; "
+                             f"static kernel from now on: {e}")
             return _build_unpack_dma(*args)(dst_u8, packed_u8)
         except ImportError:
             pass

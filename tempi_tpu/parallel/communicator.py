@@ -137,13 +137,44 @@ class DistBuffer:
 
     def set_rank(self, app_rank: int, content: np.ndarray) -> None:
         lib = self.comm.library_rank(app_rank)
-        host = np.array(self.data, copy=True)
-        host[lib, : len(content)] = content
-        self.data = jax.device_put(host, self.comm.sharding())
+        data = self.data
+        if getattr(data, "is_fully_addressable", True):
+            host = np.array(data, copy=True)
+            host[lib, : len(content)] = content
+            self.data = jax.device_put(host, self.comm.sharding())
+            return
+        # multi-controller: rebuild from per-device shards, updating the
+        # owner's row if it lives here (SPMD contract: every process calls
+        # set_rank with the same arguments; non-owners update nothing)
+        shards = []
+        for sh in data.addressable_shards:
+            start = sh.index[0].start or 0
+            arr = np.asarray(sh.data)
+            if start <= lib < start + arr.shape[0]:
+                arr = arr.copy()
+                arr[lib - start, : len(content)] = content
+            shards.append(jax.device_put(arr, sh.device))
+        self.data = jax.make_array_from_single_device_arrays(
+            data.shape, data.sharding, shards)
 
     def get_rank(self, app_rank: int) -> np.ndarray:
         lib = self.comm.library_rank(app_rank)
-        return np.asarray(self.data[lib])
+        data = self.data
+        if getattr(data, "is_fully_addressable", True):
+            return np.asarray(data[lib])
+        # multi-controller (jax.distributed): indexing a partially-
+        # addressable global array would execute a DIVERGENT per-process
+        # program (undefined under SPMD); read the local shard directly
+        for sh in data.addressable_shards:
+            idx = sh.index[0]
+            start = 0 if idx.start is None else idx.start
+            stop = data.shape[0] if idx.stop is None else idx.stop
+            if start <= lib < stop:
+                return np.asarray(sh.data)[lib - start]
+        raise ValueError(
+            f"rank {app_rank} (library {lib}) is not addressable from "
+            f"process {jax.process_index()}; multi-host callers may only "
+            f"read ranks whose devices live on this host")
 
     def block_until_ready(self) -> "DistBuffer":
         self.data.block_until_ready()

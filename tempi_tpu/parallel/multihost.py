@@ -18,9 +18,11 @@ reference reaching a network-capable MPI through its dlsym table
   exchange over the staged host transport — the same code path DCN traffic
   takes, minus the wire.
 
-This cannot be hardware-tested in a single-host environment; the seam is
-deliberately thin so a real multi-host launch only needs the coordinator
-address.
+The trait is exercised for real — not just simulated — by
+tests/test_multihost_process.py: two OS processes joined through
+``jax.distributed`` (Gloo CPU collectives standing in for DCN), each owning
+half the mesh, running the full init/topology/p2p stack across the process
+boundary. A hardware multi-host launch only needs the coordinator address.
 """
 
 from __future__ import annotations

@@ -99,6 +99,8 @@ class Environment:
     # background progress thread (no reference analog: the reference's
     # queue.hpp/waitall sketch show one was intended but never landed)
     progress_thread: bool = False
+    # disable the persistent XLA compilation cache under cache_dir
+    no_compile_cache: bool = False
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -141,6 +143,7 @@ class Environment:
             e.contiguous = ContiguousMethod.AUTO
 
         e.cache_dir = _cache_dir_fallback(getenv)
+        e.no_compile_cache = getenv("TEMPI_NO_COMPILE_CACHE") is not None
 
         pk = (getenv("TEMPI_PACK_KERNEL") or "auto").lower()
         try:

@@ -1,0 +1,89 @@
+"""Child process for the real multi-process (DCN) test.
+
+Run as: python _mp_child.py <process_id> <num_processes> <coordinator>
+
+Joins the jax.distributed world (SURVEY §5 backend trait (b)), runs a
+cross-process ring exchange of a strided datatype through the framework's
+full p2p engine, and verifies this process's local ranks. Exit code 0 on
+success. Each process executes the IDENTICAL program — the single-controller
+engine is valid multi-controller SPMD because op posting and plan
+compilation are deterministic.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tempi_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(device_count=4)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    pid, nproc, coord = sys.argv[1], sys.argv[2], sys.argv[3]
+    os.environ["TEMPI_COORDINATOR"] = coord
+    os.environ["TEMPI_NUM_PROCESSES"] = nproc
+    os.environ["TEMPI_PROCESS_ID"] = pid
+
+    import jax
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    comm = api.init()
+    assert comm.size == 4 * int(nproc), comm.size
+    # process boundary == node (DCN) boundary
+    assert comm.num_nodes == int(nproc), comm.num_nodes
+    half = comm.size // 2
+    assert not comm.is_colocated(0, half)
+    assert comm.is_colocated(0, 1)
+
+    # strided ring exchange crossing the boundary: r -> (r + half) % size
+    ty = dt.vector(4, 32, 64, dt.BYTE)
+    rows = [np.full(ty.extent, r + 1, np.uint8) for r in range(comm.size)]
+    sbuf = comm.buffer_from_host(rows)
+    rbuf = comm.alloc(ty.extent)
+    reqs = []
+    for r in range(comm.size):
+        reqs.append(p2p.isend(comm, r, sbuf, (r + half) % comm.size, ty))
+        reqs.append(p2p.irecv(comm, (r + half) % comm.size, rbuf, r, ty))
+    p2p.waitall(reqs)
+
+    local = {d.id for d in jax.local_devices()}
+    checked = 0
+    for lib, dev in enumerate(comm.devices):
+        if dev.id not in local:
+            continue
+        got = rbuf.get_rank(lib)
+        src = (lib - half) % comm.size
+        for b in range(4):
+            assert (got[b * 64: b * 64 + 32] == src + 1).all(), (lib, b)
+        checked += 1
+    assert checked == 4, checked
+
+    # a non-addressable rank read must fail loudly, not silently misread
+    remote = (int(pid) * 4 + 4) % comm.size
+    try:
+        rbuf.get_rank(remote)
+        raise SystemExit("expected get_rank(remote) to raise")
+    except ValueError:
+        pass
+
+    # SPMD set_rank on the partially-addressable buffer: every process
+    # issues the same updates; each verifies the one it owns
+    for r in range(comm.size):
+        rbuf.set_rank(r, np.full(8, 0x42, np.uint8))
+    own = int(pid) * 4
+    assert (rbuf.get_rank(own)[:8] == 0x42).all()
+
+    api.finalize()
+    print(f"MP-CHILD-OK {pid}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
