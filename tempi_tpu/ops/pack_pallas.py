@@ -580,19 +580,23 @@ def pack(src_u8: jax.Array, start: int, counts: Sequence[int],
         try:
             if p["dma"] and args not in _failed_dma:
                 try:
-                    key, offs = _shared_pack_args(p)
-                    if _dyn_dma_supported() and key not in _failed_shared:
-                        try:
-                            return _build_pack_dma_shared(*key)(src_u8, offs)
-                        except ImportError:
-                            raise
-                        except Exception as e:
-                            # a shared-kernel rejection must not disable the
-                            # proven per-geometry static kernel — and must
-                            # be paid once per structure, not per message
-                            _failed_shared.add(key)
-                            log.warn(f"shared DMA pack failed for {key}; "
-                                     f"static kernel from now on: {e}")
+                    if _dyn_dma_supported():
+                        key, offs = _shared_pack_args(p)
+                        if key not in _failed_shared:
+                            try:
+                                return _build_pack_dma_shared(*key)(src_u8,
+                                                                    offs)
+                            except ImportError:
+                                raise
+                            except Exception as e:
+                                # a shared-kernel rejection must not disable
+                                # the proven per-geometry static kernel —
+                                # and must be paid once per structure, not
+                                # per message
+                                _failed_shared.add(key)
+                                log.warn(f"shared DMA pack failed for "
+                                         f"{key}; static kernel from now "
+                                         f"on: {e}")
                     return _build_pack_dma(*args)(src_u8)
                 except ImportError:
                     raise
@@ -697,18 +701,18 @@ def unpack(dst_u8: jax.Array, packed_u8: jax.Array, start: int,
         # inside a traced program XLA's copy-insertion keeps the in-place
         # aliasing sound; eagerly it would consume the caller's array
         try:
-            key, offs = _shared_pack_args(p)
-            if (_dyn_unpack_dma_supported()
-                    and key not in _failed_shared_unpack):
-                try:
-                    return _build_unpack_dma_shared(*key)(dst_u8, packed_u8,
-                                                          offs)
-                except ImportError:
-                    raise
-                except Exception as e:
-                    _failed_shared_unpack.add(key)
-                    log.warn(f"shared DMA unpack failed for {key}; "
-                             f"static kernel from now on: {e}")
+            if _dyn_unpack_dma_supported():
+                key, offs = _shared_pack_args(p)
+                if key not in _failed_shared_unpack:
+                    try:
+                        return _build_unpack_dma_shared(*key)(
+                            dst_u8, packed_u8, offs)
+                    except ImportError:
+                        raise
+                    except Exception as e:
+                        _failed_shared_unpack.add(key)
+                        log.warn(f"shared DMA unpack failed for {key}; "
+                                 f"static kernel from now on: {e}")
             return _build_unpack_dma(*args)(dst_u8, packed_u8)
         except ImportError:
             pass

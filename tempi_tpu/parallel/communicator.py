@@ -143,17 +143,24 @@ class DistBuffer:
             host[lib, : len(content)] = content
             self.data = jax.device_put(host, self.comm.sharding())
             return
-        # multi-controller: rebuild from per-device shards, updating the
-        # owner's row if it lives here (SPMD contract: every process calls
-        # set_rank with the same arguments; non-owners update nothing)
+        # multi-controller: rebuild from per-device shards, updating only
+        # the owner's row if it lives here (SPMD contract: every process
+        # calls set_rank with the same arguments). Untouched shards are
+        # reused as-is — no host round trip — and a process owning no part
+        # of the row changes nothing at all.
+        if not any((sh.index[0].start or 0) <= lib
+                   < (sh.index[0].start or 0) + sh.data.shape[0]
+                   for sh in data.addressable_shards):
+            return
         shards = []
         for sh in data.addressable_shards:
             start = sh.index[0].start or 0
-            arr = np.asarray(sh.data)
-            if start <= lib < start + arr.shape[0]:
-                arr = arr.copy()
+            if start <= lib < start + sh.data.shape[0]:
+                arr = np.asarray(sh.data).copy()
                 arr[lib - start, : len(content)] = content
-            shards.append(jax.device_put(arr, sh.device))
+                shards.append(jax.device_put(arr, sh.device))
+            else:
+                shards.append(sh.data)
         self.data = jax.make_array_from_single_device_arrays(
             data.shape, data.sharding, shards)
 

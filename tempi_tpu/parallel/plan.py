@@ -267,7 +267,20 @@ class ExchangePlan:
         """Pack on device -> D2H -> permute on host -> H2D -> unpack.
 
         ``host_kind='pinned_host'`` asks XLA to commit the pack output
-        directly to host memory (ONESHOT analog)."""
+        directly to host memory (ONESHOT analog).
+
+        Multi-controller worlds (jax.distributed) take the device path
+        instead: the host permute would need the FULL packed payload on
+        every process, but only local shards are addressable — and on TPU
+        the XLA collectives over DCN that the device path compiles to ARE
+        the correct off-node transport (the reference staged through the
+        host because CUDA-aware MPI was slow off-node; that economics does
+        not transfer)."""
+        if any(not getattr(b.data, "is_fully_addressable", True)
+               for b in self.bufs):
+            log.debug("staged transport on a partially-addressable buffer: "
+                      "running the device path (multi-controller world)")
+            return self.run_device()
         if host_kind not in self._round_fns:
             self._round_fns[host_kind] = self._build_round_fns(host_kind)
         comm = self.comm

@@ -80,6 +80,19 @@ def main() -> int:
     own = int(pid) * 4
     assert (rbuf.get_rank(own)[:8] == 0x42).all()
 
+    # flagship model across the DCN boundary: 8-rank halo exchange whose
+    # dist-graph spans both processes (device transport; a staged request
+    # degrades to the device path in a multi-controller world)
+    from tempi_tpu.models import halo3d
+
+    ex = halo3d.HaloExchange(comm, X=16)
+    g = ex.alloc_grid(fill=lambda rank, shape: float(rank + 1))
+    for _ in range(2):
+        ex.exchange(g)
+    g.data.block_until_ready()
+    ex.exchange(g, strategy="staged")  # degrades to device, must not raise
+    g.data.block_until_ready()
+
     api.finalize()
     print(f"MP-CHILD-OK {pid}")
     return 0
