@@ -154,7 +154,16 @@ def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
 
 
 def _staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
-    """Bulk D2H -> host alltoallv -> H2D (alltoallv_impl.cpp:68-93)."""
+    """Bulk D2H -> host alltoallv -> H2D (alltoallv_impl.cpp:68-93).
+
+    Multi-controller worlds take the fused device path instead: the bulk
+    host move needs every shard, but only local ones are addressable (same
+    rationale as ExchangePlan.run_staged)."""
+    if not (getattr(sendbuf.data, "is_fully_addressable", True)
+            and getattr(recvbuf.data, "is_fully_addressable", True)):
+        log.debug("staged alltoallv on a partially-addressable buffer: "
+                  "running the fused device path (multi-controller world)")
+        return _device_fused(comm, sendbuf, sc, sd, recvbuf, rd)
     size = comm.size
     host_s = np.asarray(sendbuf.data)          # D2H
     host_r = np.array(recvbuf.data, copy=True)  # writable host copy

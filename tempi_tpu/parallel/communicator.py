@@ -148,21 +148,20 @@ class DistBuffer:
         # calls set_rank with the same arguments). Untouched shards are
         # reused as-is — no host round trip — and a process owning no part
         # of the row changes nothing at all.
-        if not any((sh.index[0].start or 0) <= lib
-                   < (sh.index[0].start or 0) + sh.data.shape[0]
-                   for sh in data.addressable_shards):
-            return
         shards = []
+        touched = False
         for sh in data.addressable_shards:
             start = sh.index[0].start or 0
             if start <= lib < start + sh.data.shape[0]:
                 arr = np.asarray(sh.data).copy()
                 arr[lib - start, : len(content)] = content
                 shards.append(jax.device_put(arr, sh.device))
+                touched = True
             else:
                 shards.append(sh.data)
-        self.data = jax.make_array_from_single_device_arrays(
-            data.shape, data.sharding, shards)
+        if touched:
+            self.data = jax.make_array_from_single_device_arrays(
+                data.shape, data.sharding, shards)
 
     def get_rank(self, app_rank: int) -> np.ndarray:
         lib = self.comm.library_rank(app_rank)

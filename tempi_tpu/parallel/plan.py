@@ -276,8 +276,7 @@ class ExchangePlan:
         the correct off-node transport (the reference staged through the
         host because CUDA-aware MPI was slow off-node; that economics does
         not transfer)."""
-        if any(not getattr(b.data, "is_fully_addressable", True)
-               for b in self.bufs):
+        if self._must_degrade_to_device():
             log.debug("staged transport on a partially-addressable buffer: "
                       "running the device path (multi-controller world)")
             return self.run_device()
@@ -365,7 +364,10 @@ class ExchangePlan:
                 ctr.counters.send.num_device += len(self.messages)
                 self.run_device()
             elif strategy in ("staged", "oneshot"):
-                if strategy == "staged":
+                if self._must_degrade_to_device():
+                    # count what actually ran, not what was requested
+                    ctr.counters.send.num_device += len(self.messages)
+                elif strategy == "staged":
                     ctr.counters.send.num_staged += len(self.messages)
                 else:
                     ctr.counters.send.num_oneshot += len(self.messages)
@@ -375,6 +377,12 @@ class ExchangePlan:
                                     if strategy == "oneshot" else None)
             else:
                 raise ValueError(f"unknown strategy {strategy!r}")
+
+    def _must_degrade_to_device(self) -> bool:
+        """True when a host-staged transport is impossible: some buffer
+        spans devices this process cannot address (multi-controller)."""
+        return any(not getattr(b.data, "is_fully_addressable", True)
+                   for b in self.bufs)
 
     @staticmethod
     def _comm_scope():

@@ -80,6 +80,34 @@ def main() -> int:
     own = int(pid) * 4
     assert (rbuf.get_rank(own)[:8] == 0x42).all()
 
+    # alltoallv across the boundary: every rank sends r+1 bytes to every
+    # other; the staged strategy must degrade to the fused device path
+    counts = np.zeros((comm.size, comm.size), np.int64)
+    for s in range(comm.size):
+        for d in range(comm.size):
+            if s != d:
+                counts[s, d] = s + 1
+    sdis = np.zeros_like(counts)
+    rdis = np.zeros_like(counts)
+    for r in range(comm.size):
+        sdis[r] = np.concatenate([[0], np.cumsum(counts[r][:-1])])
+        rdis[r] = np.concatenate([[0], np.cumsum(counts.T[r][:-1])])
+    a2 = comm.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(comm.size)])
+    a2r = comm.alloc(64)
+    from tempi_tpu.utils.env import AlltoallvMethod
+    api.alltoallv(comm, a2, counts, sdis, a2r, counts.T, rdis,
+                  method=AlltoallvMethod.STAGED)  # degrades multi-controller
+    for lib, dev in enumerate(comm.devices):
+        if dev.id not in local:
+            continue
+        got = a2r.get_rank(lib)
+        for s in range(comm.size):
+            n = counts[s, lib]
+            if n:
+                seg = got[rdis[lib, s]: rdis[lib, s] + n]
+                assert (seg == s + 1).all(), (lib, s, seg)
+
     # flagship model across the DCN boundary: 8-rank halo exchange whose
     # dist-graph spans both processes (device transport; a staged request
     # degrades to the device path in a multi-controller world)

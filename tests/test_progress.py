@@ -216,3 +216,44 @@ def test_pump_enabled_collective_no_race(world8):
                 np.full(32, r + 1, np.uint8))
     finally:
         progress.stop()
+
+
+def test_progress_thread_with_persistent_replay(monkeypatch):
+    """A background pump (TEMPI_PROGRESS_THREAD) must not race a persistent
+    batch's replay: both run under the communicator's progress lock."""
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.setenv("TEMPI_PROGRESS_THREAD", "1")
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        ty = dt.vector(4, 16, 64, dt.BYTE)
+        rows = [np.full(ty.extent, r + 1, np.uint8) for r in range(comm.size)]
+        sbuf = comm.buffer_from_host(rows)
+        rbuf = comm.alloc(ty.extent)
+        preqs = []
+        for r in range(comm.size):
+            preqs.append(p2p.send_init(comm, r, sbuf,
+                                       (r + 1) % comm.size, ty))
+            preqs.append(p2p.recv_init(comm, (r + 1) % comm.size,
+                                       rbuf, r, ty))
+        ebuf = comm.alloc(ty.extent)
+        for _ in range(5):
+            p2p.startall(preqs)
+            p2p.waitall_persistent(preqs)
+            # interleave eager traffic the pump may pick up concurrently
+            # (its own buffer — it must not clobber the checked rows)
+            r1 = p2p.isend(comm, 0, sbuf, 0, ty, tag=9)
+            r2 = p2p.irecv(comm, 0, ebuf, 0, ty, tag=9)
+            p2p.waitall([r1, r2])
+        for r in range(comm.size):
+            got = rbuf.get_rank((r + 1) % comm.size)
+            for b in range(4):
+                assert (got[b * 64: b * 64 + 16] == r + 1).all()
+    finally:
+        api.finalize()
