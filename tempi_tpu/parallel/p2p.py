@@ -473,6 +473,7 @@ def startall(preqs: Sequence[PersistentRequest],
                 # pairing would overtake it — run through the engine
                 _start_eager(comm, preqs, strategy)
                 return
+            ctr.counters.send.num_persistent_replays += 1
             try:
                 for plan, strat, binding in batch.plans:
                     # restore this batch's binding (see class docstring);

@@ -57,6 +57,9 @@ class P2PCounters:
     num_device: int = 0
     num_staged: int = 0
     num_fallback: int = 0
+    # persistent-batch replays that skipped match/strategy/plan lookup
+    # (no reference analog: its persistent requests are internal-only)
+    num_persistent_replays: int = 0
 
 
 @dataclass

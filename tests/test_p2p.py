@@ -272,6 +272,8 @@ def test_persistent_ring_replay(world):
             np.testing.assert_array_equal(got, want)
     batch = preqs[0].batch
     assert batch is not None and all(p.batch is batch for p in preqs)
+    from tempi_tpu.utils import counters as ctr
+    assert ctr.counters.send.num_persistent_replays >= 2  # starts 2 and 3
 
 
 def test_persistent_replay_not_aliased_by_same_shape_exchange(world):
