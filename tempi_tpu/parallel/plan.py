@@ -55,18 +55,22 @@ def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
     """Greedy round assignment: each rank sends at most one and receives at
     most one message per round; program order is preserved per (src,dst).
 
-    Self-messages (src == dst, e.g. periodic wrap edges) are kept in
-    self-only rounds: those rounds execute as local pack->unpack with no
-    ppermute, so XLA fuses them instead of serializing tiny collectives."""
+    ALL self-messages (src == dst, e.g. periodic wrap edges) share ONE
+    round: a self round executes as per-rank local pack->unpack branches
+    with no ppermute and no one-message-per-rank constraint, so a rank may
+    apply any number of self messages there (in posted order — MPI only
+    orders messages within a pair). A 26-edge single-rank periodic halo is
+    one round, not 26."""
     rounds: List[List[Message]] = []
     busy_s: List[set] = []
     busy_r: List[set] = []
-    is_self: List[bool] = []
+    self_round: List[Message] = []
     for m in messages:
+        if m.src == m.dst:
+            self_round.append(m)
+            continue
         placed = False
         for k in range(len(rounds)):
-            if is_self[k] != (m.src == m.dst):
-                continue
             if m.src not in busy_s[k] and m.dst not in busy_r[k]:
                 rounds[k].append(m)
                 busy_s[k].add(m.src)
@@ -77,7 +81,8 @@ def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
             rounds.append([m])
             busy_s.append({m.src})
             busy_r.append({m.dst})
-            is_self.append(m.src == m.dst)
+    if self_round:
+        rounds.append(self_round)
     return rounds
 
 
@@ -170,6 +175,46 @@ class ExchangePlan:
             table[m.dst] = keys[key]
         return branches, table
 
+    def _self_branches(self, rnd: List[Message]):
+        """Per-rank branches for a self-only round: each branch applies ALL
+        of that rank's self messages as local pack->unpack (no ppermute, no
+        padding to the round max), in posted order."""
+        bidx = {id(b): i for i, b in enumerate(self.bufs)}
+        by_rank: Dict[int, List[Message]] = {}
+        for m in rnd:
+            by_rank.setdefault(m.src, []).append(m)
+        branches = [lambda locs: locs]
+        table = np.zeros((self.comm.size,), dtype=np.int32)
+        keys: Dict[tuple, int] = {}  # structural dedup, like _send_branches
+        for rank, msgs in by_rank.items():
+            key = tuple((bidx[id(m.sbuf)], m.soffset, id(m.spacker),
+                         m.scount, bidx[id(m.rbuf)], m.roffset,
+                         id(m.rpacker), m.rcount, m.nbytes) for m in msgs)
+            if key not in keys:
+                def mk(msgs=msgs):
+                    def f(locs):
+                        for m in msgs:
+                            sbi, rbi = bidx[id(m.sbuf)], bidx[id(m.rbuf)]
+                            src = (locs[sbi] if m.soffset == 0
+                                   else locs[sbi][m.soffset:])
+                            payload = m.spacker.pack(src, m.scount)
+                            dst = (locs[rbi] if m.roffset == 0
+                                   else locs[rbi][m.roffset:])
+                            new = m.rpacker.unpack(dst, payload[: m.nbytes],
+                                                   m.rcount)
+                            if m.roffset != 0:
+                                new = jnp.concatenate(
+                                    [locs[rbi][: m.roffset], new])
+                            locs = tuple(new if i == rbi else l
+                                         for i, l in enumerate(locs))
+                        return locs
+                    return f
+
+                keys[key] = len(branches)
+                branches.append(mk())
+            table[rank] = keys[key]
+        return branches, table
+
     # -- DEVICE strategy: one fully fused jitted program ---------------------
 
     def _build_device_fn(self):
@@ -197,13 +242,16 @@ class ExchangePlan:
         locs = tuple(d.reshape(-1) for d in datas)
         r = jax.lax.axis_index(AXIS)
         for rnd in rounds:
+            if all(m.src == m.dst for m in rnd):
+                sbr, stab = self._self_branches(rnd)
+                locs = jax.lax.switch(jnp.asarray(stab)[r], sbr, locs)
+                continue
             maxb = max(m.nbytes for m in rnd)
             sbr, stab = self._send_branches(rnd, maxb)
             rbr, rtab = self._recv_branches(rnd, maxb)
             payload = jax.lax.switch(jnp.asarray(stab)[r], sbr, locs)
-            if any(m.src != m.dst for m in rnd):
-                perm = [(m.src, m.dst) for m in rnd]
-                payload = jax.lax.ppermute(payload, AXIS, perm)
+            perm = [(m.src, m.dst) for m in rnd]
+            payload = jax.lax.ppermute(payload, AXIS, perm)
             locs = jax.lax.switch(jnp.asarray(rtab)[r], rbr, payload, locs)
         return tuple(l.reshape(1, -1) for l in locs)
 
@@ -220,10 +268,26 @@ class ExchangePlan:
     # -- STAGED / ONESHOT: pack on device, move through the host -------------
 
     def _build_round_fns(self, host_kind: Optional[str]):
-        """Per-round (pack_fn, unpack_fn) jitted pair."""
+        """Per-round entries: ("self", fn) for self-only rounds (one local
+        jitted update, nothing to stage through the host) or
+        ("xfer", (pack_fn, unpack_fn)) for transfer rounds."""
         comm = self.comm
         fns = []
         for rnd in self.rounds:
+            if all(m.src == m.dst for m in rnd):
+                def mk_self(rnd=rnd):
+                    def self_step(*datas):
+                        return self._step_body([rnd], datas)
+
+                    n = len(self.bufs)
+                    sf = jax.shard_map(self_step, mesh=comm.mesh,
+                                       in_specs=(P(AXIS, None),) * n,
+                                       out_specs=(P(AXIS, None),) * n,
+                                       check_vma=False)
+                    return jax.jit(sf)
+
+                fns.append(("self", mk_self()))
+                continue
             maxb = max(m.nbytes for m in rnd)
 
             def mk(rnd=rnd, maxb=maxb):
@@ -260,7 +324,7 @@ class ExchangePlan:
                         pass
                 return pf, jax.jit(uf)
 
-            fns.append(mk())
+            fns.append(("xfer", mk()))
         return fns
 
     def run_staged(self, host_kind: Optional[str] = None) -> None:
@@ -284,7 +348,13 @@ class ExchangePlan:
             self._round_fns[host_kind] = self._build_round_fns(host_kind)
         comm = self.comm
         datas = [b.data for b in self.bufs]
-        for rnd, (pf, uf) in zip(self.rounds, self._round_fns[host_kind]):
+        for rnd, (kind, entry) in zip(self.rounds,
+                                      self._round_fns[host_kind]):
+            if kind == "self":
+                # local pack->unpack on device; nothing crosses the host
+                datas = list(entry(*datas))
+                continue
+            pf, uf = entry
             if host_kind is not None:
                 try:
                     payload = pf(*datas)
@@ -338,9 +408,12 @@ class ExchangePlan:
         return self._staging[:nbytes].view(dtype).reshape(shape)
 
     def _staging_capacity(self) -> int:
-        """Largest per-round staging footprint of this plan."""
+        """Largest per-round staging footprint of this plan. Self-only
+        rounds never touch the host slab (run_staged skips them), so they
+        don't size it."""
         return max((self.comm.size * max(m.nbytes for m in rnd)
-                    for rnd in self.rounds if rnd), default=0)
+                    for rnd in self.rounds
+                    if rnd and any(m.src != m.dst for m in rnd)), default=0)
 
     def release_staging(self) -> None:
         if self._staging_inflight is not None:
