@@ -104,15 +104,44 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             host_alloc.release(b)
 
     if not sp.intra_node_pingpong:
-        devs = jax.devices()
+        # LOCAL devices only: a global-device mesh would span processes —
+        # the adaptive harness diverges there (deadlock) and non-owners
+        # would record dispatch-only garbage
+        devs = jax.local_devices()
         if len(devs) >= 2:
             sp.intra_node_pingpong = _pingpong_curve(devs, quick, kw)
         else:
-            log.debug("single device: skipping intra-node pingpong curve")
+            log.debug("fewer than 2 local devices: skipping intra-node "
+                      "pingpong curve")
 
-    if not sp.inter_node_pingpong:
+    pair = _cross_process_pair(jax.devices())
+    if pair is not None:
+        # a REAL process (DCN) boundary exists: measure the collective over
+        # it — the analog of the reference's inter-node GPU-GPU pingpong
+        # (measure_system.cu:429-508). This is a cross-process section, so
+        # (a) entry must be AGREED — per-process cache state may diverge
+        # and a lone process entering the collective hangs forever;
+        # (b) timing is fixed-schedule (adaptive rep counts diverge); and
+        # (c) only the pair's owner observes true latency — its curve is
+        # broadcast so every process models the same DCN cost (the
+        # reference broadcasts loop control and results for these same
+        # reasons, benchmark.cpp:91-159).
+        from jax.experimental import multihost_utils as mhu
+
+        needs = np.asarray([0 if sp.inter_node_pingpong else 1])
+        if int(mhu.process_allgather(needs).max()):
+            curve = _pingpong_curve(pair, quick, kw, lockstep=True)
+            arr = np.asarray(curve, dtype=np.float64)
+            src = getattr(pair[0], "process_index", 0)
+            arr = np.asarray(mhu.broadcast_one_to_all(
+                arr, is_source=jax.process_index() == src))
+            sp.inter_node_pingpong = [(int(b), float(t)) for b, t in arr]
+    elif not sp.inter_node_pingpong:
+        # single-process: the staged D2H->host->H2D path stands in
+        # (measuring same-host ICI would overestimate DCN badly)
         sp.inter_node_pingpong = _staged_pingpong_curve(
             jax.devices(), quick, kw)
+    if sp.inter_node_pingpong:
         log.debug(f"inter_node_pingpong: {len(sp.inter_node_pingpong)} points")
 
     grids = [("pack_device", False, False), ("unpack_device", True, False),
@@ -128,9 +157,26 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     return sp
 
 
-def _pingpong_curve(devs, quick, kw):
-    """Device-device round trip over the mesh (ICI on TPU): one ppermute
-    there, one back (reference GPU-GPU pingpong, measure_system.cu:429-508)."""
+def _cross_process_pair(devs):
+    """[local device, device of another process], or None single-process."""
+    by_proc = {}
+    for d in devs:
+        by_proc.setdefault(getattr(d, "process_index", 0), d)
+    if len(by_proc) < 2:
+        return None
+    procs = sorted(by_proc)
+    return [by_proc[procs[0]], by_proc[procs[1]]]
+
+
+def _pingpong_curve(devs, quick, kw, lockstep: bool = False):
+    """Device-device round trip over a 2-device mesh (ICI on TPU when both
+    devices share a host; DCN when they span processes): one ppermute
+    there, one back (reference GPU-GPU pingpong, measure_system.cu:429-508).
+
+    ``lockstep`` uses a fixed iteration schedule identical on every process
+    instead of the adaptive IID harness — mandatory when the mesh spans
+    processes, where divergent rep counts would deadlock the collective
+    (iterations taken from ``kw['max_samples']`` when set)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -145,11 +191,21 @@ def _pingpong_curve(devs, quick, kw):
 
     fn = jax.jit(jax.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
                                out_specs=P("p", None), check_vma=False))
+    iters = kw.get("max_samples") or (10 if quick else 30)
     for nb in _transfer_sizes(quick):
         x = jax.device_put(np.zeros((2, nb), np.uint8), sh)
         fn(x).block_until_ready()
-        r = benchmark(lambda: fn(x).block_until_ready(), **kw)
-        curve.append((nb, r.trimean / 2))  # one-way time
+        if lockstep:
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fn(x).block_until_ready()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            curve.append((nb, times[len(times) // 2] / 2))  # median one-way
+        else:
+            r = benchmark(lambda: fn(x).block_until_ready(), **kw)
+            curve.append((nb, r.trimean / 2))  # one-way time
     return curve
 
 

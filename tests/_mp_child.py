@@ -121,6 +121,30 @@ def main() -> int:
     ex.exchange(g, strategy="staged")  # degrades to device, must not raise
     g.data.block_until_ready()
 
+    # real cross-process (DCN) pingpong measurement in lockstep — the
+    # adaptive harness would pick divergent rep counts per process and
+    # deadlock the collective
+    from tempi_tpu.measure import sweep
+
+    pair = sweep._cross_process_pair(jax.devices())
+    assert pair is not None
+    assert pair[0].process_index != pair[1].process_index
+    curve = sweep._pingpong_curve(pair, True, sweep._bench_kwargs(True),
+                                  lockstep=True)
+    assert curve and all(t > 0 and t < 10 for _, t in curve), curve
+    # the pair owner's observation is broadcast so every process models the
+    # same DCN cost (the measure_all path); both children must converge to
+    # byte-identical curves
+    import numpy as _np
+    from jax.experimental import multihost_utils as mhu
+    arr = _np.asarray(curve, dtype=_np.float64)
+    src = pair[0].process_index
+    got = _np.asarray(mhu.broadcast_one_to_all(
+        arr, is_source=jax.process_index() == src))
+    assert got.shape == arr.shape
+    h = mhu.process_allgather(_np.asarray([float(got.sum())]))
+    assert _np.allclose(h, h[0]), h  # identical on every process
+
     api.finalize()
     print(f"MP-CHILD-OK {pid}")
     return 0
