@@ -112,6 +112,22 @@ def neighbor_alltoallv(comm: Communicator, sendbuf: DistBuffer,
     es = datatype.size
     assert datatype.size == datatype.extent, \
         "neighbor_alltoallv requires a dense datatype"
+    if strategy is None:
+        # dense neighbor exchange == sparse alltoallv: lower onto the dense
+        # engine, whose AUTO path is the hardware-native ragged all-to-all
+        # (the reference notes this pass-through equivalence,
+        # neighbor_alltoallv.cpp:17-21). Bail to the w-path when a rank
+        # lists the same neighbor twice (a matrix can't express that) or
+        # the counts don't transpose-match.
+        mats = _neighbor_matrices(comm, graph, sendcounts, sdispls,
+                                  recvcounts, rdispls)
+        if mats is not None:
+            sc, sd, rc, rd = mats
+            if np.array_equal(sc, rc.T):
+                from . import alltoallv as a2a
+                a2a.alltoallv(comm, sendbuf, sc, sd, recvbuf, rc, rd,
+                              datatype=datatype)
+                return
     sendtypes, recvtypes = [], []
     sb, sdis, rb, rdis = [], [], [], []
     for ar in range(comm.size):
@@ -124,3 +140,26 @@ def neighbor_alltoallv(comm: Communicator, sendbuf: DistBuffer,
         rdis.append([int(d) * es for d in rdispls[ar]])
     neighbor_alltoallw(comm, sendbuf, sb, sdis, sendtypes, recvbuf, rb, rdis,
                        recvtypes, strategy=strategy)
+
+
+def _neighbor_matrices(comm, graph, sendcounts, sdispls, recvcounts,
+                       rdispls):
+    """(sc, sd, rc, rd) full (size, size) element-count/displacement
+    matrices for a dense neighbor exchange, or None when the adjacency has
+    duplicate neighbors (not expressible as a matrix)."""
+    size = comm.size
+    sc = np.zeros((size, size), np.int64)
+    sd = np.zeros((size, size), np.int64)
+    rc = np.zeros((size, size), np.int64)
+    rd = np.zeros((size, size), np.int64)
+    for ar in range(size):
+        srcs, dsts = graph[ar]
+        if len(set(dsts)) != len(dsts) or len(set(srcs)) != len(srcs):
+            return None
+        for j, dst in enumerate(dsts):
+            sc[ar, dst] = int(sendcounts[ar][j])
+            sd[ar, dst] = int(sdispls[ar][j])
+        for i, src in enumerate(srcs):
+            rc[ar, src] = int(recvcounts[ar][i])
+            rd[ar, src] = int(rdispls[ar][i])
+    return sc, sd, rc, rd

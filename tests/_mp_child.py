@@ -135,15 +135,14 @@ def main() -> int:
     # the pair owner's observation is broadcast so every process models the
     # same DCN cost (the measure_all path); both children must converge to
     # byte-identical curves
-    import numpy as _np
     from jax.experimental import multihost_utils as mhu
-    arr = _np.asarray(curve, dtype=_np.float64)
+    arr = np.asarray(curve, dtype=np.float64)
     src = pair[0].process_index
-    got = _np.asarray(mhu.broadcast_one_to_all(
+    got = np.asarray(mhu.broadcast_one_to_all(
         arr, is_source=jax.process_index() == src))
     assert got.shape == arr.shape
-    h = mhu.process_allgather(_np.asarray([float(got.sum())]))
-    assert _np.allclose(h, h[0]), h  # identical on every process
+    h = mhu.process_allgather(np.asarray([float(got.sum())]))
+    assert np.allclose(h, h[0]), h  # identical on every process
 
     api.finalize()
     print(f"MP-CHILD-OK {pid}")
