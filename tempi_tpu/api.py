@@ -46,6 +46,7 @@ def init(devices=None) -> Communicator:
     # anything initializes the XLA backend, and the cache probe reads
     # jax.default_backend()
     _enable_compile_cache()
+    _start_trace()
     _world = Communicator(devices)
     type_cache.init()
     if envmod.env.progress_thread:
@@ -91,10 +92,45 @@ def _enable_compile_cache() -> None:
         log.warn(f"compilation cache unavailable: {e!r}")
 
 
+_tracing = False
+
+
+def _start_trace() -> None:
+    """TEMPI_TRACE_DIR: capture a device trace of the init..finalize window
+    (Perfetto; the named scopes the exchange plans emit appear on the
+    timeline — the actionable analog of the reference's NVTX ranges,
+    alltoallv_impl.cpp:74-202)."""
+    global _tracing
+    trace_dir = envmod.env.trace_dir
+    if not trace_dir or _tracing:
+        return
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _tracing = True
+        log.debug(f"device trace capturing to {trace_dir}")
+    except Exception as e:  # profiling must never break init
+        log.warn(f"trace capture unavailable: {e!r}")
+
+
+def _stop_trace() -> None:
+    global _tracing
+    if not _tracing:
+        return
+    try:
+        jax.profiler.stop_trace()
+        log.debug(f"device trace written to {envmod.env.trace_dir}")
+    except Exception as e:
+        log.warn(f"trace capture failed to stop: {e!r}")
+    _tracing = False
+
+
 def finalize() -> None:
     """MPI_Finalize analog: leak checks then teardown
     (reference: src/finalize.cpp:20-40)."""
     global _world
+    # stop tracing even when init failed before _world was set: the
+    # profiler would otherwise capture forever with no API path to stop it
+    _stop_trace()
     if _world is None:
         return
     try:

@@ -69,24 +69,29 @@ def alltoallv(comm: Communicator, sendbuf: DistBuffer, sendcounts,
         raise ValueError("recvcounts must be the transpose of sendcounts")
 
     method = method or envmod.env.alltoallv
-    if method in (AlltoallvMethod.AUTO, AlltoallvMethod.NONE):
-        # the TPU "library path": prefer the hardware-native ragged
-        # all-to-all (no padding to the largest message); the masked fused
-        # collective is the fallback when the op can't build here
-        if not _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd):
-            _device_fused(comm, sendbuf, sc, sd, recvbuf, rd)
-    elif method is AlltoallvMethod.STAGED:
-        _staged(comm, sendbuf, sc, sd, recvbuf, rd)
-    elif method is AlltoallvMethod.REMOTE_FIRST:
-        _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="remote_first",
-              strategy="device")
-    elif method is AlltoallvMethod.ISIR_STAGED:
-        _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="posted",
-              strategy="staged")
-    elif method is AlltoallvMethod.ISIR_REMOTE_STAGED:
-        _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd)
-    else:
-        raise ValueError(f"unhandled alltoallv method {method}")
+    # the whole dispatch runs under the progress lock: every strategy
+    # touches comm._plan_cache and/or issues device collectives, and a
+    # background pump executing a cached ExchangePlan must not interleave
+    # (the round-1 plan-cache race, extended to the direct device paths)
+    with comm._progress_lock:
+        if method in (AlltoallvMethod.AUTO, AlltoallvMethod.NONE):
+            # the TPU "library path": prefer the hardware-native ragged
+            # all-to-all (no padding to the largest message); the masked
+            # fused collective is the fallback when the op can't build here
+            if not _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd):
+                _device_fused(comm, sendbuf, sc, sd, recvbuf, rd)
+        elif method is AlltoallvMethod.STAGED:
+            _staged(comm, sendbuf, sc, sd, recvbuf, rd)
+        elif method is AlltoallvMethod.REMOTE_FIRST:
+            _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="remote_first",
+                  strategy="device")
+        elif method is AlltoallvMethod.ISIR_STAGED:
+            _isir(comm, sendbuf, sc, sd, recvbuf, rd, order="posted",
+                  strategy="staged")
+        elif method is AlltoallvMethod.ISIR_REMOTE_STAGED:
+            _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd)
+        else:
+            raise ValueError(f"unhandled alltoallv method {method}")
 
 
 # -- device_fused -------------------------------------------------------------

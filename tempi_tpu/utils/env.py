@@ -101,6 +101,10 @@ class Environment:
     progress_thread: bool = False
     # disable the persistent XLA compilation cache under cache_dir
     no_compile_cache: bool = False
+    # when set, capture a device trace of the whole init..finalize window
+    # into this directory (the actionable analog of the reference's NVTX
+    # ranges: named scopes land in the Perfetto timeline)
+    trace_dir: str = ""
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -144,6 +148,7 @@ class Environment:
 
         e.cache_dir = _cache_dir_fallback(getenv)
         e.no_compile_cache = getenv("TEMPI_NO_COMPILE_CACHE") is not None
+        e.trace_dir = getenv("TEMPI_TRACE_DIR") or ""
 
         pk = (getenv("TEMPI_PACK_KERNEL") or "auto").lower()
         try:

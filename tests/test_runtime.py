@@ -153,3 +153,23 @@ def test_fallback_packer_counter(monkeypatch):
         comm._pending.clear()
     finally:
         api.finalize()
+
+
+def test_trace_capture_knob(tmp_path, monkeypatch):
+    """TEMPI_TRACE_DIR captures a device trace across init..finalize."""
+    import os
+
+    from tempi_tpu import api
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.setenv("TEMPI_TRACE_DIR", str(tmp_path))
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        buf = comm.alloc(64)
+        buf.data.block_until_ready()
+    finally:
+        api.finalize()
+    # the profiler writes a plugins/ or .trace tree under the dir
+    entries = list(os.listdir(tmp_path))
+    assert entries, "no trace output written"
