@@ -303,11 +303,9 @@ def _isir(comm, sendbuf, sc, sd, recvbuf, rd, order: str,
           strategy: str) -> None:
     msgs = _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order)
     if msgs:
-        # under the progress lock: the plan cache is shared with the p2p
-        # pump, and a TEMPI_PROGRESS_THREAD pump must not race a cached
-        # ExchangePlan mid-execution
-        with comm._progress_lock:
-            get_plan(comm, msgs).run(strategy)
+        # serialization against the p2p pump is the DISPATCHER's job:
+        # alltoallv() holds comm._progress_lock around every strategy
+        get_plan(comm, msgs).run(strategy)
 
 
 def _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
@@ -316,8 +314,8 @@ def _isir_remote_staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
     msgs = _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, "posted")
     local = [m for m in msgs if comm.is_colocated(m.src, m.dst)]
     remote = [m for m in msgs if not comm.is_colocated(m.src, m.dst)]
-    with comm._progress_lock:
-        if remote:
-            get_plan(comm, remote).run("staged")
-        if local:
-            get_plan(comm, local).run("device")
+    # caller (the alltoallv dispatcher) holds the progress lock
+    if remote:
+        get_plan(comm, remote).run("staged")
+    if local:
+        get_plan(comm, local).run("device")

@@ -239,8 +239,6 @@ def test_neighbor_alltoallv_dense_path_matches_w_path(world):
     """The dense lowering (matrix -> alltoallv engine) and the alltoallw
     fan-out must deliver byte-identical results on an irregular graph with
     asymmetric counts and nonzero displacements."""
-    import numpy as np
-
     size = world.size
     # irregular ring-with-chords adjacency
     dests = [[(r + 1) % size] + ([(r + 3) % size] if r % 2 == 0 else [])
