@@ -523,3 +523,22 @@ def test_persistent_first_start_match_error_withdraws_ops(world):
     api.irecv(world, 3, rbuf, 2, ty)
     p2p.try_progress(world)
     np.testing.assert_array_equal(rbuf.get_rank(3), rows64[2])
+
+
+def test_any_tag_recv(world):
+    """A recv posted with ANY_TAG matches the earliest send from its peer
+    regardless of tag (MPI wildcard semantics)."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(24, dt.BYTE)
+    s1, _ = fill(world, 24, seed=71)
+    s2, _ = fill(world, 24, seed=72)
+    r1 = world.alloc(24)
+    r2 = world.alloc(24)
+    api.isend(world, 0, s1, 1, ty, tag=5)
+    api.isend(world, 0, s2, 1, ty, tag=9)
+    qa = api.irecv(world, 1, r1, 0, ty, tag=p2p.ANY_TAG)
+    qb = api.irecv(world, 1, r2, 0, ty, tag=9)
+    api.waitall([qa, qb])
+    np.testing.assert_array_equal(r1.get_rank(1), s1.get_rank(0))  # FIFO
+    np.testing.assert_array_equal(r2.get_rank(1), s2.get_rank(0))

@@ -168,3 +168,21 @@ def test_overlapping_hvector_packs_via_fallback():
     src = np.arange(6, dtype=np.uint8)
     got = np.asarray(rec.best_packer().pack(jnp.asarray(src), 1))
     np.testing.assert_array_equal(got, [0, 1, 2, 3, 2, 3, 4, 5])
+
+
+def test_type_free_releases_cache_entry():
+    """MPI_Type_free analog drops the committed record (reference:
+    src/type_free.cpp, type_cache release via types.cpp:707-711)."""
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.ops import type_cache
+
+    ty = dt.vector(3, 8, 16, dt.BYTE)
+    rec = api.type_commit(ty)
+    assert type_cache.lookup(ty) is rec
+    api.type_free(ty)
+    assert type_cache.lookup(ty) is None
+    # recommit works after free
+    rec2 = api.type_commit(ty)
+    assert type_cache.lookup(ty) is rec2
+    api.type_free(ty)
