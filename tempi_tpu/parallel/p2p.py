@@ -31,6 +31,7 @@ from ..utils import counters as ctr
 from ..utils import env as envmod
 from ..utils import logging as log
 from ..utils.env import ContiguousMethod, DatatypeMethod
+from . import tags
 from .communicator import Communicator, DistBuffer
 from .plan import Message, get_plan
 
@@ -80,6 +81,14 @@ def _packer_for(datatype: Datatype):
 def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
           offset: int) -> Request:
+    # the reserved range is what makes internal neighbor traffic collision-
+    # free (reference: tags.cpp reserving MPI_TAG_UB-1); internal paths
+    # construct Messages directly and never come through here
+    if not ((0 <= tag < tags.RESERVED_BASE)
+            or (kind == "recv" and tag == ANY_TAG)):
+        raise ValueError(
+            f"tag {tag} out of the application range [0, {tags.RESERVED_BASE})"
+            + (" (ANY_TAG is receive-only)" if tag == ANY_TAG else ""))
     packer, rec = _packer_for(datatype)
     req = Request(next(_req_ids), comm, buf=buf)
     op = Op(kind=kind, rank=comm.library_rank(app_rank),

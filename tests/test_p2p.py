@@ -113,6 +113,24 @@ def test_tag_matching_fifo(world):
     np.testing.assert_array_equal(r2.get_rank(1), s2.get_rank(0))
 
 
+def test_reserved_tags_rejected(world):
+    """Application tags must stay below the reserved internal range
+    (reference: tags.cpp reserving MPI_TAG_UB-1 for neighbor_alltoallw),
+    and ANY_TAG is receive-only."""
+    from tempi_tpu.parallel import p2p, tags
+
+    ty = dt.contiguous(8, dt.BYTE)
+    s, _ = fill(world, 8)
+    r = world.alloc(8)
+    with pytest.raises(ValueError, match="out of the application range"):
+        api.isend(world, 0, s, 1, ty, tag=tags.NEIGHBOR_ALLTOALLW)
+    with pytest.raises(ValueError, match="receive-only"):
+        api.isend(world, 0, s, 1, ty, tag=p2p.ANY_TAG)
+    with pytest.raises(ValueError, match="out of the application range"):
+        api.irecv(world, 1, r, 0, ty, tag=-7)
+    assert not world._pending
+
+
 def test_mismatched_sizes_raise(world):
     ty8 = dt.contiguous(8, dt.BYTE)
     ty16 = dt.contiguous(16, dt.BYTE)
