@@ -33,6 +33,18 @@ import time
 REFERENCE_V100_PACK_GBS = 50.0
 PACK_BATCH_K = 8
 PACK_SAMPLE_MS = 2.0
+# tunneled-TPU latency is one-sided noise (a congested tunnel only ADDS
+# time); the median of N independent trials reports steady-state capability
+# without cherry-picking a best case. Quick/CPU-fallback mode runs 1 trial
+# (no tunnel noise to damp, and the fallback line must stay cheap).
+N_TRIALS = 3
+
+
+def _median_of(vals):
+    import statistics
+
+    vals = [v for v in vals if v is not None]
+    return statistics.median(vals) if vals else None
 
 
 def _probe_once(timeout_s: int) -> bool:
@@ -87,7 +99,7 @@ def _accelerator_usable() -> bool:
         sleep_s = min(sleep_s * 2, 60)
 
 
-def bench_pack(jax, devices):
+def bench_pack(jax, devices, quick: bool = False):
     import jax.numpy as jnp
     import numpy as np
 
@@ -117,9 +129,13 @@ def bench_pack(jax, devices):
     def enqueue():
         last[:] = [mega(bufs)]
 
-    r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
-                  min_sample_secs=PACK_SAMPLE_MS * 1e-3, max_trial_secs=3.0)
-    return ty.size * K / r.trimean / 1e9
+    gbs = []
+    for _ in range(1 if quick else N_TRIALS):
+        r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
+                      min_sample_secs=PACK_SAMPLE_MS * 1e-3,
+                      max_trial_secs=3.0)
+        gbs.append(ty.size * K / r.trimean / 1e9)
+    return _median_of(gbs)
 
 
 def bench_pingpong_nd(jax, quick: bool):
@@ -154,7 +170,9 @@ def bench_pingpong_nd(jax, quick: bool):
     pingpong()  # compile
     kw = dict(max_trial_secs=0.3, max_samples=30) if quick else \
         dict(max_trial_secs=1.5)
-    r = benchmark(pingpong, **kw)
+    trials = 1 if quick else N_TRIALS
+    r_p50 = _median_of([benchmark(pingpong, **kw).stats.med()
+                        for _ in range(trials)])
     hops = 2 if a != b else 1
 
     # two direction batches started SEQUENTIALLY so the persistent figure
@@ -174,7 +192,8 @@ def bench_pingpong_nd(jax, quick: bool):
         buf.data.block_until_ready()
 
     persistent()  # build the batches
-    rp = benchmark(persistent, **kw)
+    rp_p50 = _median_of([benchmark(persistent, **kw).stats.med()
+                         for _ in range(trials)])
 
     # per-strategy p50s: the reference bench exists to compare DEVICE vs
     # STAGED vs ONESHOT (bench_mpi_pingpong_nd.cpp); report each transport
@@ -185,13 +204,14 @@ def bench_pingpong_nd(jax, quick: bool):
 
         try:
             strat_pp()  # compile
-            rs = benchmark(strat_pp, **kw)
-            per_strategy[strat] = rs.stats.med() / hops
+            rs = _median_of([benchmark(strat_pp, **kw).stats.med()
+                             for _ in range(trials)])
+            per_strategy[strat] = rs / hops
         except Exception as e:
             print(f"pingpong {strat} failed: {e!r}", file=sys.stderr)
             per_strategy[strat] = None
-    return (r.stats.med() / hops, ("pair" if a != b else "self"),
-            rp.stats.med() / hops, per_strategy)
+    return (r_p50 / hops, ("pair" if a != b else "self"),
+            rp_p50 / hops, per_strategy)
 
 
 def bench_halo(jax, n_devices: int, quick: bool):
@@ -221,8 +241,7 @@ def bench_halo(jax, n_devices: int, quick: bool):
         ex.exchange(buf)
         buf.data.block_until_ready()
         times.append(time.perf_counter() - t0)
-    times.sort()
-    med = times[len(times) // 2]  # median: robust to tunnel hiccups
+    med = _median_of(times)  # median: robust to tunnel hiccups
     return 1.0 / med, f"X={X} ranks={comm.size} periodic={periodic}"
 
 
@@ -358,7 +377,7 @@ def main() -> int:
     api.init(devices)
     quick = platform != "tpu"
 
-    gbs = bench_pack(jax, devices)
+    gbs = bench_pack(jax, devices, quick)
     try:
         pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
     except Exception as e:  # never lose the headline to a secondary metric
@@ -395,6 +414,7 @@ def main() -> int:
         "platform": platform,
         "batch_k": PACK_BATCH_K,
         "sample_ms": PACK_SAMPLE_MS,
+        "trials": 1 if quick else N_TRIALS,
         "pingpong_nd_p50_us": (round(pp_p50 * 1e6, 2)
                                if pp_p50 is not None else None),
         "pingpong_nd_mode": pp_mode,

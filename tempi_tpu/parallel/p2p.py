@@ -37,6 +37,20 @@ from .plan import Message, get_plan
 
 ANY_TAG = -1
 
+
+def _check_tag(kind: str, tag: int) -> None:
+    """Application tags must stay below the reserved internal range — the
+    reservation is what makes internal neighbor traffic collision-free
+    (reference: tags.cpp reserving MPI_TAG_UB-1); internal paths construct
+    Messages directly and are never checked here. Validated both at post
+    time and at *_init time so a persistent batch can never raise mid-post
+    (MPI also surfaces a bad tag at Send_init, not at Start)."""
+    if not ((0 <= tag < tags.RESERVED_BASE)
+            or (kind == "recv" and tag == ANY_TAG)):
+        raise ValueError(
+            f"tag {tag} out of the application range [0, {tags.RESERVED_BASE})"
+            + (" (ANY_TAG is receive-only)" if tag == ANY_TAG else ""))
+
 _req_ids = itertools.count(1)
 
 
@@ -81,14 +95,7 @@ def _packer_for(datatype: Datatype):
 def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
           offset: int) -> Request:
-    # the reserved range is what makes internal neighbor traffic collision-
-    # free (reference: tags.cpp reserving MPI_TAG_UB-1); internal paths
-    # construct Messages directly and never come through here
-    if not ((0 <= tag < tags.RESERVED_BASE)
-            or (kind == "recv" and tag == ANY_TAG)):
-        raise ValueError(
-            f"tag {tag} out of the application range [0, {tags.RESERVED_BASE})"
-            + (" (ANY_TAG is receive-only)" if tag == ANY_TAG else ""))
+    _check_tag(kind, tag)
     packer, rec = _packer_for(datatype)
     req = Request(next(_req_ids), comm, buf=buf)
     op = Op(kind=kind, rank=comm.library_rank(app_rank),
@@ -414,6 +421,9 @@ class PersistentRequest:
     active: Optional[Request] = None
     batch: Optional["_PersistentBatch"] = None
 
+    def __post_init__(self) -> None:
+        _check_tag(self.kind, self.tag)
+
     def start(self) -> None:
         startall([self])
 
@@ -564,13 +574,17 @@ def _start_eager(comm: Communicator, preqs: Sequence[PersistentRequest],
     return to INACTIVE — the same retryable contract as the other start
     paths; without the withdrawal a retry would double-post and the stale
     ops would corrupt FIFO matching (and trip finalize's leak check)."""
-    reqs = [_post(comm, p.kind, p.app_rank, p.buf, p.peer, p.datatype,
-                  p.count, p.tag, p.offset) for p in preqs]
-    for p, r in zip(preqs, reqs):
-        p.active = r
+    reqs: List[Request] = []
     try:
+        for p in preqs:
+            reqs.append(_post(comm, p.kind, p.app_rank, p.buf, p.peer,
+                              p.datatype, p.count, p.tag, p.offset))
+        for p, r in zip(preqs, reqs):
+            p.active = r
         try_progress(comm, strategy)
     except BaseException:
+        # also covers a raise from _post mid-batch (e.g. an uncommittable
+        # datatype): the already-posted prefix must not stay pending
         _withdraw_pending(comm, reqs)
         for p in preqs:
             p.active = None

@@ -131,6 +131,19 @@ def test_reserved_tags_rejected(world):
     assert not world._pending
 
 
+def test_reserved_tag_rejected_at_init_no_leak(world):
+    """A bad tag surfaces at send_init/recv_init (MPI validates at *_init,
+    not Start), so a startall batch can never raise mid-post and strand a
+    validly-tagged member in comm._pending."""
+    from tempi_tpu.parallel import p2p, tags
+
+    ty = dt.contiguous(8, dt.BYTE)
+    s, _ = fill(world, 8)
+    with pytest.raises(ValueError, match="out of the application range"):
+        p2p.send_init(world, 0, s, 1, ty, tag=tags.NEIGHBOR_ALLTOALLW)
+    assert not world._pending
+
+
 def test_mismatched_sizes_raise(world):
     ty8 = dt.contiguous(8, dt.BYTE)
     ty16 = dt.contiguous(16, dt.BYTE)
