@@ -40,6 +40,12 @@ PACK_SAMPLE_MS = 2.0
 N_TRIALS = 3
 
 
+def _trials(quick: bool) -> int:
+    """Single source of truth so the JSON methodology field can't drift
+    from what the benches actually ran."""
+    return 1 if quick else N_TRIALS
+
+
 def _median_of(vals):
     import statistics
 
@@ -130,7 +136,7 @@ def bench_pack(jax, devices, quick: bool = False):
         last[:] = [mega(bufs)]
 
     gbs = []
-    for _ in range(1 if quick else N_TRIALS):
+    for _ in range(_trials(quick)):
         r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
                       min_sample_secs=PACK_SAMPLE_MS * 1e-3,
                       max_trial_secs=3.0)
@@ -170,7 +176,7 @@ def bench_pingpong_nd(jax, quick: bool):
     pingpong()  # compile
     kw = dict(max_trial_secs=0.3, max_samples=30) if quick else \
         dict(max_trial_secs=1.5)
-    trials = 1 if quick else N_TRIALS
+    trials = _trials(quick)
     r_p50 = _median_of([benchmark(pingpong, **kw).stats.med()
                         for _ in range(trials)])
     hops = 2 if a != b else 1
@@ -414,7 +420,7 @@ def main() -> int:
         "platform": platform,
         "batch_k": PACK_BATCH_K,
         "sample_ms": PACK_SAMPLE_MS,
-        "trials": 1 if quick else N_TRIALS,
+        "trials": _trials(quick),
         "pingpong_nd_p50_us": (round(pp_p50 * 1e6, 2)
                                if pp_p50 is not None else None),
         "pingpong_nd_mode": pp_mode,

@@ -199,6 +199,7 @@ wait = p2p.wait
 waitall = p2p.waitall
 Request = p2p.Request
 ANY_TAG = p2p.ANY_TAG
+ANY_SOURCE = p2p.ANY_SOURCE
 
 # persistent requests (MPI_Send_init/Recv_init/Startall analogs): repeated
 # exchange patterns pay matching + strategy selection once and replay the

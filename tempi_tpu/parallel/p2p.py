@@ -36,6 +36,23 @@ from .communicator import Communicator, DistBuffer
 from .plan import Message, get_plan
 
 ANY_TAG = -1
+ANY_SOURCE = -2
+
+
+def _check_rank(comm: Communicator, rank: int, what: str,
+                kind: str = "send") -> None:
+    """MPI_ERR_RANK analog: a peer outside [0, size) must fail here with a
+    clear error, not as an index fault deep inside a compiled plan (seen on
+    a 1-device TPU when a test written for the 8-rank mesh posted to rank 1).
+    ANY_SOURCE is legal only as a receive's peer (MPI: source wildcard)."""
+    if kind == "recv" and what == "peer" and rank == ANY_SOURCE:
+        return
+    if not (0 <= rank < comm.size):
+        raise ValueError(
+            f"{what} rank {rank} out of range for a {comm.size}-rank "
+            "communicator"
+            + (" (ANY_SOURCE is only valid as a receive's source)"
+               if rank == ANY_SOURCE else ""))
 
 
 def _check_tag(kind: str, tag: int) -> None:
@@ -96,10 +113,14 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
           offset: int) -> Request:
     _check_tag(kind, tag)
+    _check_rank(comm, app_rank, "local", kind)
+    _check_rank(comm, peer_app, "peer", kind)
     packer, rec = _packer_for(datatype)
     req = Request(next(_req_ids), comm, buf=buf)
+    peer_lib = (ANY_SOURCE if peer_app == ANY_SOURCE
+                else comm.library_rank(peer_app))
     op = Op(kind=kind, rank=comm.library_rank(app_rank),
-            peer=comm.library_rank(peer_app), tag=tag, buf=buf, offset=offset,
+            peer=peer_lib, tag=tag, buf=buf, offset=offset,
             packer=packer, count=count, nbytes=count * datatype.size,
             request=req)
     with comm._progress_lock:
@@ -153,8 +174,9 @@ def recv(comm: Communicator, app_rank: int, buf: DistBuffer, source: int,
 
 
 def _match(pending: List[Op]):
-    """FIFO matching by (src, dst, tag) (MPI ordering semantics). Returns
-    (messages, consumed ops, leftover ops)."""
+    """FIFO matching by (src, dst, tag) (MPI ordering semantics); a recv
+    posted with ANY_SOURCE/ANY_TAG wildcard-matches the earliest eligible
+    send to its rank. Returns (messages, consumed ops, leftover ops)."""
     sends = [op for op in pending if op.kind == "send"]
     recvs = [op for op in pending if op.kind == "recv"]
     used_r = [False] * len(recvs)
@@ -163,7 +185,9 @@ def _match(pending: List[Op]):
         for i, r in enumerate(recvs):
             if used_r[i]:
                 continue
-            if r.rank != s.peer or r.peer != s.rank:
+            if r.rank != s.peer:
+                continue
+            if r.peer != ANY_SOURCE and r.peer != s.rank:
                 continue
             if r.tag != ANY_TAG and r.tag != s.tag:
                 continue
@@ -423,6 +447,8 @@ class PersistentRequest:
 
     def __post_init__(self) -> None:
         _check_tag(self.kind, self.tag)
+        _check_rank(self.comm, self.app_rank, "local", self.kind)
+        _check_rank(self.comm, self.peer, "peer", self.kind)
 
     def start(self) -> None:
         startall([self])

@@ -131,6 +131,29 @@ def test_reserved_tags_rejected(world):
     assert not world._pending
 
 
+def test_any_source_recv(world):
+    """An ANY_SOURCE recv matches the earliest send addressed to its rank
+    regardless of sender (MPI source wildcard; the reference gets this via
+    the underlying library, src/irecv.cpp — our engine matches it itself)."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(8, dt.BYTE)
+    s1, _ = fill(world, 8, seed=4)
+    s2, _ = fill(world, 8, seed=5)
+    r1 = world.alloc(8)
+    r2 = world.alloc(8)
+    api.isend(world, 2, s1, 1, ty, tag=7)
+    api.isend(world, 3, s2, 1, ty, tag=7)
+    qa = api.irecv(world, 1, r1, p2p.ANY_SOURCE, ty, tag=7)
+    qb = api.irecv(world, 1, r2, p2p.ANY_SOURCE, ty, tag=p2p.ANY_TAG)
+    api.waitall([qa, qb])
+    np.testing.assert_array_equal(r1.get_rank(1), s1.get_rank(2))
+    np.testing.assert_array_equal(r2.get_rank(1), s2.get_rank(3))
+    # send-side wildcard is illegal
+    with pytest.raises(ValueError, match="receive's source"):
+        api.isend(world, 0, s1, p2p.ANY_SOURCE, ty)
+
+
 def test_reserved_tag_rejected_at_init_no_leak(world):
     """A bad tag surfaces at send_init/recv_init (MPI validates at *_init,
     not Start), so a startall batch can never raise mid-post and strand a
