@@ -115,6 +115,15 @@ def make_2d_hv_by_rows(block_size, c1, s1, c2, s2):
     return dt.hvector(c2, 1, s2, row)
 
 
+def make_2d_hv_by_cols(block_size, c1, s1, c2, s2):
+    """columns of blocks first (inner hvector strides by ROW), then a row
+    of columns — the transposed traversal of by_rows (type.cpp:261-274);
+    packs the same cells in a different visit order."""
+    block = dt.contiguous(block_size, dt.BYTE)
+    col = dt.hvector(c2, 1, s2, block)
+    return dt.hvector(c1, 1, s1, col)
+
+
 def make_contiguous_byte_v1(n):
     return dt.vector(1, n, n, dt.BYTE)
 

@@ -59,6 +59,15 @@ def test_3d(name, incount):
     roundtrip(st.FACTORIES_3D[name]((8, 4, 2), (16, 8, 4)), incount=incount)
 
 
+@pytest.mark.parametrize("make", [st.make_2d_hv_by_rows,
+                                  st.make_2d_hv_by_cols])
+def test_2d_hv_traversals(make):
+    """by_rows and by_cols (reference type.cpp:245-274) pack the same cells
+    in transposed visit orders; each must match the typemap oracle."""
+    # 4 B blocks at 16 B stride in a row, rows 64 B apart
+    roundtrip(make(4, 4, 16, 4, 64), incount=1)
+
+
 def test_3d_odd_sizes():
     roundtrip(st.make_subarray((3, 5, 7), (11, 13, 17)))
     roundtrip(st.make_byte_v_hv((4, 3, 5), (12, 6, 9)), incount=2)
