@@ -173,7 +173,12 @@ class PackerFallback(Packer):
                     f", buffer has {nbytes} bytes")
             if hi > np.iinfo(np.int32).max:
                 raise ValueError("typemap offsets exceed int32 range")
-        idx32 = jnp.asarray(all_idx.astype(np.int32))
+        # MUST stay numpy: _fns may first run inside a jit trace (fallback
+        # packer in a compiled exchange plan); jnp.asarray there returns a
+        # tracer, and caching it in the pk/up closures leaks it into every
+        # later trace (UnexpectedTracerError). A numpy array is a fresh
+        # constant in whichever trace uses it.
+        idx32 = all_idx.astype(np.int32)
 
         @jax.jit
         def pk(u8):

@@ -170,6 +170,22 @@ class Environment:
             e.torus = ()
 
         e.progress_thread = getenv("TEMPI_PROGRESS_THREAD") is not None
+
+        if e.no_tempi:
+            # TEMPI_DISABLE is the reference's global bail-out: every
+            # interposed entry point forwards to the underlying library
+            # untouched (src/send.cpp:13-15, checked before anything else,
+            # so it overrides every other knob — hence applied last here).
+            # Our "underlying library" is plain XLA: typemap pack, no
+            # datatype analysis, native all_to_all, no placement remap, no
+            # strategy modeling (DEVICE = the direct exchange), no pump.
+            e.no_pack = True
+            e.no_type_commit = True
+            e.alltoallv = AlltoallvMethod.NONE
+            e.placement = PlacementMethod.NONE
+            e.datatype = DatatypeMethod.DEVICE
+            e.contiguous = ContiguousMethod.NONE
+            e.progress_thread = False
         return e
 
 

@@ -131,6 +131,40 @@ def test_reserved_tags_rejected(world):
     assert not world._pending
 
 
+def test_tempi_disable_differential(monkeypatch):
+    """With TEMPI_DISABLE the exchange must produce identical bytes through
+    the baseline paths (typemap pack, no type analysis) — the reference's
+    tier-2 pattern of toggling the library off as its own oracle."""
+    import support_types as st
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.setenv("TEMPI_DISABLE", "")
+    envmod.read_environment()
+    assert envmod.env.no_tempi
+    comm = api.init()
+    try:
+        ty = st.make_2d_byte_vector(8, 16, 32)
+        rows = [np.random.default_rng(r).integers(0, 256, ty.extent, np.uint8)
+                for r in range(comm.size)]
+        s = comm.buffer_from_host(rows)
+        r_ = comm.alloc(ty.extent)
+        api.isend(comm, 0, s, 1, ty)
+        api.irecv(comm, 1, r_, 0, ty)
+        from tempi_tpu.parallel import p2p
+        p2p.try_progress(comm)
+        packed = st.oracle_pack(rows[0], ty, 1)
+        want = st.oracle_unpack(np.zeros(ty.extent, np.uint8), packed, ty, 1)
+        np.testing.assert_array_equal(r_.get_rank(1), want)
+        # the analysis pipeline must have been bypassed entirely: no
+        # planned packer exists, the exchange rode the typemap fallback
+        from tempi_tpu.ops import type_cache
+        rec = type_cache.get_or_commit(ty)
+        assert rec.packer is None
+        assert rec.best_packer() is rec.fallback
+    finally:
+        api.finalize()
+
+
 def test_any_source_recv(world):
     """An ANY_SOURCE recv matches the earliest send addressed to its rank
     regardless of sender (MPI source wildcard; the reference gets this via

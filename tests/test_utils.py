@@ -44,17 +44,34 @@ def test_env_defaults():
 
 def test_env_knobs():
     e = Environment.from_environ({
-        "TEMPI_DISABLE": "", "TEMPI_NO_PACK": "",
+        "TEMPI_NO_PACK": "",
         "TEMPI_ALLTOALLV_STAGED": "", "TEMPI_PLACEMENT_KAHIP": "",
         "TEMPI_DATATYPE_ONESHOT": "", "TEMPI_CONTIGUOUS_AUTO": "",
         "TEMPI_CACHE_DIR": "/tmp/tc",
     })
-    assert e.no_tempi and e.no_pack
+    assert e.no_pack and not e.no_tempi
     assert e.alltoallv is AlltoallvMethod.STAGED
     assert e.placement is PlacementMethod.KAHIP
     assert e.datatype is DatatypeMethod.ONESHOT
     assert e.contiguous is ContiguousMethod.AUTO
     assert e.cache_dir == "/tmp/tc"
+
+
+def test_env_disable_overrides_everything():
+    """TEMPI_DISABLE is the reference's global bail-out, checked before any
+    other knob in every interposed function (src/send.cpp:13-15) — so it
+    must force every baseline path regardless of what else is set."""
+    e = Environment.from_environ({
+        "TEMPI_DISABLE": "", "TEMPI_ALLTOALLV_STAGED": "",
+        "TEMPI_PLACEMENT_KAHIP": "", "TEMPI_DATATYPE_ONESHOT": "",
+        "TEMPI_CONTIGUOUS_AUTO": "", "TEMPI_PROGRESS_THREAD": "",
+    })
+    assert e.no_tempi and e.no_pack and e.no_type_commit
+    assert e.alltoallv is AlltoallvMethod.NONE
+    assert e.placement is PlacementMethod.NONE
+    assert e.datatype is DatatypeMethod.DEVICE
+    assert e.contiguous is ContiguousMethod.NONE
+    assert not e.progress_thread
 
 
 def test_env_no_alltoallv_wins():
