@@ -298,6 +298,69 @@ def bench_alltoallv_sparse(jax, quick: bool, reorder: bool):
     return r.trimean
 
 
+def _cpu_mesh_nbr32_child() -> int:
+    """Child mode: BASELINE config 5 at its stated scale — sparse
+    neighbor_alltoallv over a 32-rank simulated 8x4 ICI torus, with and
+    without the dist-graph reorder (reference:
+    bin/bench_nbr_alltoallv_random_sparse.cpp)."""
+    from tempi_tpu.utils.platform import force_cpu
+
+    force_cpu(device_count=32)
+    import os
+
+    os.environ.setdefault("TEMPI_RANKS_PER_NODE", "8")
+    os.environ.setdefault("TEMPI_TORUS", "8x4")
+    import numpy as np
+    import jax
+
+    from tempi_tpu import api
+    from tempi_tpu.utils.env import PlacementMethod
+
+    comm = api.init(jax.devices())
+    size = comm.size
+    rng = np.random.default_rng(7)
+    counts = rng.integers(1, 1 << 8, (size, size))
+    counts[rng.random((size, size)) > 0.15] = 0
+    np.fill_diagonal(counts, 0)
+    sources = [[int(s) for s in np.nonzero(counts[:, r])[0]]
+               for r in range(size)]
+    dests = [[int(d) for d in np.nonzero(counts[r])[0]] for r in range(size)]
+    sw = [[int(counts[s, r]) for s in sources[r]] for r in range(size)]
+    dw = [[int(counts[r, d]) for d in dests[r]] for r in range(size)]
+    from tempi_tpu.measure.benchmark import benchmark
+
+    # counts/displacements are in application-rank space and don't depend
+    # on the reorder; per-edge send counts = dw, recv counts = sw
+    sc, rc = dw, sw
+    sdis = [[int(x) for x in np.concatenate([[0], np.cumsum(c[:-1])])]
+            if c else [] for c in sc]
+    rdis = [[int(x) for x in np.concatenate([[0], np.cumsum(c[:-1])])]
+            if c else [] for c in rc]
+    out = {}
+    for label, reorder in (("nbr_alltoallv_sparse_32_s", False),
+                           ("nbr_alltoallv_sparse_32_remap_s", True)):
+        try:
+            g = api.dist_graph_create_adjacent(
+                comm, sources, dests, sweights=sw, dweights=dw,
+                reorder=reorder, method=PlacementMethod.KAHIP)
+            sb = g.alloc(max(max((sum(c) for c in sc), default=1), 1))
+            rb = g.alloc(max(max((sum(c) for c in rc), default=1), 1))
+
+            def run(g=g, sb=sb, rb=rb):
+                api.neighbor_alltoallv(g, sb, sc, sdis, rb, rc, rdis)
+                rb.data.block_until_ready()
+
+            run()  # compile
+            r = benchmark(run, max_trial_secs=0.5, max_samples=20)
+            out[label] = round(r.trimean, 6)
+        except Exception as e:
+            print(f"{label} failed: {e!r}", file=sys.stderr)
+            out[label] = None
+    api.finalize()
+    print(json.dumps(out))
+    return 0
+
+
 def _cpu_mesh_alltoallv_child() -> int:
     """Child mode: configs 4/5 on a virtual 8-device CPU mesh. A single
     real chip can't run the multi-rank alltoallv configs; this gives the
@@ -332,31 +395,31 @@ def _cpu_mesh_alltoallv_child() -> int:
     return 0
 
 
-def _cpu_mesh_alltoallv(timeout_s: float = 240.0) -> dict:
-    """Run the child mode in a subprocess (the parent's JAX backend is
-    already bound to the accelerator) and return its metrics."""
+def _cpu_mesh_child(flag: str, timeout_s: float = 240.0) -> dict:
+    """Run a ``--cpu-mesh-*`` child mode in a subprocess (the parent's JAX
+    backend is already bound to the accelerator) and return its metrics."""
     import os
     import subprocess
 
     # a parent force_cpu(1) exports XLA_FLAGS/JAX_PLATFORMS into os.environ;
-    # the child must pick its own 8-device config
+    # the child must pick its own virtual-device config
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
            and not k.startswith("TEMPI_")}
     try:
         r = subprocess.run(
-            [sys.executable, __file__, "--cpu-mesh-alltoallv"],
+            [sys.executable, __file__, flag],
             capture_output=True, timeout=timeout_s, text=True, env=env)
         if r.returncode == 0 and r.stdout.strip():
             sim = json.loads(r.stdout.strip().splitlines()[-1])
             if all(v is None for v in sim.values()):
-                print(f"cpu-mesh alltoallv child returned no data: "
+                print(f"{flag} child returned no data: "
                       f"{r.stderr[-400:]}", file=sys.stderr)
             return sim
-        print(f"cpu-mesh alltoallv child failed (rc {r.returncode}): "
+        print(f"{flag} child failed (rc {r.returncode}): "
               f"{r.stderr[-400:]}", file=sys.stderr)
     except Exception as e:
-        print(f"cpu-mesh alltoallv child failed: {e!r}", file=sys.stderr)
+        print(f"{flag} child failed: {e!r}", file=sys.stderr)
     return {}
 
 
@@ -365,6 +428,8 @@ def main() -> int:
 
     if "--cpu-mesh-alltoallv" in sys.argv:
         return _cpu_mesh_alltoallv_child()
+    if "--cpu-mesh-nbr32" in sys.argv:
+        return _cpu_mesh_nbr32_child()
 
     platform = "tpu"
     forced = os.environ.get("TEMPI_BENCH_FORCE", "")
@@ -406,11 +471,17 @@ def main() -> int:
             a2av[label] = None
     api.finalize()
     if all(v is None for v in a2av.values()):
-        sim = _cpu_mesh_alltoallv()
+        sim = _cpu_mesh_child("--cpu-mesh-alltoallv")
         if any(v is not None for v in sim.values()):
             a2av.update(sim)
             a2av_platform = "cpu-mesh-8"  # simulated mesh, NOT the chip
     a2av["alltoallv_platform"] = a2av_platform
+    # config 5 at its judged 32-rank scale (always a simulated mesh here:
+    # one chip can't host 32 ranks); labeled by its own platform field
+    nbr32 = _cpu_mesh_child("--cpu-mesh-nbr32")
+    if any(v is not None for v in nbr32.values()):
+        a2av.update(nbr32)
+        a2av["nbr32_platform"] = "cpu-mesh-32"
 
     print(json.dumps({
         "metric": f"bench-mpi-pack 2D subarray pack bandwidth ({platform})",
