@@ -435,6 +435,144 @@ def _cpu_mesh_child(flag: str, timeout_s: float = 240.0) -> dict:
     return {}
 
 
+def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
+    """All accelerator-bound metrics, one ``emit(dict)`` per completed
+    metric — shared by the subprocess child (streams each line) and the
+    in-process CPU fallback (accumulates into one dict). The caller has
+    already run ``api.init``. Per-metric failures are reported with
+    explicit nulls so the output schema stays stable."""
+    emit({"pack_gbs": round(bench_pack(jax, devices, quick), 3)})
+    try:
+        pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
+        emit({"pingpong_nd_p50_us": round(pp_p50 * 1e6, 2),
+              "pingpong_nd_mode": pp_mode,
+              "pingpong_nd_persistent_p50_us": (
+                  round(pp_pers * 1e6, 2) if pp_pers is not None else None),
+              "pingpong_nd_staged_p50_us": (
+                  round(pp_strat["staged"] * 1e6, 2)
+                  if pp_strat.get("staged") is not None else None),
+              "pingpong_nd_oneshot_p50_us": (
+                  round(pp_strat["oneshot"] * 1e6, 2)
+                  if pp_strat.get("oneshot") is not None else None)})
+    except Exception as e:
+        print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
+        emit({"pingpong_nd_p50_us": None, "pingpong_nd_mode": "failed",
+              "pingpong_nd_persistent_p50_us": None,
+              "pingpong_nd_staged_p50_us": None,
+              "pingpong_nd_oneshot_p50_us": None})
+    try:
+        halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
+        emit({"halo_iters_per_s": round(halo_ips, 2),
+              "halo_config": halo_cfg})
+    except Exception as e:
+        print(f"halo failed: {e!r}", file=sys.stderr)
+        emit({"halo_iters_per_s": None, "halo_config": "failed"})
+    for label, reorder in (("alltoallv_sparse_s", False),
+                           ("alltoallv_sparse_remap_s", True)):
+        try:
+            emit({label: round(
+                bench_alltoallv_sparse(jax, quick, reorder), 6)})
+        except Exception as e:  # single chip: configs 4/5 are multi-rank
+            print(f"{label} skipped: {e!r}", file=sys.stderr)
+            emit({label: None})
+
+
+def _device_bench_child() -> int:
+    """Child mode: every accelerator-bound metric, streamed as one JSON
+    line per completed metric. Run in a subprocess because a tunnel that
+    wedges MID-BENCH blocks in PJRT C code where no in-process timeout can
+    fire — the parent then keeps the metrics already streamed (partial
+    evidence) instead of hanging and forfeiting the whole capture."""
+    import jax
+
+    from tempi_tpu import api
+
+    def emit(d: dict) -> None:
+        print(json.dumps(d), flush=True)
+
+    devices = jax.devices()
+    api.init(devices)
+    try:
+        _collect_device_metrics(jax, devices, False, emit)
+    finally:
+        api.finalize()
+    emit({"device_bench_done": True})
+    return 0
+
+
+def _device_bench(inactivity_s: float = 300.0,
+                  overall_s: float = 1200.0) -> dict:
+    """Run --device-bench in a subprocess, merging its streamed metric
+    lines. Kills the child after ``inactivity_s`` with no new output (a
+    wedged tunnel) or ``overall_s`` total, keeping what already arrived.
+    Reads the raw fd (select on a buffered TextIOWrapper can strand
+    buffered lines) and drains it after EOF/kill so a final burst of
+    metrics is never lost."""
+    import os
+    import select
+    import subprocess
+
+    merged: dict = {}
+
+    def consume(chunk_text: str, buf: list) -> None:
+        buf[0] += chunk_text
+        while "\n" in buf[0]:
+            line, buf[0] = buf[0].split("\n", 1)
+            try:
+                d = json.loads(line)
+                if isinstance(d, dict):
+                    merged.update(d)
+            except ValueError:
+                pass  # non-JSON noise on stdout (runtime chatter)
+
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, __file__, "--device-bench"],
+            stdout=subprocess.PIPE, stderr=None,  # stderr passes through
+            env=dict(os.environ))
+        fd = proc.stdout.fileno()
+        buf = [""]
+        deadline = time.monotonic() + overall_s
+        last_data = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= deadline or now - last_data >= inactivity_s:
+                print("device bench child stalled (wedged tunnel?); "
+                      f"keeping {len(merged)} partial metrics",
+                      file=sys.stderr)
+                break
+            if not select.select([fd], [], [], 5.0)[0]:
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:  # EOF: child exited
+                break
+            last_data = time.monotonic()
+            consume(chunk.decode("utf-8", "replace"), buf)
+        # drain anything still readable without blocking, then parse the
+        # unterminated tail too (a killed child may end mid-line)
+        while select.select([fd], [], [], 0)[0]:
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break
+            consume(chunk.decode("utf-8", "replace"), buf)
+        consume("\n", buf)
+    except Exception as e:
+        print(f"device bench child failed: {e!r}", file=sys.stderr)
+    finally:
+        if proc is not None:
+            proc.kill()
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                pass
+    if merged and not merged.pop("device_bench_done", False):
+        # wedged after the last streamed metric: visibly incomplete rather
+        # than byte-identical to a clean capture
+        merged["device_bench_complete"] = False
+    return merged
+
+
 def main() -> int:
     import os
 
@@ -442,6 +580,8 @@ def main() -> int:
         return _cpu_mesh_alltoallv_child()
     if "--cpu-mesh-nbr32" in sys.argv:
         return _cpu_mesh_nbr32_child()
+    if "--device-bench" in sys.argv:
+        return _device_bench_child()
 
     platform = "tpu"
     forced = os.environ.get("TEMPI_BENCH_FORCE", "")
@@ -452,73 +592,69 @@ def main() -> int:
 
         force_cpu(device_count=1)
         platform = "cpu-fallback"
-    import jax
+    dev: dict = {}
+    if platform == "tpu":
+        dev = _device_bench()
+        if "pack_gbs" not in dev:
+            # total wedge after a passing probe: fall back honestly
+            print("device bench produced no headline; CPU fallback",
+                  file=sys.stderr)
+            from tempi_tpu.utils.platform import force_cpu
 
-    from tempi_tpu import api
-
-    devices = jax.devices()
-    api.init(devices)
+            force_cpu(device_count=1)
+            platform = "cpu-fallback"
     quick = platform != "tpu"
 
-    gbs = bench_pack(jax, devices, quick)
-    try:
-        pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
-    except Exception as e:  # never lose the headline to a secondary metric
-        print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
-        pp_p50, pp_mode, pp_pers, pp_strat = None, "failed", None, {}
-    try:
-        halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
-    except Exception as e:
-        print(f"halo failed: {e!r}", file=sys.stderr)
-        halo_ips, halo_cfg = None, "failed"
-    a2av = {}
+    if quick:
+        import jax
+
+        from tempi_tpu import api
+
+        devices = jax.devices()
+        api.init(devices)
+        dev = {}
+        _collect_device_metrics(jax, devices, quick, dev.update)
+        api.finalize()
+
+    # stable schema: a metric the (possibly killed) child never reached
+    # still appears, as an explicit null (BENCH_NOTES captures rely on it)
+    for key, default in (("pingpong_nd_p50_us", None),
+                         ("pingpong_nd_mode", "missing"),
+                         ("pingpong_nd_persistent_p50_us", None),
+                         ("pingpong_nd_staged_p50_us", None),
+                         ("pingpong_nd_oneshot_p50_us", None),
+                         ("halo_iters_per_s", None),
+                         ("halo_config", "missing"),
+                         ("alltoallv_sparse_s", None),
+                         ("alltoallv_sparse_remap_s", None)):
+        dev.setdefault(key, default)
     a2av_platform = platform
-    for label, reorder in (("alltoallv_sparse_s", False),
-                           ("alltoallv_sparse_remap_s", True)):
-        try:
-            a2av[label] = round(
-                bench_alltoallv_sparse(jax, quick, reorder), 6)
-        except Exception as e:  # single chip: configs 4/5 are multi-rank
-            print(f"{label} skipped: {e!r}", file=sys.stderr)
-            a2av[label] = None
-    api.finalize()
-    if all(v is None for v in a2av.values()):
+    if dev.get("alltoallv_sparse_s") is None \
+            and dev.get("alltoallv_sparse_remap_s") is None:
         sim = _cpu_mesh_child("--cpu-mesh-alltoallv")
         if any(v is not None for v in sim.values()):
-            a2av.update(sim)
+            dev.update(sim)
             a2av_platform = "cpu-mesh-8"  # simulated mesh, NOT the chip
-    a2av["alltoallv_platform"] = a2av_platform
+    dev["alltoallv_platform"] = a2av_platform
     # config 5 at its judged 32-rank scale (always a simulated mesh here:
     # one chip can't host 32 ranks); labeled by its own platform field
     nbr32 = _cpu_mesh_child("--cpu-mesh-nbr32")
     if any(v is not None for v in nbr32.values()):
-        a2av.update(nbr32)
-        a2av["nbr32_platform"] = "cpu-mesh-32"
+        dev.update(nbr32)
+        dev["nbr32_platform"] = "cpu-mesh-32"
 
+    gbs = dev.pop("pack_gbs", None)
     print(json.dumps({
         "metric": f"bench-mpi-pack 2D subarray pack bandwidth ({platform})",
-        "value": round(gbs, 3),
+        "value": gbs,
         "unit": "GB/s",
-        "vs_baseline": round(gbs / REFERENCE_V100_PACK_GBS, 3),
+        "vs_baseline": (round(gbs / REFERENCE_V100_PACK_GBS, 3)
+                        if gbs is not None else None),
         "platform": platform,
         "batch_k": PACK_BATCH_K,
         "sample_ms": PACK_SAMPLE_MS,
         "trials": _trials(quick),
-        "pingpong_nd_p50_us": (round(pp_p50 * 1e6, 2)
-                               if pp_p50 is not None else None),
-        "pingpong_nd_mode": pp_mode,
-        "pingpong_nd_persistent_p50_us": (round(pp_pers * 1e6, 2)
-                                          if pp_pers is not None else None),
-        "pingpong_nd_staged_p50_us": (
-            round(pp_strat["staged"] * 1e6, 2)
-            if pp_strat.get("staged") is not None else None),
-        "pingpong_nd_oneshot_p50_us": (
-            round(pp_strat["oneshot"] * 1e6, 2)
-            if pp_strat.get("oneshot") is not None else None),
-        "halo_iters_per_s": (round(halo_ips, 2)
-                             if halo_ips is not None else None),
-        "halo_config": halo_cfg,
-        **a2av,
+        **dev,
     }))
     return 0
 
