@@ -1,0 +1,85 @@
+"""Randomized churn over the p2p engine: every round uses FRESH buffers,
+a random message pattern, a random strategy, and random tag/wildcard
+choices, with every payload verified against the typemap oracle.
+
+This hunts the class of bug where Python-side caches (plan cache, packer
+memos, persistent-batch bindings) capture state from one trace and leak it
+into a later one — the failure mode behind the round-2 fallback-packer
+tracer leak (tempi_tpu/ops/packer.py) — and the class where a cached plan
+is replayed against the wrong buffer binding."""
+
+import numpy as np
+import pytest
+
+import support_types as st
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+TYPES = [
+    lambda: dt.contiguous(48, dt.BYTE),
+    lambda: dt.vector(4, 16, 32, dt.BYTE),
+    lambda: st.make_2d_byte_subarray(8, 32, 64),
+    lambda: st.make_byte_v_hv((8, 4, 2), (16, 8, 4)),
+]
+
+
+def test_churn_random_rounds(world):
+    size = world.size
+    rng = np.random.default_rng(0xC0FFEE)
+    for rnd in range(25):
+        ty = TYPES[int(rng.integers(len(TYPES)))]()
+        strategy = [None, "device", "staged", "oneshot"][
+            int(rng.integers(4))]
+        rows = [rng.integers(0, 256, ty.extent, np.uint8)
+                for _ in range(size)]
+        sbuf = world.buffer_from_host(rows)
+        rbuf = world.alloc(ty.extent)
+
+        # random partial permutation: each selected rank sends to a
+        # distinct target (no rank receives twice into the same buffer)
+        senders = [int(r) for r in rng.permutation(size)[:rng.integers(
+            1, size + 1)]]
+        targets = [int(t) for t in rng.permutation(size)[:len(senders)]]
+        use_wild = rng.random() < 0.3
+        tag = int(rng.integers(0, 100))
+        persistent = rng.random() < 0.3
+
+        if persistent:
+            batch = []
+            for s_, t_ in zip(senders, targets):
+                batch.append(p2p.send_init(world, s_, sbuf, t_, ty,
+                                           tag=tag))
+                batch.append(p2p.recv_init(world, t_, rbuf, s_, ty,
+                                           tag=tag))
+            p2p.startall(batch, strategy)
+            p2p.waitall_persistent(batch, strategy)
+        else:
+            reqs = []
+            for s_, t_ in zip(senders, targets):
+                reqs.append(p2p.isend(world, s_, sbuf, t_, ty, tag=tag))
+                reqs.append(p2p.irecv(
+                    world, t_, rbuf,
+                    p2p.ANY_SOURCE if use_wild else s_, ty,
+                    tag=p2p.ANY_TAG if use_wild else tag))
+            p2p.waitall(reqs, strategy)
+
+        packed = {s_: st.oracle_pack(rows[s_], ty, 1) for s_ in senders}
+        for s_, t_ in zip(senders, targets):
+            want = st.oracle_unpack(np.zeros(ty.extent, np.uint8),
+                                    packed[s_], ty, 1)
+            got = np.asarray(rbuf.get_rank(t_))
+            np.testing.assert_array_equal(
+                got, want,
+                err_msg=f"round={rnd} ty={ty} strat={strategy} "
+                        f"persistent={persistent} wild={use_wild} "
+                        f"{s_}->{t_}")
+        assert not world._pending
