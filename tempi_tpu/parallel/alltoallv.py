@@ -98,23 +98,22 @@ def alltoallv(comm: Communicator, sendbuf: DistBuffer, sendcounts,
 
 
 def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
-    size = comm.size
     M = int(sc.max()) if sc.size else 0
     if M == 0:
         return
     # library-rank-space tables (application displacements translated)
     lsc, lsd, lrd = _lib_tables(comm, sc, sd, rd)
 
-    # Vectorized ragged layout: the count/displacement tables are device
-    # arrays indexed by the traced rank, so the program is ONE masked gather,
-    # ONE fused all_to_all, and ONE masked scatter regardless of mesh size —
-    # no per-rank lax.switch branches (the round-1 design unrolled
-    # O(size^2) pad/slice branches and blew up compile time past 8 ranks).
-    LSC = jnp.asarray(lsc)
-    LSD = jnp.asarray(lsd)
-    LRD = jnp.asarray(lrd)
-
-    def step(s, r):
+    # Vectorized ragged layout: the count/displacement tables are TRACED
+    # ARGUMENTS (replicated across the mesh), so the program is ONE masked
+    # gather, ONE fused all_to_all, and ONE masked scatter regardless of
+    # mesh size — no per-rank lax.switch branches (the round-1 design
+    # unrolled O(size^2) pad/slice branches and blew up compile time past
+    # 8 ranks) — and one compile serves EVERY counts matrix with the same
+    # padded geometry (the reference's eager engine takes per-call counts
+    # with no re-setup, alltoallv_impl.cpp; baking tables as constants
+    # recompiled per matrix).
+    def step(s, r, LSC, LSD, LRD):
         sloc = s.reshape(-1)
         rloc = r.reshape(-1)
         me = jax.lax.axis_index(AXIS)
@@ -137,16 +136,18 @@ def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         rloc = rloc.at[pos.reshape(-1)].set(got.reshape(-1), mode="drop")
         return rloc.reshape(1, -1)
 
-    fn = comm._plan_cache.get(("a2av", M, sendbuf.nbytes, recvbuf.nbytes,
-                               lsc.tobytes(), lsd.tobytes(), lrd.tobytes()))
+    fn = comm._plan_cache.get(("a2av", M, sendbuf.nbytes, recvbuf.nbytes))
     if fn is None:
+        rep = P(None, None)
         sm = jax.shard_map(step, mesh=comm.mesh,
-                           in_specs=(P(AXIS, None), P(AXIS, None)),
+                           in_specs=(P(AXIS, None), P(AXIS, None),
+                                     rep, rep, rep),
                            out_specs=P(AXIS, None), check_vma=False)
         fn = jax.jit(sm)
-        comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes,
-                          lsc.tobytes(), lsd.tobytes(), lrd.tobytes())] = fn
-    recvbuf.data = fn(sendbuf.data, recvbuf.data)
+        comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes)] = fn
+    recvbuf.data = fn(sendbuf.data, recvbuf.data,
+                      jnp.asarray(lsc, jnp.int32), jnp.asarray(lsd, jnp.int32),
+                      jnp.asarray(lrd, jnp.int32))
 
 
 # -- ragged (native XLA ragged-all-to-all) ------------------------------------

@@ -63,6 +63,36 @@ def test_alltoallv_methods(world, method, monkeypatch):
                                       err_msg=f"rank {r} method {method}")
 
 
+def test_alltoallv_same_geometry_single_compile(world):
+    """Two DIFFERENT counts matrices built to share (M, nbytes) must hit
+    exactly one compiled fused program (tables are traced arguments, not
+    baked constants — the reference's engine takes per-call counts with no
+    re-setup, alltoallv_impl.cpp), and the first matrix's results must not
+    leak into the second's."""
+    from tempi_tpu.parallel import alltoallv as a2av_mod
+
+    size = world.size
+    world._plan_cache.clear()
+    base = np.zeros((size, size), np.int64)
+    for s in range(size):
+        base[s, (s + 1) % size] = 8
+    alt = np.zeros_like(base)
+    for s in range(size):
+        alt[s, (s + 2) % size] = 8  # different pattern, same M=8
+    for counts in (base, alt):
+        sdis = np.zeros_like(counts)
+        rdis = np.zeros_like(counts)
+        rows = [np.full(8, s + 1, np.uint8) for s in range(size)]
+        sb = world.buffer_from_host(rows)
+        rb = world.alloc(8)
+        a2av_mod._device_fused(world, sb, counts, sdis, rb, rdis)
+        for d in range(size):
+            src = int(np.nonzero(counts[:, d])[0][0])
+            assert (np.asarray(rb.get_rank(d)) == src + 1).all()
+    keys = [k for k in world._plan_cache if k and k[0] == "a2av"]
+    assert len(keys) == 1, keys
+
+
 def test_alltoallv_float_elements(world):
     """counts in elements of a 4-byte type."""
     size = world.size
