@@ -17,8 +17,13 @@ from tempi_tpu.ops import dtypes as dt
 from tempi_tpu.parallel import p2p
 
 
-@pytest.fixture()
-def world():
+@pytest.fixture(params=["inline", "pump"])
+def world(request, monkeypatch):
+    if request.param == "pump":
+        monkeypatch.setenv("TEMPI_PROGRESS_THREAD", "1")
+        from tempi_tpu.utils import env as envmod
+
+        envmod.read_environment()
     comm = api.init()
     yield comm
     api.finalize()
