@@ -257,12 +257,20 @@ def test_contiguous_sweep(world):
         np.testing.assert_array_equal(r.get_rank(7), rows[6])
 
 
-def test_auto_picks_per_message_strategy(world):
+def test_auto_picks_per_message_strategy(world, monkeypatch):
     """AUTO consults the model PER MESSAGE (reference sender.cpp:251-328):
     with curves where the host path wins small messages and the device path
     wins large ones, one exchange carrying both sizes uses both transports."""
     from tempi_tpu.measure import system as msys
     from tempi_tpu.utils import counters as ctr
+    from tempi_tpu.utils import env as envmod
+
+    # the test is about AUTO: pin it even if the outer environment forces
+    # a method (e.g. a TEMPI_DATATYPE_ONESHOT suite sweep)
+    monkeypatch.setenv("TEMPI_DATATYPE_AUTO", "")
+    monkeypatch.delenv("TEMPI_DATATYPE_ONESHOT", raising=False)
+    monkeypatch.delenv("TEMPI_DATATYPE_DEVICE", raising=False)
+    envmod.read_environment()
 
     sp = msys.SystemPerformance()
     cheap = [[1e-7] * 9 for _ in range(9)]
