@@ -162,6 +162,7 @@ def test_halo_exchange_with_reorder(world, monkeypatch):
     """Same result with KaHIP-style placement reordering active."""
     monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
     monkeypatch.setenv("TEMPI_PLACEMENT_KAHIP", "1")
+    monkeypatch.delenv("TEMPI_DISABLE", raising=False)  # forces NONE
     from tempi_tpu.parallel.communicator import Communicator
     from tempi_tpu.utils import env as envmod
     envmod.read_environment()

@@ -266,10 +266,12 @@ def test_auto_picks_per_message_strategy(world, monkeypatch):
     from tempi_tpu.utils import env as envmod
 
     # the test is about AUTO: pin it even if the outer environment forces
-    # a method (e.g. a TEMPI_DATATYPE_ONESHOT suite sweep)
+    # a method (e.g. a TEMPI_DATATYPE_ONESHOT or TEMPI_DISABLE suite sweep)
     monkeypatch.setenv("TEMPI_DATATYPE_AUTO", "")
     monkeypatch.delenv("TEMPI_DATATYPE_ONESHOT", raising=False)
     monkeypatch.delenv("TEMPI_DATATYPE_DEVICE", raising=False)
+    monkeypatch.delenv("TEMPI_DISABLE", raising=False)
+    monkeypatch.delenv("TEMPI_NO_PACK", raising=False)
     envmod.read_environment()
 
     sp = msys.SystemPerformance()
@@ -304,11 +306,17 @@ def test_auto_picks_per_message_strategy(world, monkeypatch):
 def test_contiguous_method_knobs(world, monkeypatch):
     """TEMPI_CONTIGUOUS_STAGED forces the staged transport for 1-D types;
     AUTO consults the staged-vs-direct model (reference type_commit.cpp:52-73,
-    sender.cpp:34-86)."""
+    sender.cpp:34-86). Requires the planned Packer1D path: under a global
+    TEMPI_NO_PACK sweep every type rides the typemap fallback (the
+    differential-oracle path) and the contiguous knob is correctly moot."""
     from tempi_tpu.measure import system as msys
     from tempi_tpu.utils import counters as ctr
     from tempi_tpu.utils import env as envmod
     from tempi_tpu.parallel import p2p as p2p_mod
+
+    monkeypatch.delenv("TEMPI_NO_PACK", raising=False)
+    monkeypatch.delenv("TEMPI_DISABLE", raising=False)
+    envmod.read_environment()
 
     ty = dt.contiguous(512, dt.BYTE)
     sbuf, rows = fill(world, 512)
