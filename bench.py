@@ -506,7 +506,7 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
                   klabel: k})
         except Exception as e:
             print(f"{label} failed: {e!r}", file=sys.stderr)
-            emit({label: None})
+            emit({label: None, klabel: k})
     try:
         emit(_model_evidence())
     except Exception as e:
@@ -546,9 +546,11 @@ def _model_evidence() -> dict:
                               if modeled else "unmodeled-fallthrough"),
         "modeling_cache_hits": c.modeling.cache_hit,
         "modeling_cache_misses": c.modeling.cache_miss,
-        "sends_device": c.send.num_device + c.isend.num_device,
-        "sends_oneshot": c.send.num_oneshot + c.isend.num_oneshot,
-        "sends_staged": c.send.num_staged + c.isend.num_staged,
+        # plan-side counters ONLY: they count the transport each message
+        # actually rode; the isend group counts posts, not transports
+        "sends_device": c.send.num_device,
+        "sends_oneshot": c.send.num_oneshot,
+        "sends_staged": c.send.num_staged,
     }
 
 
@@ -764,6 +766,8 @@ def main() -> int:
                          ("pack_gbs_4m", None),
                          ("pack_gbs_1m", None),
                          ("pack_gbs_1k", None),
+                         ("pack_batch_k_1m", None),
+                         ("pack_batch_k_1k", None),
                          *((k, None) for k in _MODEL_EVIDENCE_KEYS)):
         dev.setdefault(key, default)
     a2av_platform = platform

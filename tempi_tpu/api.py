@@ -197,6 +197,8 @@ isend = p2p.isend
 irecv = p2p.irecv
 wait = p2p.wait
 waitall = p2p.waitall
+test = p2p.test
+testall = p2p.testall
 Request = p2p.Request
 ANY_TAG = p2p.ANY_TAG
 ANY_SOURCE = p2p.ANY_SOURCE

@@ -89,6 +89,9 @@ class Request:
     def wait(self) -> None:
         wait(self)
 
+    def test(self) -> bool:
+        return test(self)
+
 
 @dataclass(slots=True)
 class Op:
@@ -176,7 +179,14 @@ def recv(comm: Communicator, app_rank: int, buf: DistBuffer, source: int,
 def _match(pending: List[Op]):
     """FIFO matching by (src, dst, tag) (MPI ordering semantics); a recv
     posted with ANY_SOURCE/ANY_TAG wildcard-matches the earliest eligible
-    send to its rank. Returns (messages, consumed ops, leftover ops)."""
+    send to its rank. Returns (messages, consumed ops, leftover ops).
+
+    A matched pair whose sizes differ raises (MPI_ERR_TRUNCATE analog) and
+    fails the whole progress call. NOTE for wildcard users: a wildcard recv
+    can envelope-match a send the application intended for a LATER specific
+    recv of a different size — MPI semantics are identical (the wildcard
+    matches first in FIFO order and truncation is an error), but the error
+    here aborts every op in the progress call, not just the pair."""
     sends = [op for op in pending if op.kind == "send"]
     recvs = [op for op in pending if op.kind == "recv"]
     used_r = [False] * len(recvs)
@@ -380,6 +390,66 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
         req.buf = None
 
 
+def test(req: Request, strategy: Optional[str] = None) -> bool:
+    """MPI_Test analog: nonblocking completion query. The reference's async
+    engine is poll-based — wake() advances the state machine with
+    cudaEventQuery/MPI_Test and never blocks (async_operation.cpp:154-194);
+    this is that poll surfaced to the caller. One progress attempt runs
+    (only already-matched pairs execute — nonblocking); the request is
+    complete when its exchange has been dispatched AND the exchanged buffer
+    is ready (Event.query, the cudaEventQuery analog). An unmatched peer is
+    simply "not yet" — False, never the deadlock error wait() raises,
+    because MPI_Test on a not-yet-matched request is legal polling."""
+    if not req.done:
+        try_progress(req.comm, strategy)
+    if not req.done:
+        if req.error is not None:
+            raise RuntimeError(
+                "progress engine failed while executing the exchange this "
+                "request was matched into") from req.error
+        return False
+    if req.buf is not None:
+        from ..runtime import events
+        ev = events.request().record(req.buf.data)
+        ready = ev.query()
+        events.release(ev)
+        if not ready:
+            return False
+        req.buf = None  # completion observed; wait() becomes a no-op
+    return True
+
+
+def testall(reqs, strategy: Optional[str] = None) -> bool:
+    """MPI_Testall analog: True only when EVERY request is complete, and
+    only then are the requests' completion events considered drained (a
+    False return leaves each request individually testable/waitable)."""
+    if not all(r.done for r in reqs):
+        # one progress attempt per DISTINCT communicator (a batch may span
+        # comms, like waitall's per-request try_progress)
+        seen: List[Communicator] = []
+        for r in reqs:
+            if not r.done and all(r.comm is not c for c in seen):
+                seen.append(r.comm)
+                try_progress(r.comm, strategy)
+        for r in reqs:
+            if not r.done and r.error is not None:
+                raise RuntimeError(
+                    "progress engine failed while executing the exchange "
+                    "this request was matched into") from r.error
+        if not all(r.done for r in reqs):
+            return False
+    from ..runtime import events
+    for b in _distinct_bufs(reqs):
+        ev = events.request().record(b.data)
+        ready = ev.query()
+        events.release(ev)
+        if not ready:
+            return False
+    for r in reqs:
+        r.buf = None
+    return True
+
+
 def waitall(reqs, strategy: Optional[str] = None) -> None:
     """Complete every request. The completion events are recorded over the
     DISTINCT buffers the batch touched — a 26-edge halo exchange over one
@@ -455,6 +525,36 @@ class PersistentRequest:
 
     def wait(self) -> None:
         waitall_persistent([self])
+
+    def test(self) -> bool:
+        """MPI_Test on an active persistent request: True completes the
+        active instance (the request becomes inactive and startable again,
+        like a successful MPI_Test); False leaves it active. Raising on an
+        engine failure mirrors wait(): the failed instance is withdrawn and
+        the request returns to the inactive, restartable state."""
+        act = self.active
+        if act is None:
+            raise RuntimeError("test() on an inactive persistent request")
+        if not act.done:
+            try_progress(self.comm)
+        if not act.done:
+            if act.error is not None:
+                with self.comm._progress_lock:
+                    _withdraw_pending(self.comm, [act])
+                self.active = None
+                raise RuntimeError(
+                    "progress engine failed while executing the exchange "
+                    "this request was matched into") from act.error
+            return False
+        from ..runtime import events
+        ev = events.request().record(self.buf.data)
+        ready = ev.query()
+        events.release(ev)
+        if not ready:
+            return False
+        act.buf = None
+        self.active = None
+        return True
 
 
 @dataclass(slots=True)
