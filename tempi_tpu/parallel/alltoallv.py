@@ -225,9 +225,13 @@ def _lib_tables(comm, sc, sd, rd):
             lsc[lr, lp] = sc[ar, pr]
             lsd[lr, lp] = sd[ar, pr]
             lrd[lr, lp] = rd[ar, pr]
+    # only segments that MOVE bytes constrain the tables: a large
+    # displacement on a zero-count pair is never read (lanes are masked by
+    # count), so it must not spuriously reject the call
     lim = np.iinfo(np.int32).max
-    if sc.size and max(int((lsd + lsc).max()),
-                       int((lrd + lsc.T).max())) > lim:
+    send_end = np.where(lsc > 0, lsd + lsc, 0)
+    recv_end = np.where(lsc.T > 0, lrd + lsc.T, 0)
+    if sc.size and max(int(send_end.max()), int(recv_end.max())) > lim:
         raise ValueError("alltoallv segment offsets exceed int32 range "
                          "(per-rank buffer too large for device tables)")
     return lsc, lsd, lrd

@@ -409,14 +409,21 @@ def test(req: Request, strategy: Optional[str] = None) -> bool:
                 "request was matched into") from req.error
         return False
     if req.buf is not None:
-        from ..runtime import events
-        ev = events.request().record(req.buf.data)
-        ready = ev.query()
-        events.release(ev)
-        if not ready:
+        if not _buf_ready(req.buf):
             return False
         req.buf = None  # completion observed; wait() becomes a no-op
     return True
+
+
+def _buf_ready(buf: DistBuffer) -> bool:
+    """Non-blocking readiness probe of a buffer's dispatched data: one
+    pooled event, recorded and queried (the cudaEventQuery analog all the
+    MPI_Test paths share)."""
+    from ..runtime import events
+    ev = events.request().record(buf.data)
+    ready = ev.query()
+    events.release(ev)
+    return ready
 
 
 def testall(reqs, strategy: Optional[str] = None) -> bool:
@@ -438,13 +445,8 @@ def testall(reqs, strategy: Optional[str] = None) -> bool:
                     "this request was matched into") from r.error
         if not all(r.done for r in reqs):
             return False
-    from ..runtime import events
-    for b in _distinct_bufs(reqs):
-        ev = events.request().record(b.data)
-        ready = ev.query()
-        events.release(ev)
-        if not ready:
-            return False
+    if not all(_buf_ready(b) for b in _distinct_bufs(reqs)):
+        return False
     for r in reqs:
         r.buf = None
     return True
@@ -546,11 +548,7 @@ class PersistentRequest:
                     "progress engine failed while executing the exchange "
                     "this request was matched into") from act.error
             return False
-        from ..runtime import events
-        ev = events.request().record(self.buf.data)
-        ready = ev.query()
-        events.release(ev)
-        if not ready:
+        if not _buf_ready(self.buf):
             return False
         act.buf = None
         self.active = None
