@@ -517,7 +517,8 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
 _MODEL_EVIDENCE_KEYS = (
     "perf_json_platform", "model_device_s", "model_oneshot_s",
     "auto_choice_nd_1m", "modeling_cache_hits", "modeling_cache_misses",
-    "sends_device", "sends_oneshot", "sends_staged")
+    "sends_device", "sends_oneshot", "sends_staged",
+    "oneshot_rounds_host_landed", "oneshot_rounds_degraded")
 
 
 def _model_evidence() -> dict:
@@ -551,6 +552,11 @@ def _model_evidence() -> dict:
         "sends_device": c.send.num_device,
         "sends_oneshot": c.send.num_oneshot,
         "sends_staged": c.send.num_staged,
+        # attribution of the oneshot number to the path it names: pack
+        # rounds whose output XLA committed to pinned host memory vs
+        # silent device-output degradations (VERDICT r2 item 5)
+        "oneshot_rounds_host_landed": c.send.num_oneshot_landed,
+        "oneshot_rounds_degraded": c.send.num_oneshot_degraded,
     }
 
 

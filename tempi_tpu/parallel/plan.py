@@ -359,10 +359,23 @@ class ExchangePlan:
                 try:
                     payload = pf(*datas)
                     payload.block_until_ready()
+                    # verify the LANDING, not just the absence of an error:
+                    # the oneshot number is only attributable to the
+                    # pinned-host path if XLA actually committed the pack
+                    # output there (VERDICT r2 item 5)
+                    landed_kind = getattr(payload.sharding, "memory_kind",
+                                          None)
+                    if landed_kind == host_kind:
+                        ctr.counters.send.num_oneshot_landed += 1
+                    else:
+                        ctr.counters.send.num_oneshot_degraded += 1
+                        log.debug(f"oneshot pack output landed in "
+                                  f"{landed_kind!r}, not {host_kind!r}")
                 except Exception:
                     # platform without host memory kinds (e.g. CPU): fall
                     # back to plain device outputs for the pack stage, and
                     # remember so later runs don't retry the broken programs
+                    ctr.counters.send.num_oneshot_degraded += 1
                     log.debug(f"memory kind {host_kind!r} unsupported; "
                               "staged pack falls back to device outputs")
                     if None not in self._round_fns:

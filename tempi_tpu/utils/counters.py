@@ -60,6 +60,13 @@ class P2PCounters:
     # persistent-batch replays that skipped match/strategy/plan lookup
     # (no reference analog: its persistent requests are internal-only)
     num_persistent_replays: int = 0
+    # oneshot evidence: pack rounds whose output XLA actually committed to
+    # pinned host memory vs rounds that silently degraded to device
+    # outputs — distinguishes "the number measures the path it names" from
+    # the fallback (reference analog: the mapped-host allocation that makes
+    # ONESHOT possible, allocator_host.hpp:31-49)
+    num_oneshot_landed: int = 0
+    num_oneshot_degraded: int = 0
 
 
 @dataclass
