@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""On-chip pack-kernel tuning sweep (VERDICT r2 item 4: close the gap to
+the ~819 GB/s v5e HBM roofline).
+
+Sweeps the two dispatch knobs that govern the direct-DMA pack kernel's
+sustained bandwidth at the bench-mpi-pack headline shape:
+
+  * TEMPI_PACK_SPLIT — single-combo DMA row splitting (1 = one big strided
+    make_async_copy; S = S concurrent DMAs over disjoint row chunks)
+  * batch K — independent packs jitted into one dispatch
+
+Each config runs in its OWN subprocess (the split target is read at module
+import) with a short fixed schedule, so a full sweep costs ~1-2 min of chip
+time. Prints one JSON line per config and a final "best" line; feed the
+winner back into pack_pallas._DMA_SPLIT_TARGET's default.
+
+Usage: python benches/bench_pack_tuning.py [--quick]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+SPLITS = (1, 2, 4, 8, 16)
+BATCHES = (8, 16)
+
+
+def _child() -> int:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.ops import type_cache
+
+    split = int(os.environ.get("TEMPI_PACK_SPLIT", "1"))
+    k = int(os.environ.get("TEMPI_TUNE_BATCH_K", "8"))
+    quick = os.environ.get("TEMPI_TUNE_QUICK") == "1"
+    nblocks, bl, stride = 8192, 512, 1024  # the 4 MiB headline shape
+    ty = dt.subarray([nblocks, stride], [nblocks, bl], [0, 0], dt.BYTE)
+    rec = type_cache.get_or_commit(ty)
+    packer = rec.best_packer()
+    dev = jax.devices()[0]
+    bufs = [jax.device_put(
+        jnp.asarray(np.random.default_rng(i).integers(
+            0, 256, ty.extent, np.uint8)), dev) for i in range(k)]
+    mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
+    jax.block_until_ready(mega(bufs))  # compile
+    # fixed schedule: reps sized for ~2 ms samples, median of N samples
+    reps = max(1, int(2e-3 / 40e-6 / k))
+    samples = 10 if quick else 30
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(reps):
+            last = mega(bufs)
+        jax.block_until_ready(last)
+        times.append((time.perf_counter() - t0) / reps)
+    times.sort()
+    med = times[len(times) // 2]
+    print(json.dumps({"split": split, "batch_k": k,
+                      "gbs": round(ty.size * k / med / 1e9, 1)}))
+    return 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child()
+    quick = "--quick" in sys.argv
+    results = []
+    for split in SPLITS:
+        for k in BATCHES:
+            env = dict(os.environ, TEMPI_PACK_SPLIT=str(split),
+                       TEMPI_TUNE_BATCH_K=str(k),
+                       TEMPI_TUNE_QUICK="1" if quick else "0")
+            try:
+                r = subprocess.run(
+                    [sys.executable, __file__, "--child"], env=env,
+                    capture_output=True, text=True, timeout=300)
+                line = json.loads(r.stdout.strip().splitlines()[-1])
+                results.append(line)
+                print(json.dumps(line), flush=True)
+            except Exception as e:
+                print(f"split={split} k={k} failed: {e!r}", file=sys.stderr)
+    if results:
+        best = max(results, key=lambda d: d["gbs"])
+        print(json.dumps({"best": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -75,6 +76,24 @@ _MAX_BLOCK_BYTES = 2 * 1024 * 1024
 # Most outer-level DMAs a grid-free kernel will unroll; past this the
 # pipelined kernel amortizes better than a huge straight-line program.
 _MAX_DMAS = 64
+# Row-split target for single-combo direct-DMA kernels: a lone strided
+# make_async_copy over many rows can underuse the chip's parallel DMA
+# engines; splitting the row range into S concurrent copies (disjoint row
+# chunks of the same output) engages more of them. Read at import;
+# TEMPI_PACK_SPLIT=1 disables, =S targets S-way. Default chosen by the
+# on-chip sweep in benches/bench_pack_tuning.py. Parsed defensively like
+# every other TEMPI_* knob: a malformed value must not break import.
+
+
+def _split_target_from_env() -> int:
+    try:
+        return max(1, int(os.environ.get("TEMPI_PACK_SPLIT", "1")))
+    except ValueError:
+        log.warn("malformed TEMPI_PACK_SPLIT ignored")
+        return 1
+
+
+_DMA_SPLIT_TARGET = _split_target_from_env()
 # Unrolled aliased-unpack updates beyond this bloat the XLA program.
 _MAX_UNPACK_UPDATES = 64
 
@@ -240,11 +259,22 @@ def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
         tile = gcd(tile, start_row) if start_row else tile
         if tile < 8 or tile % 8:  # Mosaic sublane divisibility
             tile = None
+    # Single-combo row split (see _DMA_SPLIT_TARGET): S concurrent DMAs
+    # over disjoint row chunks. Chunks must keep Mosaic's 8-sublane row
+    # alignment; multi-combo kernels already run parallel DMAs.
+    split = 1
+    if dma and n_dmas == 1 and _DMA_SPLIT_TARGET > 1:
+        s = _DMA_SPLIT_TARGET
+        while s > 1 and not (counts[1] % s == 0
+                             and (counts[1] // s) % 8 == 0):
+            s //= 2
+        if s > 1 and _multi_dma_supported():
+            split = s
     # the plan stays valid even when no PACK kernel fits (tile None, dma
     # False): the geometry still powers the Mosaic-free fused unpack splice
     return dict(bl=bl, rowstride=rowstride, nrows=nrows, start_row=start_row,
                 outer_rows=outer_rows, nblocks=counts[1], tile=tile,
-                n_dmas=n_dmas, dma=dma)
+                n_dmas=n_dmas, dma=dma, split=split)
 
 
 def _sized_plan(sb: StridedBlock, nbytes: Optional[int],
@@ -316,17 +346,35 @@ def _dma_call(p: dict, unpack: bool, dynamic: bool = False):
     combos = _outer_offsets(p)
     n = len(combos)
     single = n == 1
+    # single-combo row split: S concurrent DMAs over disjoint row chunks of
+    # the same (nblocks, bl) output — engages parallel DMA engines where a
+    # lone big strided copy may serialize on one
+    split = p.get("split", 1) if single else 1
+    chunk = nblocks // split
+    n_copies = n if not single else split
+    one_sem = n_copies == 1
     pk_shape = ((nblocks, bl) if single else
                 tuple(x for x, _ in p["outer_rows"]) + (nblocks, bl))
 
     def copies(pk_ref, view_ref, sems, off_ref):
+        if single:
+            (_, r0), = combos
+            row0 = off_ref[0] if dynamic else r0
+            for c in range(split):
+                pk_at = (pk_ref if split == 1 else
+                         pk_ref.at[pl.ds(c * chunk, chunk), pl.ds(0, bl)])
+                view_at = view_ref.at[pl.ds(row0 + c * chunk, chunk),
+                                      pl.ds(0, bl)]
+                src, dst = (pk_at, view_at) if unpack else (view_at, pk_at)
+                yield pltpu.make_async_copy(
+                    src, dst, sems if one_sem else sems.at[c])
+            return
         for i, (idx, r0) in enumerate(combos):
-            pk_at = pk_ref if single else pk_ref.at[idx]
+            pk_at = pk_ref.at[idx]
             row0 = off_ref[i] if dynamic else r0
             view_at = view_ref.at[pl.ds(row0, nblocks), pl.ds(0, bl)]
             src, dst = (pk_at, view_at) if unpack else (view_at, pk_at)
-            yield pltpu.make_async_copy(src, dst,
-                                        sems if single else sems.at[i])
+            yield pltpu.make_async_copy(src, dst, sems.at[i])
 
     def kern(*refs):
         off_ref = None
@@ -344,8 +392,8 @@ def _dma_call(p: dict, unpack: bool, dynamic: bool = False):
     anyspec = pl.BlockSpec(memory_space=pl.ANY)
     out_shape = (p["nrows"], p["rowstride"]) if unpack else pk_shape
     in_specs = [anyspec, anyspec] if unpack else [anyspec]
-    sems = (pltpu.SemaphoreType.DMA if single
-            else pltpu.SemaphoreType.DMA((n,)))
+    sems = (pltpu.SemaphoreType.DMA if one_sem
+            else pltpu.SemaphoreType.DMA((n_copies,)))
     # aliasing indices count the scalar-prefetch operand
     aliases = ({1 + dynamic: 0} if unpack else {})
     if dynamic:
@@ -381,23 +429,24 @@ def _build_pack_dma(nbytes: int, start: int, counts: Tuple[int, ...],
 
 
 def _structural_plan(nrows: int, rowstride: int, nblocks: int, bl: int,
-                     combo_shape: Tuple[int, ...]) -> dict:
+                     combo_shape: Tuple[int, ...], split: int = 1) -> dict:
     """Synthetic plan carrying only the structure a dynamic-offset kernel
     needs: the baked per-combo offsets in outer_rows are ignored (the
-    runtime ``off_ref`` supplies them)."""
+    runtime ``off_ref`` supplies them). ``split`` keys the kernel body (the
+    single-combo row-split unrolls one DMA per chunk)."""
     outer = [(x, 0) for x in combo_shape] if combo_shape else [(1, nblocks)]
     return dict(bl=bl, nblocks=nblocks, nrows=nrows, rowstride=rowstride,
-                start_row=0, outer_rows=outer)
+                start_row=0, outer_rows=outer, split=split)
 
 
 @functools.lru_cache(maxsize=512)
 def _build_pack_dma_shared(nrows: int, rowstride: int, nblocks: int, bl: int,
-                           combo_shape: Tuple[int, ...]):
+                           combo_shape: Tuple[int, ...], split: int = 1):
     """Structure-keyed grid-free DMA kernel: row offsets are runtime
     scalars (scalar prefetch), so geometries differing only in start/outer
     strides share ONE Mosaic compile. The _plan gate still guarantees every
     offset value is 8-sublane-aligned at call time."""
-    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape)
+    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape, split)
     call, _ = _dma_call(p, unpack=False, dynamic=True)
 
     def fn(u8, offs):
@@ -407,13 +456,16 @@ def _build_pack_dma_shared(nrows: int, rowstride: int, nblocks: int, bl: int,
 
 
 def _shared_pack_args(p: dict):
-    """(structural key, offsets) for the shared kernel."""
+    """(structural key, offsets) for the shared kernel. The key carries the
+    plan's row-split factor — the kernel BODY differs per split, so split
+    values must not share a Mosaic compile."""
     combos = _outer_offsets(p)
     combo_shape = (() if len(combos) == 1
                    else tuple(x for x, _ in p["outer_rows"]))
     import numpy as _np
     offs = _np.asarray([r0 for _, r0 in combos], dtype=_np.int32)
-    return ((p["nrows"], p["rowstride"], p["nblocks"], p["bl"], combo_shape),
+    return ((p["nrows"], p["rowstride"], p["nblocks"], p["bl"], combo_shape,
+             p.get("split", 1)),
             offs)
 
 
@@ -452,10 +504,11 @@ def _dyn_unpack_dma_supported() -> bool:
 
 @functools.lru_cache(maxsize=512)
 def _build_unpack_dma_shared(nrows: int, rowstride: int, nblocks: int,
-                             bl: int, combo_shape: Tuple[int, ...]):
+                             bl: int, combo_shape: Tuple[int, ...],
+                             split: int = 1):
     """Structure-keyed in-place unpack: packed columns DMAed over the
     aliased destination at runtime row offsets."""
-    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape)
+    p = _structural_plan(nrows, rowstride, nblocks, bl, combo_shape, split)
     call, pk_shape = _dma_call(p, unpack=True, dynamic=True)
 
     def fn(u8, packed, offs):

@@ -197,3 +197,55 @@ def test_packer_nd_routes_large_types():
     want = st.oracle_pack(buf, ty, 1)
     got = np.asarray(rec.best_packer().pack(jnp.asarray(buf), 1))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.fixture()
+def split8(monkeypatch):
+    """Force 8-way single-combo DMA row splitting (TEMPI_PACK_SPLIT=8);
+    the plan cache is keyed on geometry only, so it must be cleared around
+    the global flip."""
+    caches = (pack_pallas._plan, pack_pallas._build_pack_dma,
+              pack_pallas._build_unpack_dma,
+              pack_pallas._build_pack_dma_shared,
+              pack_pallas._build_unpack_dma_shared)
+    for f in caches:
+        f.cache_clear()
+    monkeypatch.setattr(pack_pallas, "_DMA_SPLIT_TARGET", 8)
+    yield
+    for f in caches:
+        f.cache_clear()
+
+
+def test_dma_row_split_bytes_identical(split8):
+    """The split kernel (S concurrent DMAs over disjoint row chunks) must
+    be byte-identical to the oracle on the headline single-combo shape."""
+    nblocks, bl, stride = 128, 128, 256
+    args = (nblocks * stride, 0, (bl, nblocks), (1, stride),
+            nblocks * stride, 1)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["dma"] and p["split"] == 8
+    run_both(*args, seed=7)
+
+
+def test_dma_row_split_skipped_when_rows_do_not_divide(split8):
+    """Rows not divisible into 8-aligned chunks: split must back off (to a
+    smaller factor or 1), never produce an invalid kernel."""
+    nblocks, bl, stride = 72, 128, 256  # 72 = 8*9: /8 leaves chunk 9 (bad)
+    args = (nblocks * stride, 0, (bl, nblocks), (1, stride),
+            nblocks * stride, 1)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["dma"]
+    assert p["split"] == 1  # 8 -> 4 -> 2 all leave misaligned chunks
+    run_both(*args, seed=8)
+
+
+def test_dma_row_split_with_start_offset(split8):
+    """Split + non-zero start row: every chunk's view offset stays
+    8-aligned and bytes match."""
+    nblocks, bl, stride = 64, 128, 256
+    start = 8 * stride  # 8 rows in
+    nbytes = (nblocks + 16) * stride
+    args = (nbytes, start, (bl, nblocks), (1, stride), nblocks * stride, 1)
+    p = pack_pallas._plan(*args)
+    assert p is not None and p["dma"] and p["split"] == 8
+    run_both(*args, seed=9)
