@@ -144,6 +144,34 @@ def _multi_dma_supported() -> bool:
 
 
 @functools.lru_cache(maxsize=1)
+def _split_dma_supported() -> bool:
+    """One-time probe of the row-split kernel BODY: ds-sliced 2-D chunks of
+    the output ref as DMA endpoints (a different Mosaic pattern from the
+    rank-3 indexed refs _multi_dma_supported probes). Eager for the same
+    reason as the other probes: a traced rejection would fail a whole
+    exchange plan at compile time with no fallback. Byte-checked — a
+    silently mis-lowered chunk offset would corrupt every split pack."""
+    if _interpret():
+        return True
+    try:
+        import numpy as _np
+        nblocks, bl, stride = 16, 128, 256
+        p = dict(bl=bl, rowstride=stride, nrows=nblocks, start_row=0,
+                 outer_rows=[(1, nblocks)], nblocks=nblocks, split=2)
+        call, _ = _dma_call(p, unpack=False)
+        src = _np.arange(nblocks * stride, dtype=_np.uint8) % 251
+        out = _np.asarray(jax.jit(
+            lambda u8: call(u8.reshape(nblocks, stride)))(jnp.asarray(src)))
+        want = src.reshape(nblocks, stride)[:, :bl]
+        if not (out == want).all():
+            raise RuntimeError("split DMA produced wrong bytes")
+        return True
+    except Exception as e:
+        log.debug(f"row-split DMA probe failed; split stays disabled: {e}")
+        return False
+
+
+@functools.lru_cache(maxsize=1)
 def _dyn_dma_supported() -> bool:
     """One-time probe: do scalar-prefetch DYNAMIC-offset DMA kernels lower
     on this backend? When they do, pack kernels are keyed by structure only
@@ -268,7 +296,7 @@ def _plan(nbytes: int, start: int, counts: Tuple[int, ...],
         while s > 1 and not (counts[1] % s == 0
                              and (counts[1] // s) % 8 == 0):
             s //= 2
-        if s > 1 and _multi_dma_supported():
+        if s > 1 and _multi_dma_supported() and _split_dma_supported():
             split = s
     # the plan stays valid even when no PACK kernel fits (tile None, dma
     # False): the geometry still powers the Mosaic-free fused unpack splice

@@ -200,3 +200,41 @@ def test_contiguous_auto_modeled_choice_single_device(tmp_path, monkeypatch):
             "choice did not come from the model"
     finally:
         api.finalize()
+
+
+def test_shipped_perf_sheet_fallback(tmp_path, monkeypatch):
+    """With an empty cache dir, load_cached falls back to the repo-shipped
+    PERF_TPU.json — but only when its platform stamp matches (TPU curves
+    must never steer the CPU mesh)."""
+    import os
+
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path / "empty"))
+
+    # platform mismatch (a TPU sheet on this CPU test run): refused
+    wrong = SystemPerformance()
+    wrong.platform = "tpu/v5e"
+    wrong.d2h = [(1, 1e-6)]
+    shipped = tmp_path / "PERF_TPU.json"
+    import json as _json
+    shipped.write_text(_json.dumps(wrong.to_json()))
+    monkeypatch.setattr(msys, "shipped_path", lambda: str(shipped))
+    assert msys.load_cached() is None
+
+    # matching platform: loaded
+    right = SystemPerformance()
+    right.platform = msys.current_platform()
+    right.d2h = [(1, 2e-6), (1024, 3e-6)]
+    shipped.write_text(_json.dumps(right.to_json()))
+    sp = msys.load_cached()
+    assert sp is not None and sp.d2h[0] == (1, 2e-6)
+
+    # cache dir wins over the shipped sheet when both exist
+    cached = SystemPerformance()
+    cached.platform = msys.current_platform()
+    cached.d2h = [(1, 9e-6)]
+    os.makedirs(str(tmp_path / "empty"), exist_ok=True)
+    (tmp_path / "empty" / "perf.json").write_text(
+        _json.dumps(cached.to_json()))
+    sp = msys.load_cached()
+    assert sp is not None and sp.d2h[0] == (1, 9e-6)
