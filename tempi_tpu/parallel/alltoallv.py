@@ -197,7 +197,11 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
                            in_specs=(P(AXIS, None), P(AXIS, None),
                                      rep, rep, rep),
                            out_specs=P(AXIS, None), check_vma=False)
-        fn = jax.jit(sm)
+        # donate the recv buffer (arg 1): it is rebound to the output on
+        # return, so XLA reuses its HBM. The send buffer stays live (MPI
+        # semantics: sendbuf is untouched by the call) and is not donated.
+        from .plan import ExchangePlan
+        fn = jax.jit(sm, donate_argnums=ExchangePlan._donate(2, skip=1))
         comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes)] = fn
     recvbuf.data = fn(sendbuf.data, recvbuf.data,
                       jnp.asarray(lsc, jnp.int32), jnp.asarray(lsd, jnp.int32),
@@ -278,25 +282,32 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
                 axis_name=AXIS)
             return out.reshape(1, -1)
 
+        # oracle inputs snapshotted BEFORE the call: the recv buffer is
+        # donated, so reading it after the collective would raise
+        host_s = np.asarray(sendbuf.data)
+        want = np.array(recvbuf.data, copy=True)
         try:
+            from .plan import ExchangePlan
             sm = jax.shard_map(step, mesh=comm.mesh,
                                in_specs=(P(AXIS, None), P(AXIS, None)),
                                out_specs=P(AXIS, None), check_vma=False)
-            fn = jax.jit(sm)
+            # recv buffer (arg 1) donated like the fused path: callers
+            # rebind recvbuf.data to the output on return
+            fn = jax.jit(sm, donate_argnums=ExchangePlan._donate(2, skip=1))
             out = fn(sendbuf.data, recvbuf.data)
             out.block_until_ready()
         except Exception as e:
             log.debug(f"ragged_all_to_all unavailable on this backend; "
                       f"using the fused path: {e}")
             comm._plan_cache[key] = False
+            _restore_if_donated(comm, recvbuf, want)
             return False
         # first-use oracle check per table signature: CPU XLA cannot run
         # this op at all, so tests exercise only the fallback — the first
         # hardware activation must not be trusted sight-unseen. One host
         # compare (buffers are fully addressable here by the gate above),
         # then the compiled fn is cached as verified.
-        host_s = np.asarray(sendbuf.data)
-        want = np.array(recvbuf.data, copy=True)
+        recv_before = want.copy()  # pristine pre-call recv content
         size = comm.size
         for s in range(size):
             for d in range(size):
@@ -308,6 +319,10 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
             log.warn("ragged_all_to_all produced wrong bytes on this "
                      "backend; using the fused path from now on")
             comm._plan_cache[key] = False
+            # the donated recv buffer must be RESTORED before the fused
+            # fallback runs, and from the pristine copy (the op's output
+            # holds wrong bytes)
+            recvbuf.data = jax.device_put(recv_before, comm.sharding())
             return False
         comm._plan_cache[key] = fn
         recvbuf.data = out
@@ -316,6 +331,18 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
         return False
     recvbuf.data = fn(sendbuf.data, recvbuf.data)
     return True
+
+
+def _restore_if_donated(comm, buf, host_copy: np.ndarray) -> None:
+    """After a failed donating call, the buffer may already be consumed
+    (runtime failures happen after donation; compile failures before).
+    Re-materialize it from the host snapshot only when actually deleted."""
+    try:
+        deleted = buf.data.is_deleted()
+    except Exception:
+        deleted = False
+    if deleted:
+        buf.data = jax.device_put(host_copy, comm.sharding())
 
 
 # -- staged (bulk host) -------------------------------------------------------

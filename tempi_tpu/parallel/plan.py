@@ -217,6 +217,29 @@ class ExchangePlan:
 
     # -- DEVICE strategy: one fully fused jitted program ---------------------
 
+    @staticmethod
+    def _donate(n: int, skip: int = 0) -> tuple:
+        """Donation indices for exchange programs whose buffer inputs are
+        DEAD on return (every caller immediately rebinds ``b.data`` to the
+        outputs): XLA reuses the input HBM for the outputs instead of
+        holding both live — the TPU-idiomatic form of the reference's
+        device-allocator buffer reuse (allocator_slab.hpp pools;
+        device buffers in sender.cpp:157). ``skip`` protects leading args
+        that stay live after the call (e.g. the staging array the host
+        loop drains later). Send-side buffers ARE donated too: the MPI
+        "sendbuf unchanged" guarantee holds at the DistBuffer level (every
+        plan buffer is rebound to an output carrying identical pass-through
+        content); only raw pre-exchange ``jax.Array`` references die. CPU
+        ignores donation with a warning per jit, so donate only on
+        accelerator backends. TEMPI_NO_DONATE (presence-based, like every
+        TEMPI_* gate) is the escape hatch for applications that hold raw
+        array references across exchanges."""
+        import os
+        if jax.default_backend() == "cpu" \
+                or os.environ.get("TEMPI_NO_DONATE") is not None:
+            return ()
+        return tuple(range(skip, n))
+
     def _build_device_fn(self):
         comm = self.comm
         rounds = self.rounds
@@ -236,7 +259,7 @@ class ExchangePlan:
                            in_specs=(P(AXIS, None),) * n,
                            out_specs=(P(AXIS, None),) * n,
                            check_vma=False)
-        return jax.jit(sm)
+        return jax.jit(sm, donate_argnums=self._donate(n))
 
     def _step_body(self, rounds, datas):
         locs = tuple(d.reshape(-1) for d in datas)
@@ -284,7 +307,7 @@ class ExchangePlan:
                                        in_specs=(P(AXIS, None),) * n,
                                        out_specs=(P(AXIS, None),) * n,
                                        check_vma=False)
-                    return jax.jit(sf)
+                    return jax.jit(sf, donate_argnums=self._donate(n))
 
                 fns.append(("self", mk_self()))
                 continue
@@ -314,6 +337,11 @@ class ExchangePlan:
                                    in_specs=(P(AXIS, None),) * (n + 1),
                                    out_specs=(P(AXIS, None),) * n,
                                    check_vma=False)
+                # pack must NOT donate: its buffer inputs stay live (the
+                # unpack stage consumes them after the host round trip).
+                # unpack donates the buffers (rebound on return) but skips
+                # arg 0 — the staging array the host loop drains later.
+                uf = jax.jit(uf, donate_argnums=self._donate(n + 1, skip=1))
                 pf = jax.jit(pf)
                 if host_kind is not None:
                     try:
@@ -322,7 +350,7 @@ class ExchangePlan:
                         pf = jax.jit(pf, out_shardings=out_sh)
                     except Exception:
                         pass
-                return pf, jax.jit(uf)
+                return pf, uf
 
             fns.append(("xfer", mk()))
         return fns
@@ -348,11 +376,20 @@ class ExchangePlan:
             self._round_fns[host_kind] = self._build_round_fns(host_kind)
         comm = self.comm
         datas = [b.data for b in self.bufs]
+
+        def rebind() -> None:
+            # rebind after EVERY donating stage, not once at loop end: a
+            # later round failing mid-loop must not leave b.data pointing
+            # at arrays the earlier round's unpack already donated
+            for b, d in zip(self.bufs, datas):
+                b.data = d
+
         for rnd, (kind, entry) in zip(self.rounds,
                                       self._round_fns[host_kind]):
             if kind == "self":
                 # local pack->unpack on device; nothing crosses the host
                 datas = list(entry(*datas))
+                rebind()
                 continue
             pf, uf = entry
             if host_kind is not None:
@@ -395,8 +432,7 @@ class ExchangePlan:
                 dev = jax.device_put(moved, comm.sharding())   # H2D
             self._staging_inflight = dev
             datas = list(uf(dev, *datas))
-        for b, d in zip(self.bufs, datas):
-            b.data = d
+            rebind()
 
     def _staging_for(self, shape, dtype) -> np.ndarray:
         """Host transport buffer from the slab pool (reference: hostAllocator
