@@ -305,7 +305,10 @@ class HaloExchange:
         sm = jax.shard_map(step_u8, mesh=self.comm.mesh,
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
-        return jax.jit(sm)
+        # the caller rebinds buf.data to the output (run_iteration), so the
+        # input grid is dead on return — donate it (see ExchangePlan._donate)
+        from ..parallel.plan import ExchangePlan
+        return jax.jit(sm, donate_argnums=ExchangePlan._donate(1))
 
     def run_iteration(self, buf: DistBuffer, stencil=None,
                       strategy: Optional[str] = None) -> None:
