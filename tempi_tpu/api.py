@@ -213,6 +213,36 @@ waitall_persistent = p2p.waitall_persistent
 PersistentRequest = p2p.PersistentRequest
 
 
+def sendrecv(comm: Communicator, app_rank: int, sendbuf: DistBuffer,
+             dest: int, sendtype: Datatype, recvbuf: DistBuffer,
+             source: int, recvtype: Datatype, sendcount: int = 1,
+             recvcount: int = 1, sendtag: int = 0, recvtag: int = 0,
+             sendoffset: int = 0, recvoffset: int = 0):
+    """MPI_Sendrecv analog (the reference uses the pattern internally for
+    dist-graph edge forwarding, dist_graph_create_adjacent.cpp:392-431):
+    both operations posted before progress runs, so the pair can never
+    deadlock against its own ordering. Carries the same single-controller
+    semantics caveat as send/recv (README): the call posts and drives
+    progress but does NOT block — one rank's sendrecv completes only once
+    its peers have posted theirs. Returns the (send, recv) requests;
+    waitall over every rank's pairs is the synchronization point."""
+    rs = p2p.isend(comm, app_rank, sendbuf, dest, sendtype, sendcount,
+                   sendtag, sendoffset)
+    rr = p2p.irecv(comm, app_rank, recvbuf, source, recvtype, recvcount,
+                   recvtag, recvoffset)
+    p2p.try_progress(comm)
+    return rs, rr
+
+
+def barrier(comm: Communicator) -> None:
+    """MPI_Barrier analog: one tiny psum over the mesh, drained before
+    return. In a single-controller world this orders the CONTROLLER with
+    the devices (all prior dispatched work on the mesh completes before
+    the call returns)."""
+    from .parallel.reduce import barrier as _barrier
+    _barrier(comm)
+
+
 # -- collectives & graph communicators ---------------------------------------
 
 def alltoallv(*args, **kwargs):

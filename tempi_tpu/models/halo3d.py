@@ -257,6 +257,11 @@ class HaloExchange:
     def stencil_fn(self):
         """Jitted 7-point Jacobi update over the mesh (interior only).
 
+        DONATION CONTRACT (accelerator backends): the input grid array is
+        donated — callers must rebind ``buf.data`` to the returned output
+        (run_iteration does) and must not read the pre-call array object
+        afterwards. TEMPI_NO_DONATE disables this.
+
         Per-rank box shapes may differ (uneven decomposition): each distinct
         allocated shape becomes one ``lax.switch`` branch, selected by the
         device's library rank — the same uniform-program-with-divergent-
@@ -306,9 +311,9 @@ class HaloExchange:
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
         # the caller rebinds buf.data to the output (run_iteration), so the
-        # input grid is dead on return — donate it (see ExchangePlan._donate)
-        from ..parallel.plan import ExchangePlan
-        return jax.jit(sm, donate_argnums=ExchangePlan._donate(1))
+        # input grid is dead on return — donate it (see plan.donation_argnums)
+        from ..parallel.plan import donation_argnums
+        return jax.jit(sm, donate_argnums=donation_argnums(1))
 
     def run_iteration(self, buf: DistBuffer, stencil=None,
                       strategy: Optional[str] = None) -> None:

@@ -200,8 +200,8 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         # donate the recv buffer (arg 1): it is rebound to the output on
         # return, so XLA reuses its HBM. The send buffer stays live (MPI
         # semantics: sendbuf is untouched by the call) and is not donated.
-        from .plan import ExchangePlan
-        fn = jax.jit(sm, donate_argnums=ExchangePlan._donate(2, skip=1))
+        from .plan import donation_argnums
+        fn = jax.jit(sm, donate_argnums=donation_argnums(2, skip=1))
         comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes)] = fn
     recvbuf.data = fn(sendbuf.data, recvbuf.data,
                       jnp.asarray(lsc, jnp.int32), jnp.asarray(lsd, jnp.int32),
@@ -287,13 +287,13 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
         host_s = np.asarray(sendbuf.data)
         want = np.array(recvbuf.data, copy=True)
         try:
-            from .plan import ExchangePlan
+            from .plan import donation_argnums
             sm = jax.shard_map(step, mesh=comm.mesh,
                                in_specs=(P(AXIS, None), P(AXIS, None)),
                                out_specs=P(AXIS, None), check_vma=False)
             # recv buffer (arg 1) donated like the fused path: callers
             # rebind recvbuf.data to the output on return
-            fn = jax.jit(sm, donate_argnums=ExchangePlan._donate(2, skip=1))
+            fn = jax.jit(sm, donate_argnums=donation_argnums(2, skip=1))
             out = fn(sendbuf.data, recvbuf.data)
             out.block_until_ready()
         except Exception as e:

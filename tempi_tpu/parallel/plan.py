@@ -51,6 +51,28 @@ class Message:
     roffset: int
 
 
+def donation_argnums(n: int, skip: int = 0) -> tuple:
+    """Donation indices for exchange programs whose buffer inputs are DEAD
+    on return (every caller immediately rebinds ``b.data`` to the outputs):
+    XLA reuses the input HBM for the outputs instead of holding both live —
+    the TPU-idiomatic form of the reference's device-allocator buffer reuse
+    (allocator_slab.hpp pools; device buffers in sender.cpp:157). ``skip``
+    protects leading args that stay live after the call (e.g. the staging
+    array the host loop drains later). Send-side buffers ARE donated too:
+    the MPI "sendbuf unchanged" guarantee holds at the DistBuffer level
+    (every plan buffer is rebound to an output carrying identical
+    pass-through content); only raw pre-exchange ``jax.Array`` references
+    die. CPU ignores donation with a warning per jit, so donate only on
+    accelerator backends. TEMPI_NO_DONATE (presence-based, like every
+    TEMPI_* gate) is the escape hatch for applications that hold raw array
+    references across exchanges. Shared by the exchange plans, the fused/
+    ragged alltoallv programs, and the halo stencil."""
+    import os
+    if jax.default_backend() == "cpu" \
+            or os.environ.get("TEMPI_NO_DONATE") is not None:
+        return ()
+    return tuple(range(skip, n))
+
 def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
     """Greedy round assignment: each rank sends at most one and receives at
     most one message per round; program order is preserved per (src,dst).
@@ -217,29 +239,6 @@ class ExchangePlan:
 
     # -- DEVICE strategy: one fully fused jitted program ---------------------
 
-    @staticmethod
-    def _donate(n: int, skip: int = 0) -> tuple:
-        """Donation indices for exchange programs whose buffer inputs are
-        DEAD on return (every caller immediately rebinds ``b.data`` to the
-        outputs): XLA reuses the input HBM for the outputs instead of
-        holding both live — the TPU-idiomatic form of the reference's
-        device-allocator buffer reuse (allocator_slab.hpp pools;
-        device buffers in sender.cpp:157). ``skip`` protects leading args
-        that stay live after the call (e.g. the staging array the host
-        loop drains later). Send-side buffers ARE donated too: the MPI
-        "sendbuf unchanged" guarantee holds at the DistBuffer level (every
-        plan buffer is rebound to an output carrying identical pass-through
-        content); only raw pre-exchange ``jax.Array`` references die. CPU
-        ignores donation with a warning per jit, so donate only on
-        accelerator backends. TEMPI_NO_DONATE (presence-based, like every
-        TEMPI_* gate) is the escape hatch for applications that hold raw
-        array references across exchanges."""
-        import os
-        if jax.default_backend() == "cpu" \
-                or os.environ.get("TEMPI_NO_DONATE") is not None:
-            return ()
-        return tuple(range(skip, n))
-
     def _build_device_fn(self):
         comm = self.comm
         rounds = self.rounds
@@ -259,7 +258,7 @@ class ExchangePlan:
                            in_specs=(P(AXIS, None),) * n,
                            out_specs=(P(AXIS, None),) * n,
                            check_vma=False)
-        return jax.jit(sm, donate_argnums=self._donate(n))
+        return jax.jit(sm, donate_argnums=donation_argnums(n))
 
     def _step_body(self, rounds, datas):
         locs = tuple(d.reshape(-1) for d in datas)
@@ -307,7 +306,7 @@ class ExchangePlan:
                                        in_specs=(P(AXIS, None),) * n,
                                        out_specs=(P(AXIS, None),) * n,
                                        check_vma=False)
-                    return jax.jit(sf, donate_argnums=self._donate(n))
+                    return jax.jit(sf, donate_argnums=donation_argnums(n))
 
                 fns.append(("self", mk_self()))
                 continue
@@ -341,7 +340,7 @@ class ExchangePlan:
                 # unpack stage consumes them after the host round trip).
                 # unpack donates the buffers (rebound on return) but skips
                 # arg 0 — the staging array the host loop drains later.
-                uf = jax.jit(uf, donate_argnums=self._donate(n + 1, skip=1))
+                uf = jax.jit(uf, donate_argnums=donation_argnums(n + 1, skip=1))
                 pf = jax.jit(pf)
                 if host_kind is not None:
                     try:

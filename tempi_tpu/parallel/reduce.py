@@ -91,3 +91,30 @@ def reduce(comm: Communicator, buf: DistBuffer, root: int = 0,
     are unchanged. ``root`` is an application rank."""
     ctr.counters.lib.num_calls += 1
     _run(comm, buf, dtype, op, root=comm.library_rank(root))
+
+
+def barrier(comm: Communicator) -> None:
+    """MPI_Barrier analog: a 1-element psum over the mesh axis, drained
+    before return. Devices synchronize through the collective; the
+    controller synchronizes by blocking on its result (all previously
+    dispatched mesh work is ordered before it)."""
+    if comm.freed:
+        raise RuntimeError("communicator has been freed")
+    ctr.counters.lib.num_calls += 1
+    cached = comm._plan_cache.get("barrier")
+    if cached is None:
+        def step(x):
+            return (x + jax.lax.psum(x, AXIS) * 0).reshape(1, 1)
+
+        sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
+                           out_specs=P(AXIS, None), check_vma=False)
+        import numpy as np
+
+        # the constant input lives with the fn: a hot-loop barrier must not
+        # pay an H2D transfer per call (free() drops the cache either way)
+        x = jax.device_put(np.zeros((comm.size, 1), np.float32),
+                           comm.sharding())
+        cached = (jax.jit(sm), x)
+        comm._plan_cache["barrier"] = cached
+    fn, x = cached
+    fn(x).block_until_ready()

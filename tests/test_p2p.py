@@ -793,3 +793,33 @@ def test_oneshot_landing_is_attributed(world):
     else:
         assert landed >= 1, \
             "on an accelerator the oneshot pack must land in pinned host"
+
+
+def test_sendrecv(world):
+    """MPI_Sendrecv analog: paired ring shift in one call per rank, no
+    deadlock regardless of posting order (both ops posted before any
+    progress runs)."""
+    ty = dt.contiguous(32, dt.BYTE)
+    sbuf, rows = fill(world, 32, seed=21)
+    rbuf = world.alloc(32)
+    reqs = []
+    for r in range(world.size):
+        reqs.extend(api.sendrecv(world, r, sbuf, (r + 1) % world.size, ty,
+                                 rbuf, (r - 1) % world.size, ty))
+    api.waitall(reqs)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r),
+                                      rows[(r - 1) % world.size])
+
+
+def test_barrier(world):
+    """MPI_Barrier analog: returns (devices + controller synchronized) and
+    is reusable; a freed communicator raises."""
+    api.barrier(world)
+    api.barrier(world)
+    from tempi_tpu.parallel.communicator import Communicator
+    c2 = Communicator(world.devices)
+    api.barrier(c2)
+    c2.free()
+    with pytest.raises(RuntimeError, match="freed"):
+        api.barrier(c2)
