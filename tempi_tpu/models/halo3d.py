@@ -190,6 +190,7 @@ class HaloExchange:
             reorder=reorder)
         # persistent-request batches per (buffer, strategy) exchange pattern
         self._persistent: dict = {}
+        self._fused_step = None  # cached fused exchange+stencil program
 
     @property
     def alloc(self) -> Tuple[int, int, int]:
@@ -254,13 +255,10 @@ class HaloExchange:
 
     # -- stencil compute (the "model" forward) -------------------------------
 
-    def stencil_fn(self):
-        """Jitted 7-point Jacobi update over the mesh (interior only).
-
-        DONATION CONTRACT (accelerator backends): the input grid array is
-        donated — callers must rebind ``buf.data`` to the returned output
-        (run_iteration does) and must not read the pre-call array object
-        afterwards. TEMPI_NO_DONATE disables this.
+    def _stencil_body(self):
+        """The raw per-shard stencil update (runs inside a shard_map):
+        local (1, nbytes) row in, updated row out. Shared by stencil_fn
+        and the fused exchange+stencil step.
 
         Per-rank box shapes may differ (uneven decomposition): each distinct
         allocated shape becomes one ``lax.switch`` branch, selected by the
@@ -268,7 +266,6 @@ class HaloExchange:
         branches pattern the exchange plans use."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
 
         r = self.radius
         nbytes = self.nbytes
@@ -307,21 +304,121 @@ class HaloExchange:
                 out = jax.lax.switch(jnp.asarray(table)[lib], branches, u8)
             return out.reshape(1, nbytes)
 
-        sm = jax.shard_map(step_u8, mesh=self.comm.mesh,
+        return step_u8
+
+    def stencil_fn(self):
+        """Jitted 7-point Jacobi update over the mesh (interior only).
+
+        DONATION CONTRACT (accelerator backends): the input grid array is
+        donated — callers must rebind ``buf.data`` to the returned output
+        (run_iteration does) and must not read the pre-call array object
+        afterwards. TEMPI_NO_DONATE disables this."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        sm = jax.shard_map(self._stencil_body(), mesh=self.comm.mesh,
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
-        # the caller rebinds buf.data to the output (run_iteration), so the
-        # input grid is dead on return — donate it (see plan.donation_argnums)
         from ..parallel.plan import donation_argnums
         return jax.jit(sm, donate_argnums=donation_argnums(1))
 
+    def fused_step_fn(self):
+        """ONE jitted SPMD program for a full training-step analog: the
+        complete halo exchange (every edge's pack -> ppermute -> unpack
+        rounds) FUSED with the stencil update — communication and compute
+        in a single XLA program, so the compiler can overlap the collective
+        rounds with the interior compute and one dispatch drives the whole
+        iteration (the TPU-first pitch of this framework; the reference
+        necessarily dispatches MPI calls and CUDA kernels separately,
+        bench_halo_exchange.cpp). Geometry-cached on the exchange (valid
+        for any grid buffer of this pattern). Input donated; callers rebind
+        ``buf.data`` to the output."""
+        if self._fused_step is not None:
+            return self._fused_step
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops import type_cache
+        from ..parallel.plan import (ExchangePlan, Message,
+                                     donation_argnums)
+
+        class _GridSlot:
+            """Identity placeholder for the one grid buffer: the private
+            plan below is traced, never run, so only buffer IDENTITY (all
+            messages touch the same buffer) matters."""
+            nbytes = self.nbytes
+
+        slot = _GridSlot()
+        msgs = []
+        for e in self.edges:
+            sp = type_cache.get_or_commit(e.send_type).best_packer()
+            rp = type_cache.get_or_commit(e.recv_type).best_packer()
+            msgs.append(Message(
+                src=self.comm.library_rank(e.src),
+                dst=self.comm.library_rank(e.dst), tag=0,
+                nbytes=e.send_type.size, sbuf=slot, spacker=sp, scount=1,
+                soffset=0, rbuf=slot, rpacker=rp, rcount=1, roffset=0))
+        # a PRIVATE plan (not the shared get_plan cache): it contributes
+        # only its round schedule and branch builders to the trace
+        plan = ExchangePlan(self.comm, msgs)
+        body = self._stencil_body()
+
+        def step(data):
+            (out,) = plan._step_body(plan.rounds, (data,))
+            return body(out)
+
+        sm = jax.shard_map(step, mesh=self.comm.mesh,
+                           in_specs=P(AXIS, None), out_specs=P(AXIS, None),
+                           check_vma=False)
+        self._fused_step = jax.jit(sm, donate_argnums=donation_argnums(1))
+        # warm-compile OUTSIDE any lock: run_iteration dispatches this under
+        # the progress lock, and a first-call XLA compile there would hold
+        # every concurrent post/progress/pump for tens of seconds
+        warm = self.comm.alloc(self.nbytes)
+        self._fused_step(warm.data).block_until_ready()
+        return self._fused_step
+
     def run_iteration(self, buf: DistBuffer, stencil=None,
                       strategy: Optional[str] = None) -> None:
-        """One training-step analog: halo exchange then stencil update."""
+        """One training-step analog: halo exchange then stencil update.
+
+        The default path (no explicit stencil/strategy) runs the FUSED
+        exchange+stencil program — one dispatch per iteration, collective
+        rounds overlappable with compute. Falls back to the two-program
+        path when other p2p operations are pending on the communicator
+        (the fused program bypasses the matching engine, so pending eager
+        ops must keep their MPI ordering through the normal path)."""
+        if stencil is None and strategy is None and self._fused_eligible():
+            fn = self.fused_step_fn()
+            with self.comm._progress_lock:
+                if not self.comm._pending:
+                    from ..utils import counters as ctr
+                    ctr.counters.lib.num_calls += 1
+                    ctr.counters.device.num_launches += 1
+                    # every edge rides the device transport in the fused
+                    # program — counted like the engine would count it
+                    ctr.counters.send.num_device += len(self.edges)
+                    buf.data = fn(buf.data)
+                    return
         self.exchange(buf, strategy)
         if stencil is None:
             stencil = self.stencil_fn()
         buf.data = stencil(buf.data)
+
+    @staticmethod
+    def _fused_eligible() -> bool:
+        """The fused program is the DEVICE transport; honor the global
+        transport knobs (a TEMPI_DATATYPE_ONESHOT sweep must exercise the
+        oneshot engine path, not be silently fused over) and provide the
+        usual presence-based escape hatch (TEMPI_NO_FUSED)."""
+        import os
+
+        from ..utils import env as envmod
+        from ..utils.env import DatatypeMethod
+        if os.environ.get("TEMPI_NO_FUSED") is not None:
+            return False
+        return envmod.env.datatype in (DatatypeMethod.AUTO,
+                                       DatatypeMethod.DEVICE)
 
 
 def single_chip_step(alloc=(66, 66, 66)):

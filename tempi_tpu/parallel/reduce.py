@@ -98,23 +98,28 @@ def barrier(comm: Communicator) -> None:
     before return. Devices synchronize through the collective; the
     controller synchronizes by blocking on its result (all previously
     dispatched mesh work is ordered before it)."""
-    if comm.freed:
-        raise RuntimeError("communicator has been freed")
-    ctr.counters.lib.num_calls += 1
-    cached = comm._plan_cache.get("barrier")
-    if cached is None:
-        def step(x):
-            return (x + jax.lax.psum(x, AXIS) * 0).reshape(1, 1)
+    # under the progress lock like every collective dispatch: the freed
+    # check, the _plan_cache access, and the device collective must not
+    # interleave with a background pump executing a cached ExchangePlan
+    # over the same mesh (the alltoallv dispatcher's discipline)
+    with comm._progress_lock:
+        if comm.freed:
+            raise RuntimeError("communicator has been freed")
+        ctr.counters.lib.num_calls += 1
+        cached = comm._plan_cache.get("barrier")
+        if cached is None:
+            def step(x):
+                return (x + jax.lax.psum(x, AXIS) * 0).reshape(1, 1)
 
-        sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
-                           out_specs=P(AXIS, None), check_vma=False)
-        import numpy as np
+            sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
+                               out_specs=P(AXIS, None), check_vma=False)
+            import numpy as np
 
-        # the constant input lives with the fn: a hot-loop barrier must not
-        # pay an H2D transfer per call (free() drops the cache either way)
-        x = jax.device_put(np.zeros((comm.size, 1), np.float32),
-                           comm.sharding())
-        cached = (jax.jit(sm), x)
-        comm._plan_cache["barrier"] = cached
-    fn, x = cached
-    fn(x).block_until_ready()
+            # the constant input lives with the fn: a hot-loop barrier must
+            # not pay an H2D transfer per call (free() drops the cache)
+            x = jax.device_put(np.zeros((comm.size, 1), np.float32),
+                               comm.sharding())
+            cached = (jax.jit(sm), x)
+            comm._plan_cache["barrier"] = cached
+        fn, x = cached
+        fn(x).block_until_ready()

@@ -198,3 +198,39 @@ def test_single_chip_step_jits():
     x, faces = jax.jit(fn)(*args)
     assert x.shape == (10, 10, 10)
     assert faces.shape[0] == 6 * 8 * 8
+
+
+def test_fused_step_matches_two_program_path(world):
+    """The fused exchange+stencil program (one dispatch) must be
+    byte-identical to exchange() followed by stencil_fn() — the default
+    run_iteration path vs the explicit two-program path."""
+    X = 8
+    ex1 = halo3d.HaloExchange(world, X=X, periodic=True)
+    ex2 = halo3d.HaloExchange(world, X=X, periodic=True)
+    b1 = ex1.alloc_grid(fill=_coord_fill(ex1))
+    b2 = ex2.alloc_grid(fill=_coord_fill(ex2))
+    for _ in range(3):
+        ex1.run_iteration(b1)                      # fused single program
+        ex2.exchange(b2)                           # two-program reference
+        b2.data = ex2.stencil_fn()(b2.data)
+    for rank in range(world.size):
+        np.testing.assert_array_equal(b1.get_rank(rank), b2.get_rank(rank))
+
+
+def test_fused_step_defers_to_engine_with_pending_ops(world):
+    """With an unmatched eager op pending, run_iteration must route through
+    the normal engine (MPI ordering), not the fused bypass — and produce
+    the same bytes once the pending op is cleaned up."""
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    X = 8
+    ex = halo3d.HaloExchange(world, X=X, periodic=True)
+    buf = ex.alloc_grid(fill=_coord_fill(ex))
+    other = ex.comm.alloc(16)
+    pending = p2p.irecv(ex.comm, 0, other, 1, dt.contiguous(16, dt.BYTE),
+                        tag=3)
+    ex.run_iteration(buf)  # must not raise, must not consume the pending op
+    assert not pending.done
+    with ex.comm._progress_lock:
+        ex.comm._pending.clear()
