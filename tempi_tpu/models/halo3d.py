@@ -191,6 +191,8 @@ class HaloExchange:
         # persistent-request batches per (buffer, strategy) exchange pattern
         self._persistent: dict = {}
         self._fused_step = None  # cached fused exchange+stencil program
+        self._fused_exchange = None  # cached exchange-only program
+        self._stencil = None  # cached stencil-only program
 
     @property
     def alloc(self) -> Tuple[int, int, int]:
@@ -234,7 +236,14 @@ class HaloExchange:
         (MPI_Send_init/MPI_Startall analog, which the reference's async
         engine also builds on, async_operation.cpp:124-130): matching and
         strategy selection are paid on the first exchange of each (buffer,
-        strategy) pattern, replays dispatch the cached compiled plans."""
+        strategy) pattern, replays dispatch the cached compiled plans.
+
+        Default-strategy calls with nothing pending take the fused
+        exchange program (one dispatch for the whole edge set, no per-call
+        replay machinery); pinned strategies and pending-op states route
+        through the engine."""
+        if strategy is None and self._try_fused(buf, self.fused_exchange_fn):
+            return
         key = (id(buf), strategy)
         preqs = self._persistent.get(key)
         if preqs is None:
@@ -335,6 +344,29 @@ class HaloExchange:
         ``buf.data`` to the output."""
         if self._fused_step is not None:
             return self._fused_step
+        self._fused_step = self._build_fused(self._stencil_body())
+        return self._fused_step
+
+    def fused_exchange_fn(self):
+        """The exchange-only variant of fused_step_fn: the complete edge
+        set as ONE dispatched program, bypassing the per-call persistent
+        replay machinery (fewer controller operations per iteration — on a
+        tunneled chip each saved op is a round trip). Same donation and
+        eligibility rules."""
+        if self._fused_exchange is not None:
+            return self._fused_exchange
+        self._fused_exchange = self._build_fused(None)
+        return self._fused_exchange
+
+    def _build_fused(self, body):
+        """One jitted SPMD program: all exchange rounds, then ``body``
+        (the stencil) when given. AOT-compiled before return (lower +
+        compile — NO collective is executed here: a warm-run would race a
+        background pump dispatching over the same mesh, and compiling
+        inside the dispatch lock would hold every concurrent
+        post/progress/pump for tens of seconds). The returned callable is
+        the compiled executable, so the first locked dispatch is
+        compile-free."""
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -361,22 +393,17 @@ class HaloExchange:
         # a PRIVATE plan (not the shared get_plan cache): it contributes
         # only its round schedule and branch builders to the trace
         plan = ExchangePlan(self.comm, msgs)
-        body = self._stencil_body()
 
         def step(data):
             (out,) = plan._step_body(plan.rounds, (data,))
-            return body(out)
+            return body(out) if body is not None else out
 
         sm = jax.shard_map(step, mesh=self.comm.mesh,
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
-        self._fused_step = jax.jit(sm, donate_argnums=donation_argnums(1))
-        # warm-compile OUTSIDE any lock: run_iteration dispatches this under
-        # the progress lock, and a first-call XLA compile there would hold
-        # every concurrent post/progress/pump for tens of seconds
+        fn = jax.jit(sm, donate_argnums=donation_argnums(1))
         warm = self.comm.alloc(self.nbytes)
-        self._fused_step(warm.data).block_until_ready()
-        return self._fused_step
+        return fn.lower(warm.data).compile()
 
     def run_iteration(self, buf: DistBuffer, stencil=None,
                       strategy: Optional[str] = None) -> None:
@@ -388,22 +415,42 @@ class HaloExchange:
         path when other p2p operations are pending on the communicator
         (the fused program bypasses the matching engine, so pending eager
         ops must keep their MPI ordering through the normal path)."""
-        if stencil is None and strategy is None and self._fused_eligible():
-            fn = self.fused_step_fn()
-            with self.comm._progress_lock:
-                if not self.comm._pending:
-                    from ..utils import counters as ctr
-                    ctr.counters.lib.num_calls += 1
-                    ctr.counters.device.num_launches += 1
-                    # every edge rides the device transport in the fused
-                    # program — counted like the engine would count it
-                    ctr.counters.send.num_device += len(self.edges)
-                    buf.data = fn(buf.data)
-                    return
+        if stencil is None and strategy is None \
+                and self._try_fused(buf, self.fused_step_fn):
+            return
         self.exchange(buf, strategy)
         if stencil is None:
-            stencil = self.stencil_fn()
+            if self._stencil is None:  # cached: the fallback path must not
+                self._stencil = self.stencil_fn()  # re-jit per iteration
+            stencil = self._stencil
         buf.data = stencil(buf.data)
+
+    def _try_fused(self, buf: DistBuffer, builder) -> bool:
+        """Dispatch a fused program when the engine isn't needed; returns
+        False when the caller must route through the engine. Shared by
+        exchange() and run_iteration() so the lock/freed/counter discipline
+        lives in exactly one place."""
+        if not self._fused_eligible():
+            return False
+        if self.comm._pending:
+            # cheap lock-free pre-check: don't pay the fused program's
+            # compile for a call that will route to the engine anyway (the
+            # authoritative re-check below runs under the lock)
+            return False
+        fn = builder()  # compiles OUTSIDE the lock, dispatches nothing
+        with self.comm._progress_lock:
+            if self.comm.freed:
+                raise RuntimeError("communicator has been freed")
+            if self.comm._pending:
+                return False
+            from ..utils import counters as ctr
+            ctr.counters.lib.num_calls += 1
+            ctr.counters.device.num_launches += 1
+            # every edge rides the device transport in the fused program —
+            # counted like the engine would count it
+            ctr.counters.send.num_device += len(self.edges)
+            buf.data = fn(buf.data)
+            return True
 
     @staticmethod
     def _fused_eligible() -> bool:

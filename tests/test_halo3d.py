@@ -234,3 +234,20 @@ def test_fused_step_defers_to_engine_with_pending_ops(world):
     assert not pending.done
     with ex.comm._progress_lock:
         ex.comm._pending.clear()
+
+
+def test_fused_exchange_matches_engine_path(world, monkeypatch):
+    """exchange() fast path (one fused program) must be byte-identical to
+    the persistent-engine path (TEMPI_NO_FUSED pins the engine)."""
+    X = 8
+    ex1 = halo3d.HaloExchange(world, X=X, periodic=True)
+    ex2 = halo3d.HaloExchange(world, X=X, periodic=True)
+    b1 = ex1.alloc_grid(fill=_coord_fill(ex1))
+    b2 = ex2.alloc_grid(fill=_coord_fill(ex2))
+    assert ex1._fused_eligible()
+    ex1.exchange(b1)                       # fused exchange program
+    monkeypatch.setenv("TEMPI_NO_FUSED", "1")
+    assert not ex2._fused_eligible()
+    ex2.exchange(b2)                       # persistent engine path
+    for rank in range(world.size):
+        np.testing.assert_array_equal(b1.get_rank(rank), b2.get_rank(rank))
