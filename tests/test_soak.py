@@ -69,3 +69,51 @@ def test_soak_mixed_traffic(world):
         for b in range(4):
             assert (got[b * 64: b * 64 + 16] == r + 1).all()
     assert ctr.counters.send.num_persistent_replays >= 39
+
+
+def test_soak_new_surfaces(world):
+    """Round-3 surfaces under sustained mixed load: fused halo iterations
+    interleaved with eager ops (forcing fused<->engine transitions),
+    MPI_Test polling, sendrecv pairs, and barriers — then the same leak
+    checks."""
+    from tempi_tpu.models import halo3d
+    from tempi_tpu.runtime import events
+
+    size = world.size
+    ty = dt.contiguous(48, dt.BYTE)
+    sbuf = world.buffer_from_host(
+        [np.full(48, r + 1, np.uint8) for r in range(size)])
+    rbuf = world.alloc(48)
+    ex = halo3d.HaloExchange(world, X=16, periodic=True)
+    grid = ex.alloc_grid(fill=lambda rank, shape: float(rank + 1))
+
+    for it in range(30):
+        if it % 3 == 0:
+            # pending eager op forces run_iteration onto the engine path
+            rr = p2p.irecv(world, (it + 1) % size, rbuf, it % size, ty,
+                           tag=2)
+            ex.run_iteration(grid)  # engine fallback (op pending)
+            rs = p2p.isend(world, it % size, sbuf, (it + 1) % size, ty,
+                           tag=2)
+            while not p2p.testall([rs, rr]):  # MPI_Test polling to done
+                pass
+        else:
+            ex.run_iteration(grid)  # fused single-program path
+        reqs = []
+        for r in range(size):
+            reqs.extend(api.sendrecv(world, r, sbuf, (r + 1) % size, ty,
+                                     rbuf, (r - 1) % size, ty, sendtag=3,
+                                     recvtag=3))
+        p2p.waitall(reqs)
+        if it % 5 == 0:
+            api.barrier(world)
+
+    grid.data.block_until_ready()
+    assert not world._pending
+    assert events._pool is None or events._pool._outstanding == 0
+    assert len(world._plan_cache) < 60, len(world._plan_cache)
+    out = np.frombuffer(grid.get_rank(0).tobytes(), np.float32)
+    assert np.isfinite(out).all()
+    for r in range(size):  # ring payload from (r-1): filled with peer+1
+        np.testing.assert_array_equal(rbuf.get_rank(r),
+                                      np.full(48, r or size, np.uint8))
