@@ -776,6 +776,11 @@ def test_oneshot_landing_is_attributed(world):
 
     from tempi_tpu.utils import counters as ctr
 
+    if world.size < 2:
+        # a 1-rank world (the real chip under TEMPI_TEST_TPU) only has
+        # self pairs, which legitimately never stage; the landing is
+        # hardware-proven by bench.py's _pinned_host_probe instead
+        pytest.skip("oneshot attribution needs a transfer pair (>=2 ranks)")
     ty = dt.contiguous(128, dt.BYTE)
     sbuf, rows = fill(world, 128)
     rbuf = world.alloc(128)
