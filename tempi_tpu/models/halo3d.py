@@ -464,6 +464,10 @@ class HaloExchange:
         from ..utils.env import DatatypeMethod
         if os.environ.get("TEMPI_NO_FUSED") is not None:
             return False
+        if envmod.env.no_tempi:
+            # TEMPI_DISABLE measures the baseline: the fused program is a
+            # framework optimization and must not mask it
+            return False
         return envmod.env.datatype in (DatatypeMethod.AUTO,
                                        DatatypeMethod.DEVICE)
 

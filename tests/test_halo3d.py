@@ -251,3 +251,25 @@ def test_fused_exchange_matches_engine_path(world, monkeypatch):
     ex2.exchange(b2)                       # persistent engine path
     for rank in range(world.size):
         np.testing.assert_array_equal(b1.get_rank(rank), b2.get_rank(rank))
+
+
+def test_fused_disabled_under_tempi_disable(world, monkeypatch):
+    """TEMPI_DISABLE is the global bail-out: the fused program must not
+    mask the baseline it exists to be compared against."""
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    try:
+        ex = halo3d.HaloExchange(world, X=8, periodic=True)
+        assert not ex._fused_eligible()
+        buf = ex.alloc_grid(fill=_coord_fill(ex))
+        ex.run_iteration(buf)  # engine path with fallback packers
+        want = _global_reference_periodic(8, 1)
+        for rank in range(world.size):
+            (lo, hi) = ex.boxes[rank]
+            np.testing.assert_allclose(
+                _rank_interior(ex, buf, rank),
+                want[lo[2]:hi[2], lo[1]:hi[1], lo[0]:hi[0]], rtol=1e-5)
+    finally:
+        monkeypatch.delenv("TEMPI_DISABLE")
+        envmod.read_environment()
