@@ -23,7 +23,13 @@ def main() -> int:
                    help="wrap-around boundaries (self-edges on 1 rank)")
     p.add_argument("--compute", action="store_true",
                    help="include the stencil update each iteration")
+    p.add_argument("--engine", action="store_true",
+                   help="pin the persistent-replay engine path "
+                        "(TEMPI_NO_FUSED) instead of the fused program")
     args = p.parse_args()
+    if args.engine:
+        import os
+        os.environ["TEMPI_NO_FUSED"] = "1"
     setup_platform(args)
 
     import numpy as np
@@ -76,10 +82,14 @@ def main() -> int:
     t_comp /= split_iters
 
     halo_bytes = sum(e.cells for e in ex.edges) * 4
-    emit_csv(("grid", "ranks", "iters", "total_s", "iter_s", "iters_per_s",
-              "exchange_s_per_iter", "compute_s_per_iter",
+    emit_csv(("grid", "ranks", "iters", "path", "total_s", "iter_s",
+              "iters_per_s", "exchange_s_per_iter", "compute_s_per_iter",
               "halo_MB_per_iter"),
-             [(args.grid, comm.size, iters, dt, dt / iters, iters / dt,
+             [(args.grid, comm.size, iters,
+               # label the path actually TAKEN: external knobs
+               # (TEMPI_NO_FUSED/DISABLE/DATATYPE_*) also deselect fused
+               "fused" if ex._fused_eligible() else "engine",
+               dt, dt / iters, iters / dt,
                t_ex, t_comp, halo_bytes / 1e6)])
     api.finalize()
     return 0
