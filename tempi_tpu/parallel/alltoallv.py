@@ -219,16 +219,17 @@ def _lib_tables(comm, sc, sd, rd):
     after the cast, so it must fail loudly here — the same guard the packer
     applies to typemap offsets (ops/packer.py)."""
     size = comm.size
+    # vectorized permutation: lx[lib[a], lib[p]] = x[a, p] (a 32-rank
+    # matrix would otherwise pay 1024 Python iterations per call)
+    lib = np.fromiter((comm.library_rank(a) for a in range(size)),
+                      dtype=np.int64, count=size)
+    ix = np.ix_(lib, lib)
     lsc = np.zeros_like(sc)
     lsd = np.zeros_like(sd)
     lrd = np.zeros_like(rd)
-    for ar in range(size):
-        lr = comm.library_rank(ar)
-        for pr in range(size):
-            lp = comm.library_rank(pr)
-            lsc[lr, lp] = sc[ar, pr]
-            lsd[lr, lp] = sd[ar, pr]
-            lrd[lr, lp] = rd[ar, pr]
+    lsc[ix] = sc
+    lsd[ix] = sd
+    lrd[ix] = rd
     # only segments that MOVE bytes constrain the tables: a large
     # displacement on a zero-count pair is never read (lanes are masked by
     # count), so it must not spuriously reject the call
