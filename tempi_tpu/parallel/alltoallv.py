@@ -211,6 +211,13 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
 # -- ragged (native XLA ragged-all-to-all) ------------------------------------
 
 
+def _lib_perm(comm) -> np.ndarray:
+    """app-rank -> library-rank permutation as one vector (shared by the
+    table translation and the staged host permute)."""
+    return np.fromiter((comm.library_rank(a) for a in range(comm.size)),
+                       dtype=np.int64, count=comm.size)
+
+
 def _lib_tables(comm, sc, sd, rd):
     """Count/displacement matrices translated to library-rank space.
 
@@ -221,8 +228,7 @@ def _lib_tables(comm, sc, sd, rd):
     size = comm.size
     # vectorized permutation: lx[lib[a], lib[p]] = x[a, p] (a 32-rank
     # matrix would otherwise pay 1024 Python iterations per call)
-    lib = np.fromiter((comm.library_rank(a) for a in range(size)),
-                      dtype=np.int64, count=size)
+    lib = _lib_perm(comm)
     ix = np.ix_(lib, lib)
     lsc = np.zeros_like(sc)
     lsd = np.zeros_like(sd)
@@ -348,6 +354,13 @@ def _restore_if_donated(comm, buf, host_copy: np.ndarray) -> None:
 
 # -- staged (bulk host) -------------------------------------------------------
 
+# Payload cap for the fully-vectorized byte-gather host permute: the three
+# concurrent int64 index arrays (seg, src_flat, dst_flat) plus the gather
+# temporary cost ~25 B of transient host memory per byte moved, so past
+# this the per-segment numpy loop (whose memcpys then dominate the
+# interpreter overhead) is cheaper.
+_STAGED_GATHER_BYTES = 4 << 20
+
 
 def _staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
     """Bulk D2H -> host alltoallv -> H2D (alltoallv_impl.cpp:68-93).
@@ -360,17 +373,33 @@ def _staged(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         log.debug("staged alltoallv on a partially-addressable buffer: "
                   "running the fused device path (multi-controller world)")
         return _device_fused(comm, sendbuf, sc, sd, recvbuf, rd)
-    size = comm.size
-    host_s = np.asarray(sendbuf.data)          # D2H
-    host_r = np.array(recvbuf.data, copy=True)  # writable host copy
-    for ar in range(size):
-        src = comm.library_rank(ar)
-        for pr in range(size):
-            dst = comm.library_rank(pr)
-            n = sc[ar, pr]
-            if n:
-                host_r[dst, rd[pr, ar]: rd[pr, ar] + n] = \
-                    host_s[src, sd[ar, pr]: sd[ar, pr] + n]
+    host_s = np.ascontiguousarray(np.asarray(sendbuf.data))   # D2H
+    # order='C': the flat-index scatter below writes through reshape(-1),
+    # which must be a VIEW — an F-ordered conversion would make it a copy
+    # and silently drop every byte moved
+    host_r = np.array(recvbuf.data, copy=True, order="C")     # writable host
+    # host permute over the nonzero pairs only (a 32-rank sparse matrix
+    # used to pay 1024 Python iterations regardless of sparsity)
+    ar, pr = np.nonzero(sc)
+    if ar.size:
+        lib = _lib_perm(comm)
+        n = sc[ar, pr].astype(np.int64)
+        if int(n.sum()) <= _STAGED_GATHER_BYTES:
+            # small payloads: ONE byte-level gather/scatter pair — O(1)
+            # Python iterations per call, capped (see _STAGED_GATHER_BYTES);
+            # big payloads below amortize the per-segment loop over large
+            # memcpys instead.
+            seg = (np.arange(int(n.sum()), dtype=np.int64)
+                   - np.repeat(np.cumsum(n) - n, n))
+            src_flat = np.repeat(lib[ar] * host_s.shape[1]
+                                 + sd[ar, pr].astype(np.int64), n) + seg
+            dst_flat = np.repeat(lib[pr] * host_r.shape[1]
+                                 + rd[pr, ar].astype(np.int64), n) + seg
+            host_r.reshape(-1)[dst_flat] = host_s.reshape(-1)[src_flat]
+        else:
+            for a, p, nn in zip(ar, pr, n):
+                host_r[lib[p], rd[p, a]: rd[p, a] + nn] = \
+                    host_s[lib[a], sd[a, p]: sd[a, p] + nn]
     recvbuf.data = jax.device_put(host_r, comm.sharding())  # H2D
 
 

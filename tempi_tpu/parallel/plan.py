@@ -110,6 +110,12 @@ def schedule_rounds(messages: Sequence[Message]) -> List[List[Message]]:
 
 from ..ops.pack_xla import _pad_to
 
+# Per-group payload cap for the fancy-index host transport in run_staged:
+# past this the one-temporary double copy of advanced indexing costs more
+# than the per-row Python loop it replaces (same economics as
+# alltoallv._STAGED_GATHER_BYTES).
+_GROUP_COPY_BYTES = 4 << 20
+
 
 class ExchangePlan:
     """A compiled communication schedule over one communicator."""
@@ -129,6 +135,7 @@ class ExchangePlan:
         self._round_fns = {}  # host_kind -> per-round (pack, unpack) fns
         self._staging = None  # pooled host staging buffer (STAGED/ONESHOT)
         self._staging_inflight = None  # H2D copy that may still read staging
+        self._host_moves = {}  # round index -> grouped transport indices
 
     # -- signature for plan caching ------------------------------------------
 
@@ -383,8 +390,7 @@ class ExchangePlan:
             for b, d in zip(self.bufs, datas):
                 b.data = d
 
-        for rnd, (kind, entry) in zip(self.rounds,
-                                      self._round_fns[host_kind]):
+        for ri, (kind, entry) in enumerate(self._round_fns[host_kind]):
             if kind == "self":
                 # local pack->unpack on device; nothing crosses the host
                 datas = list(entry(*datas))
@@ -424,14 +430,42 @@ class ExchangePlan:
             with ctr.timed(ctr.counters.device, "transfer_time"):
                 host = np.asarray(payload)        # D2H (packed bytes only)
             moved = self._staging_for(host.shape, host.dtype)
-            for m in rnd:                          # host-side transport
-                moved[m.dst, : m.nbytes] = host[m.src, : m.nbytes]
+            for nb, srcs, dsts in self._round_moves(ri):  # host transport
+                if nb * len(srcs) > _GROUP_COPY_BYTES:
+                    # advanced indexing materializes host[srcs, :nb] as a
+                    # temporary before the store — 2x traffic. On multi-MB
+                    # groups the per-row slice copies (no temp) win and the
+                    # Python overhead is noise next to the memcpys.
+                    for s, d in zip(srcs, dsts):
+                        moved[d, :nb] = host[s, :nb]
+                else:
+                    moved[dsts, :nb] = host[srcs, :nb]
             ctr.counters.device.num_transfers += 1
             with ctr.timed(ctr.counters.device, "transfer_time"):
                 dev = jax.device_put(moved, comm.sharding())   # H2D
             self._staging_inflight = dev
             datas = list(uf(dev, *datas))
             rebind()
+
+    def _round_moves(self, ri: int):
+        """Host-transport index groups for round ``ri``, built once per plan:
+        messages grouped by size so each group is ONE row-level fancy-index
+        copy (exact bytes, no stale-tail reads). A transfer round has at most
+        one sender and one receiver per rank (schedule_rounds), so the dst
+        rows within a group are unique and the scatter is well-defined. A
+        32-rank staged round with uniform message sizes — the alltoallv
+        shape — is O(1) Python iterations instead of O(size)."""
+        mv = self._host_moves.get(ri)
+        if mv is None:
+            by_nb: Dict[int, Tuple[list, list]] = {}
+            for m in self.rounds[ri]:
+                s, d = by_nb.setdefault(m.nbytes, ([], []))
+                s.append(m.src)
+                d.append(m.dst)
+            mv = [(nb, np.asarray(s, np.intp), np.asarray(d, np.intp))
+                  for nb, (s, d) in by_nb.items()]
+            self._host_moves[ri] = mv
+        return mv
 
     def _staging_for(self, shape, dtype) -> np.ndarray:
         """Host transport buffer from the slab pool (reference: hostAllocator

@@ -63,6 +63,24 @@ def test_alltoallv_methods(world, method, monkeypatch):
                                       err_msg=f"rank {r} method {method}")
 
 
+def test_alltoallv_staged_gather_and_loop_branches_agree(world, monkeypatch):
+    """_staged's host permute has two implementations: the O(1)-Python
+    byte-gather for payloads under _STAGED_GATHER_BYTES and the per-segment
+    numpy loop above it. Both must match the oracle on the same sparse
+    matrix (the loop branch otherwise only runs on >4 MiB payloads no CI
+    case reaches)."""
+    from tempi_tpu.parallel import alltoallv as a2av_mod
+
+    for cap in (a2av_mod._STAGED_GATHER_BYTES, 0):  # gather, then loop
+        monkeypatch.setattr(a2av_mod, "_STAGED_GATHER_BYTES", cap)
+        counts, sd, rc, rd, sbuf, rbuf, want = make_a2av_case(world, seed=7)
+        a2av_mod._staged(world, sbuf, counts, sd, rbuf, rd)
+        for r in range(world.size):
+            np.testing.assert_array_equal(
+                rbuf.get_rank(r), want[r],
+                err_msg=f"rank {r} gather_cap={cap}")
+
+
 def test_alltoallv_same_geometry_single_compile(world):
     """Two DIFFERENT counts matrices built to share (M, nbytes) must hit
     exactly one compiled fused program (tables are traced arguments, not

@@ -246,6 +246,31 @@ def test_staged_strategy(world):
     np.testing.assert_array_equal(rbuf.get_rank(4), want)
 
 
+def test_staged_host_transport_branches_agree(world, monkeypatch):
+    """run_staged's host transport has two branches: the grouped
+    fancy-index copy under _GROUP_COPY_BYTES and the per-row slice loop
+    above it (the cap keeps advanced indexing's gather temporary off
+    multi-MB rounds). Both must move the same bytes — the loop branch
+    otherwise only runs on >4 MiB rounds no CI case reaches."""
+    from tempi_tpu.parallel import p2p as p2p_mod
+    from tempi_tpu.parallel import plan as plan_mod
+
+    nb = 96
+    for cap in (plan_mod._GROUP_COPY_BYTES, 0):  # fancy-index, then loop
+        monkeypatch.setattr(plan_mod, "_GROUP_COPY_BYTES", cap)
+        sbuf, rows = fill(world, nb, seed=cap % 97)
+        rbuf = world.alloc(nb)
+        ty = dt.contiguous(nb, dt.BYTE)
+        for r in range(world.size):
+            api.isend(world, r, sbuf, (r + 1) % world.size, ty, tag=9)
+            api.irecv(world, r, rbuf, (r - 1) % world.size, ty, tag=9)
+        p2p_mod.try_progress(world, strategy="staged")
+        for r in range(world.size):
+            np.testing.assert_array_equal(
+                rbuf.get_rank(r), rows[(r - 1) % world.size],
+                err_msg=f"rank {r} group_copy_cap={cap}")
+
+
 def test_contiguous_sweep(world):
     """Contiguous sizes 1B..64KiB (reference test/sender.cpp:27-58)."""
     for nbytes in [1, 7, 64, 1024, 65536]:
