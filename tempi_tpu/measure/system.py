@@ -50,14 +50,20 @@ def current_platform() -> str:
     perf.json per machine via TEMPI_CACHE_DIR (env.cpp:87-106); here one
     machine exposes both a CPU mesh and the accelerator, so the cache must
     carry which one it measured — TPU curves steering the CPU mesh (or vice
-    versa) picks pathological strategies."""
+    versa) picks pathological strategies. The stamp also encodes the DEVICE
+    COUNT: a sheet measured on a 1-chip box (whose intra_node_pingpong is
+    the self-ppermute stand-in that understates real ICI latency) must not
+    silently steer a multi-chip slice of the same device kind — the count
+    mismatch refuses it and that slice re-measures its own curves."""
     import jax
     backend = jax.default_backend()
     try:
-        kind = jax.devices()[0].device_kind
+        devs = jax.devices()
+        kind = devs[0].device_kind
+        count = len(devs)
     except Exception:
-        kind = "unknown"
-    return f"{backend}/{kind}"
+        kind, count = "unknown", 0
+    return f"{backend}/{kind}/n{count}"
 
 
 @dataclass

@@ -193,6 +193,7 @@ class HaloExchange:
         self._fused_step = None  # cached fused exchange+stencil program
         self._fused_exchange = None  # cached exchange-only program
         self._stencil = None  # cached stencil-only program
+        self._fused_auto_ok = None  # cached AUTO-model verdict (fused path)
 
     @property
     def alloc(self) -> Tuple[int, int, int]:
@@ -358,26 +359,15 @@ class HaloExchange:
         self._fused_exchange = self._build_fused(None)
         return self._fused_exchange
 
-    def _build_fused(self, body):
-        """One jitted SPMD program: all exchange rounds, then ``body``
-        (the stencil) when given. AOT-compiled before return (lower +
-        compile — NO collective is executed here: a warm-run would race a
-        background pump dispatching over the same mesh, and compiling
-        inside the dispatch lock would hold every concurrent
-        post/progress/pump for tens of seconds). The returned callable is
-        the compiled executable, so the first locked dispatch is
-        compile-free."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-
+    def _edge_messages(self):
+        """The edge set as plan Messages over one identity grid-buffer
+        slot. The fused builders trace (never run) the private plan, and
+        the AUTO eligibility check models these messages, so only buffer
+        IDENTITY (every message touches the same buffer) matters."""
         from ..ops import type_cache
-        from ..parallel.plan import (ExchangePlan, Message,
-                                     donation_argnums)
+        from ..parallel.plan import Message
 
         class _GridSlot:
-            """Identity placeholder for the one grid buffer: the private
-            plan below is traced, never run, so only buffer IDENTITY (all
-            messages touch the same buffer) matters."""
             nbytes = self.nbytes
 
         slot = _GridSlot()
@@ -390,9 +380,25 @@ class HaloExchange:
                 dst=self.comm.library_rank(e.dst), tag=0,
                 nbytes=e.send_type.size, sbuf=slot, spacker=sp, scount=1,
                 soffset=0, rbuf=slot, rpacker=rp, rcount=1, roffset=0))
+        return msgs
+
+    def _build_fused(self, body):
+        """One jitted SPMD program: all exchange rounds, then ``body``
+        (the stencil) when given. AOT-compiled before return (lower +
+        compile — NO collective is executed here: a warm-run would race a
+        background pump dispatching over the same mesh, and compiling
+        inside the dispatch lock would hold every concurrent
+        post/progress/pump for tens of seconds). The returned callable is
+        the compiled executable, so the first locked dispatch is
+        compile-free."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.plan import ExchangePlan, donation_argnums
+
         # a PRIVATE plan (not the shared get_plan cache): it contributes
         # only its round schedule and branch builders to the trace
-        plan = ExchangePlan(self.comm, msgs)
+        plan = ExchangePlan(self.comm, self._edge_messages())
 
         def step(data):
             (out,) = plan._step_body(plan.rounds, (data,))
@@ -449,15 +455,41 @@ class HaloExchange:
             # every edge rides the device transport in the fused program —
             # counted like the engine would count it
             ctr.counters.send.num_device += len(self.edges)
-            buf.data = fn(buf.data)
+            try:
+                buf.data = fn(buf.data)
+            except Exception as e:
+                # the input was DONATED: a runtime failure (compile already
+                # happened AOT) may have consumed it, leaving buf.data a
+                # deleted array whose next use raises an opaque error far
+                # from the cause — diagnose it here instead
+                try:
+                    consumed = buf.data.is_deleted()
+                except Exception:
+                    consumed = False
+                if consumed:
+                    raise RuntimeError(
+                        "fused halo program failed after its grid buffer "
+                        "was donated; the grid contents are lost — "
+                        "re-initialize the buffer, or set TEMPI_NO_FUSED / "
+                        "TEMPI_NO_DONATE to route around the fused "
+                        "donating dispatch") from e
+                raise
             return True
 
-    @staticmethod
-    def _fused_eligible() -> bool:
+    def _fused_eligible(self) -> bool:
         """The fused program is the DEVICE transport; honor the global
         transport knobs (a TEMPI_DATATYPE_ONESHOT sweep must exercise the
         oneshot engine path, not be silently fused over) and provide the
-        usual presence-based escape hatch (TEMPI_NO_FUSED)."""
+        usual presence-based escape hatch (TEMPI_NO_FUSED).
+
+        Under AUTO the measured model keeps its authority: the fused path
+        activates only when the per-message model (the same decision the
+        engine would make, choose_strategy_message) picks the device
+        transport for EVERY edge — otherwise the engine path runs and
+        applies its per-message oneshot/staged choices. The verdict is
+        cached per instance: edge geometry is fixed at construction, and
+        the engine's own per-comm decision caches have the same
+        load-model-then-decide-once lifecycle."""
         import os
 
         from ..utils import env as envmod
@@ -468,8 +500,18 @@ class HaloExchange:
             # TEMPI_DISABLE measures the baseline: the fused program is a
             # framework optimization and must not mask it
             return False
-        return envmod.env.datatype in (DatatypeMethod.AUTO,
-                                       DatatypeMethod.DEVICE)
+        if envmod.env.datatype is DatatypeMethod.DEVICE:
+            return True
+        if envmod.env.datatype is not DatatypeMethod.AUTO:
+            return False
+        if self._fused_auto_ok is None:
+            self._fused_auto_ok = all(
+                p2p.choose_strategy_message(self.comm, m) == "device"
+                for m in self._edge_messages())
+            if not self._fused_auto_ok:
+                log.debug("fused halo path disabled: the measured model "
+                          "picks a host transport for at least one edge")
+        return self._fused_auto_ok
 
 
 def single_chip_step(alloc=(66, 66, 66)):

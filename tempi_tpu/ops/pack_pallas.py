@@ -165,6 +165,22 @@ def _split_dma_supported() -> bool:
         want = src.reshape(nblocks, stride)[:, :bl]
         if not (out == want).all():
             raise RuntimeError("split DMA produced wrong bytes")
+        # the plan's split factor also keys the UNPACK kernels (the same
+        # body with reversed DMA endpoints and an aliased output) — a
+        # mis-lowered chunk offset there would corrupt every split unpack,
+        # so verify that direction's bytes too, including the untouched
+        # off-column remainder of the aliased destination
+        callu, _ = _dma_call(p, unpack=True)
+        dst = (_np.arange(nblocks * stride, dtype=_np.uint8) % 239
+               ).reshape(nblocks, stride)
+        packed = (_np.arange(nblocks * bl, dtype=_np.uint8) % 241
+                  ).reshape(nblocks, bl)
+        outu = _np.asarray(jax.jit(callu)(jnp.asarray(packed),
+                                          jnp.asarray(dst)))
+        wantu = dst.copy()
+        wantu[:, :bl] = packed
+        if not (outu == wantu).all():
+            raise RuntimeError("split DMA unpack produced wrong bytes")
         return True
     except Exception as e:
         log.debug(f"row-split DMA probe failed; split stays disabled: {e}")

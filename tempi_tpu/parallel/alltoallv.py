@@ -136,15 +136,19 @@ def _device_fused(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         bulk = np.minimum(sc, T)
         _device_fused_full(comm, sendbuf, bulk, sd, recvbuf, rd)
         tails = []
+        # the pre-committed BYTE type with count=n, NOT a fresh
+        # contiguous(n) commit per distinct tail length: workloads whose
+        # count matrices vary call-to-call must not grow the global type
+        # cache without bound (the plan cache itself is LRU-bounded,
+        # plan._PLAN_CACHE_MAX)
+        packer = type_cache.get_or_commit(dtypes.BYTE).best_packer()
         for a, p in zip(*np.nonzero(sc > T)):
             n = int(sc[a, p] - T)
-            ty = dtypes.contiguous(n, dtypes.BYTE)
-            packer = type_cache.get_or_commit(ty).best_packer()
             tails.append(Message(
                 src=comm.library_rank(int(a)), dst=comm.library_rank(int(p)),
-                tag=0, nbytes=n, sbuf=sendbuf, spacker=packer, scount=1,
+                tag=0, nbytes=n, sbuf=sendbuf, spacker=packer, scount=n,
                 soffset=int(sd[a, p]) + T, rbuf=recvbuf, rpacker=packer,
-                rcount=1, roffset=int(rd[p, a]) + T))
+                rcount=n, roffset=int(rd[p, a]) + T))
         # caller (the alltoallv dispatcher) holds the progress lock
         get_plan(comm, tails).run("device")
         return
@@ -190,7 +194,8 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         rloc = rloc.at[pos.reshape(-1)].set(got.reshape(-1), mode="drop")
         return rloc.reshape(1, -1)
 
-    fn = comm._plan_cache.get(("a2av", M, sendbuf.nbytes, recvbuf.nbytes))
+    from .plan import cache_get, cache_put
+    fn = cache_get(comm, ("a2av", M, sendbuf.nbytes, recvbuf.nbytes))
     if fn is None:
         rep = P(None, None)
         sm = jax.shard_map(step, mesh=comm.mesh,
@@ -202,7 +207,7 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
         # semantics: sendbuf is untouched by the call) and is not donated.
         from .plan import donation_argnums
         fn = jax.jit(sm, donate_argnums=donation_argnums(2, skip=1))
-        comm._plan_cache[("a2av", M, sendbuf.nbytes, recvbuf.nbytes)] = fn
+        cache_put(comm, ("a2av", M, sendbuf.nbytes, recvbuf.nbytes), fn)
     recvbuf.data = fn(sendbuf.data, recvbuf.data,
                       jnp.asarray(lsc, jnp.int32), jnp.asarray(lsd, jnp.int32),
                       jnp.asarray(lrd, jnp.int32))
@@ -269,7 +274,8 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
     lsc, lsd, lrd = _lib_tables(comm, sc, sd, rd)
     key = ("a2av-ragged", sendbuf.nbytes, recvbuf.nbytes,
            lsc.tobytes(), lsd.tobytes(), lrd.tobytes())
-    fn = comm._plan_cache.get(key)
+    from .plan import cache_get, cache_put
+    fn = cache_get(comm, key)
     if fn is None:
         LSC = jnp.asarray(lsc, jnp.int32)
         LSD = jnp.asarray(lsd, jnp.int32)
@@ -306,7 +312,7 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
         except Exception as e:
             log.debug(f"ragged_all_to_all unavailable on this backend; "
                       f"using the fused path: {e}")
-            comm._plan_cache[key] = False
+            cache_put(comm, key, False)
             _restore_if_donated(comm, recvbuf, want)
             return False
         # first-use oracle check per table signature: CPU XLA cannot run
@@ -325,13 +331,13 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
         if not np.array_equal(np.asarray(out), want):
             log.warn("ragged_all_to_all produced wrong bytes on this "
                      "backend; using the fused path from now on")
-            comm._plan_cache[key] = False
+            cache_put(comm, key, False)
             # the donated recv buffer must be RESTORED before the fused
             # fallback runs, and from the pristine copy (the op's output
             # holds wrong bytes)
             recvbuf.data = jax.device_put(recv_before, comm.sharding())
             return False
-        comm._plan_cache[key] = fn
+        cache_put(comm, key, fn)
         recvbuf.data = out
         return True
     if fn is False:
@@ -413,14 +419,15 @@ def _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order: str):
         pairs.sort(key=lambda ap: comm.is_colocated(
             comm.library_rank(ap[0]), comm.library_rank(ap[1])))
     msgs = []
+    # pre-committed BYTE with count=n: see the tail-message note in
+    # _device_fused (no per-length type-cache growth)
+    packer = type_cache.get_or_commit(dtypes.BYTE).best_packer()
     for a, p in pairs:
         n = int(sc[a, p])
-        ty = dtypes.contiguous(n, dtypes.BYTE)
-        packer = type_cache.get_or_commit(ty).best_packer()
         msgs.append(Message(
             src=comm.library_rank(a), dst=comm.library_rank(p), tag=0,
-            nbytes=n, sbuf=sendbuf, spacker=packer, scount=1,
-            soffset=int(sd[a, p]), rbuf=recvbuf, rpacker=packer, rcount=1,
+            nbytes=n, sbuf=sendbuf, spacker=packer, scount=n,
+            soffset=int(sd[a, p]), rbuf=recvbuf, rpacker=packer, rcount=n,
             roffset=int(rd[p, a])))
     return msgs
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 import weakref
+from collections import OrderedDict
 from typing import List, Optional, Sequence
 
 import jax
@@ -51,7 +52,9 @@ class Communicator:
         # dist-graph adjacency per application rank: (sources, destinations)
         self.graph = graph
         self.parent = parent
-        self._plan_cache = {}
+        # LRU-bounded by plan.cache_put/_PLAN_CACHE_MAX — insertion order IS
+        # the recency order, so it must stay an OrderedDict
+        self._plan_cache = OrderedDict()
         self._pending = []  # deferred isend/irecv ops (async engine)
         # serializes op posting and progress between the application thread
         # and the background progress pump

@@ -548,17 +548,47 @@ class ExchangePlan:
         return events.comm_stream()
 
 
+# Bound on cached plans/compiled programs per communicator: workloads whose
+# message geometries vary call-to-call (e.g. skew-split alltoallv tails over
+# fresh count matrices) would otherwise accumulate compiled XLA programs
+# without limit. LRU — a reuse moves the entry to the back; an insert past
+# the cap evicts the oldest, releasing any staging slab it still pools.
+# Holders of a live reference (persistent-request batches replay their plan
+# object directly) are unaffected: eviction only drops the cache's ref.
+_PLAN_CACHE_MAX = 128
+
+
+def cache_get(comm: Communicator, key):
+    """LRU-aware read of the communicator's plan/program cache."""
+    hit = comm._plan_cache.get(key)
+    if hit is not None:
+        comm._plan_cache.move_to_end(key)
+    return hit
+
+
+def cache_put(comm: Communicator, key, value) -> None:
+    """LRU-aware insert; evicts the oldest entries past _PLAN_CACHE_MAX."""
+    cache = comm._plan_cache
+    cache[key] = value
+    cache.move_to_end(key)
+    while len(cache) > _PLAN_CACHE_MAX:
+        _, old = cache.popitem(last=False)
+        release = getattr(old, "release_staging", None)
+        if release is not None:  # cache also holds bare jitted fns/markers
+            release()
+
+
 def get_plan(comm: Communicator, messages: Sequence[Message]) -> ExchangePlan:
     """Plan cache keyed by the message-set signature (compiled programs are
     reused across iterations, like the reference's per-type sender cache)."""
     plan = ExchangePlan(comm, messages)
     key = plan.signature()
-    cached = comm._plan_cache.get(key)
+    cached = cache_get(comm, key)
     if cached is not None:
         # rebind buffers: same structure, possibly new DistBuffer.data
         cached.bufs = plan.bufs
         cached.messages = plan.messages
         cached.rounds = plan.rounds
         return cached
-    comm._plan_cache[key] = plan
+    cache_put(comm, key, plan)
     return plan

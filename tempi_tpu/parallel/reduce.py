@@ -68,14 +68,20 @@ def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
          root: Optional[int]) -> None:
     import numpy as np
 
-    if comm.freed:
-        raise RuntimeError("communicator has been freed")
-    key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
-    fn = comm._plan_cache.get(key)
-    if fn is None:
-        fn = _build(comm, buf.nbytes, dtype, op, root)
-        comm._plan_cache[key] = fn
-    buf.data = fn(buf.data)
+    # under the progress lock like barrier() below and every collective
+    # dispatcher: the LRU cache access (structural OrderedDict mutation,
+    # possible eviction releasing a staging slab) and the device collective
+    # must not interleave with a background pump mid-exchange
+    with comm._progress_lock:
+        if comm.freed:
+            raise RuntimeError("communicator has been freed")
+        key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
+        from .plan import cache_get, cache_put
+        fn = cache_get(comm, key)
+        if fn is None:
+            fn = _build(comm, buf.nbytes, dtype, op, root)
+            cache_put(comm, key, fn)
+        buf.data = fn(buf.data)
 
 
 def allreduce(comm: Communicator, buf: DistBuffer, dtype=jnp.float32,
@@ -106,7 +112,8 @@ def barrier(comm: Communicator) -> None:
         if comm.freed:
             raise RuntimeError("communicator has been freed")
         ctr.counters.lib.num_calls += 1
-        cached = comm._plan_cache.get("barrier")
+        from .plan import cache_get, cache_put
+        cached = cache_get(comm, "barrier")
         if cached is None:
             def step(x):
                 return (x + jax.lax.psum(x, AXIS) * 0).reshape(1, 1)
@@ -120,6 +127,6 @@ def barrier(comm: Communicator) -> None:
             x = jax.device_put(np.zeros((comm.size, 1), np.float32),
                                comm.sharding())
             cached = (jax.jit(sm), x)
-            comm._plan_cache["barrier"] = cached
+            cache_put(comm, "barrier", cached)
         fn, x = cached
         fn(x).block_until_ready()

@@ -253,6 +253,91 @@ def test_fused_exchange_matches_engine_path(world, monkeypatch):
         np.testing.assert_array_equal(b1.get_rank(rank), b2.get_rank(rank))
 
 
+def test_fused_auto_consults_model(world, monkeypatch):
+    """Under TEMPI_DATATYPE AUTO the fused path must defer to the measured
+    model: when the per-message model (the same decision the engine makes)
+    picks a host transport for any edge, the fused program — which rides
+    the device transport for every edge — must stand down so AUTO means
+    the same thing on both paths (ADVICE r3)."""
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.setenv("TEMPI_DATATYPE_AUTO", "")
+    monkeypatch.delenv("TEMPI_DATATYPE_ONESHOT", raising=False)
+    monkeypatch.delenv("TEMPI_DATATYPE_DEVICE", raising=False)
+    monkeypatch.delenv("TEMPI_DISABLE", raising=False)
+    envmod.read_environment()
+    try:
+        # oneshot wins every geometry: device transport is 10 s flat
+        sp = msys.SystemPerformance()
+        cheap = [[1e-9] * 9 for _ in range(9)]
+        expensive = [[10.0] * 9 for _ in range(9)]
+        sp.pack_host = sp.unpack_host = cheap
+        sp.pack_device = sp.unpack_device = expensive
+        sp.host_pingpong = [(1, 1e-9), (1 << 23, 1e-9)]
+        sp.intra_node_pingpong = [(1, 10.0), (1 << 23, 10.0)]
+        msys.set_system(sp)
+        ex = halo3d.HaloExchange(world, X=8, periodic=True)
+        assert not ex._fused_eligible()
+
+        # device wins every geometry: the fused fast path stays on
+        sp2 = msys.SystemPerformance()
+        sp2.pack_host = sp2.unpack_host = expensive
+        sp2.pack_device = sp2.unpack_device = cheap
+        sp2.host_pingpong = [(1, 10.0), (1 << 23, 10.0)]
+        sp2.intra_node_pingpong = [(1, 1e-9), (1 << 23, 1e-9)]
+        msys.set_system(sp2)
+        ex2 = halo3d.HaloExchange(world, X=8, periodic=True)
+        assert ex2._fused_eligible()
+    finally:
+        msys.set_system(msys.SystemPerformance())
+        envmod.read_environment()
+
+
+def test_fused_donation_failure_diagnosed(world, monkeypatch):
+    """A fused dispatch that fails AFTER donating its input must raise a
+    clear diagnosis (grid contents lost), not leave buf.data pointing at a
+    deleted array whose next use fails far from the cause (ADVICE r3)."""
+    ex = halo3d.HaloExchange(world, X=8, periodic=True)
+    buf = ex.alloc_grid(fill=_coord_fill(ex))
+
+    class _ConsumedArray:
+        def is_deleted(self):
+            return True
+
+    def exploding_builder():
+        def fn(data):
+            raise ValueError("simulated runtime failure after donation")
+        return fn
+
+    buf.data = _ConsumedArray()
+    with pytest.raises(RuntimeError, match="donated.*lost|lost.*donated"):
+        ex._try_fused(buf, exploding_builder)
+
+
+def test_plan_cache_lru_bounded(world, monkeypatch):
+    """Varying message geometries must not grow the per-comm plan cache
+    without bound: past _PLAN_CACHE_MAX the oldest entries are evicted,
+    newest retained (ADVICE r3 — skew-split alltoallv tails with fresh
+    count matrices accumulate one plan per pattern)."""
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p, plan as plan_mod
+
+    monkeypatch.setattr(plan_mod, "_PLAN_CACHE_MAX", 3)
+    world._plan_cache.clear()
+    for n in (8, 16, 24, 32, 40, 48):
+        sbuf = world.alloc(n)
+        rbuf = world.alloc(n)
+        p2p.isend(world, 0, sbuf, 1, dt.contiguous(n, dt.BYTE))
+        p2p.irecv(world, 1, rbuf, 0, dt.contiguous(n, dt.BYTE))
+        p2p.try_progress(world, strategy="device")
+    assert len(world._plan_cache) <= 3
+    # the most recent geometry survived and replays from cache
+    sizes = {m.nbytes for plan in world._plan_cache.values()
+             for m in plan.messages}
+    assert 48 in sizes and 8 not in sizes
+
+
 def test_fused_disabled_under_tempi_disable(world, monkeypatch):
     """TEMPI_DISABLE is the global bail-out: the fused program must not
     mask the baseline it exists to be compared against."""
