@@ -176,17 +176,64 @@ def pack_size(incount: int, datatype: Datatype) -> int:
     return dtypes.pack_size(incount, datatype)
 
 
-def pack(src_u8, incount: int, datatype: Datatype):
-    """MPI_Pack analog on a single device buffer (uint8 array in, packed
-    uint8 array out)."""
+def pack(src_u8, incount: int, datatype: Datatype, outbuf=None,
+         position: int = None):
+    """MPI_Pack analog on a single device buffer.
+
+    Two call shapes:
+      * ``pack(src, incount, ty)`` — convenience form: returns just the
+        packed uint8 array.
+      * ``pack(src, incount, ty, outbuf, position)`` — MPI cursor form
+        (MPI_Pack's position in/out, reference src/pack.cpp:28 advancing
+        ``*position``; packer_1d.cu:16-50 writes at ``outbuf+position``):
+        the packed bytes land in ``outbuf`` at byte offset ``position``;
+        returns ``(outbuf', new_position)``. Functional: the caller
+        rebinds the output buffer and threads the advanced cursor into
+        the next pack, exactly like MPI code reuses ``position``."""
     rec = type_cache.get_or_commit(datatype)
-    return rec.best_packer().pack(src_u8, incount)
+    packer = rec.best_packer()
+    if outbuf is None and position is None:
+        return packer.pack(src_u8, incount)
+    # validate BEFORE the pack executes: misuse must not pay (and then
+    # discard) a device pack dispatch
+    if outbuf is None or position is None:
+        raise ValueError("pack: outbuf and position must be given together")
+    import jax.numpy as jnp
+    outbuf = jnp.asarray(outbuf)
+    if outbuf.ndim != 1:
+        raise ValueError(f"pack: outbuf must be 1-D, got {outbuf.shape}")
+    nb = packer.packed_size * incount
+    if position < 0 or position + nb > outbuf.shape[0]:
+        # MPI_ERR_TRUNCATE analog: the reference's outsize contract
+        raise ValueError(
+            f"pack: {nb} bytes at position {position} overflow the "
+            f"{outbuf.shape[0]}-byte output buffer")
+    packed = packer.pack(src_u8, incount)
+    return outbuf.at[position: position + nb].set(packed), position + nb
 
 
-def unpack(dst_u8, packed_u8, outcount: int, datatype: Datatype):
-    """MPI_Unpack analog: returns the updated destination buffer."""
+def unpack(dst_u8, packed_u8, outcount: int, datatype: Datatype,
+           position: int = None):
+    """MPI_Unpack analog: returns the updated destination buffer.
+
+    With ``position`` (MPI cursor form, reference src/unpack.cpp mirror of
+    pack.cpp:28): ``packed_u8`` is the full pack buffer, the object's
+    bytes are read at byte offset ``position``, and the call returns
+    ``(dst', new_position)``."""
     rec = type_cache.get_or_commit(datatype)
-    return rec.best_packer().unpack(dst_u8, packed_u8, outcount)
+    packer = rec.best_packer()
+    if position is None:
+        return packer.unpack(dst_u8, packed_u8, outcount)
+    if packed_u8.ndim != 1:
+        raise ValueError(
+            f"unpack: pack buffer must be 1-D, got {packed_u8.shape}")
+    nb = packer.packed_size * outcount
+    if position < 0 or position + nb > packed_u8.shape[0]:
+        raise ValueError(
+            f"unpack: {nb} bytes at position {position} overflow the "
+            f"{packed_u8.shape[0]}-byte pack buffer")
+    out = packer.unpack(dst_u8, packed_u8[position: position + nb], outcount)
+    return out, position + nb
 
 
 # -- p2p ----------------------------------------------------------------------

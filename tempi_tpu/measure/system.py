@@ -162,8 +162,14 @@ def load_cached() -> Optional[SystemPerformance]:
             with open(path) as f:
                 sp = SystemPerformance.from_json(json.load(f))
             if sp.platform != plat:  # unstamped caches are refused too
-                log.debug(f"ignoring {path}: measured on {sp.platform!r}, "
-                          f"running on {plat!r}")
+                # visible at default verbosity: a refused sheet silently
+                # downgrades every AUTO decision to the unmeasured default.
+                # Sheets from before the stamp carried the device count
+                # ("backend/kind" with no "/nN") are refused the same way —
+                # the count cannot be trusted retroactively; re-measure.
+                log.info(f"ignoring perf sheet {path}: measured on "
+                         f"{sp.platform!r}, running on {plat!r} "
+                         f"(re-run measure_all to refresh)")
                 continue
             set_system(sp)
             log.debug(f"loaded system performance cache from {path}")

@@ -552,9 +552,13 @@ class ExchangePlan:
 # message geometries vary call-to-call (e.g. skew-split alltoallv tails over
 # fresh count matrices) would otherwise accumulate compiled XLA programs
 # without limit. LRU — a reuse moves the entry to the back; an insert past
-# the cap evicts the oldest, releasing any staging slab it still pools.
+# the cap evicts the oldest and reclaims any staging slab it still pools.
 # Holders of a live reference (persistent-request batches replay their plan
-# object directly) are unaffected: eviction only drops the cache's ref.
+# object directly) keep working — their compiled programs are untouched and
+# a reclaimed slab is lazily re-acquired by _staging_for on the next staged
+# run (one re-allocation, not a correctness hazard: every cache_put runs
+# under the comm's progress lock, so eviction can't release a slab
+# mid-round).
 _PLAN_CACHE_MAX = 128
 
 

@@ -68,19 +68,30 @@ def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
          root: Optional[int]) -> None:
     import numpy as np
 
+    # the LRU cache access (structural OrderedDict mutation, possible
+    # eviction releasing a staging slab) and the device collective run
     # under the progress lock like barrier() below and every collective
-    # dispatcher: the LRU cache access (structural OrderedDict mutation,
-    # possible eviction releasing a staging slab) and the device collective
-    # must not interleave with a background pump mid-exchange
+    # dispatcher — but the jit BUILD happens OUTSIDE it (the fused-halo
+    # discipline: a first-use compile must not freeze a background pump
+    # mid-exchange for the whole compile)
+    from .plan import cache_get, cache_put
+    key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
     with comm._progress_lock:
         if comm.freed:
             raise RuntimeError("communicator has been freed")
-        key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
-        from .plan import cache_get, cache_put
         fn = cache_get(comm, key)
-        if fn is None:
-            fn = _build(comm, buf.nbytes, dtype, op, root)
-            cache_put(comm, key, fn)
+    if fn is None:
+        built = _build(comm, buf.nbytes, dtype, op, root)
+        with comm._progress_lock:
+            if comm.freed:
+                raise RuntimeError("communicator has been freed")
+            fn = cache_get(comm, key)  # another thread may have won
+            if fn is None:
+                fn = built
+                cache_put(comm, key, fn)
+    with comm._progress_lock:
+        if comm.freed:
+            raise RuntimeError("communicator has been freed")
         buf.data = fn(buf.data)
 
 

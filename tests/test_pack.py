@@ -122,3 +122,55 @@ def test_gap_bytes_preserved():
         mask[o:o + l] = True
     assert (out[mask] == 0xAB).all()
     assert (out[~mask] == 0).all()
+
+
+def test_pack_unpack_position_cursor():
+    """MPI_Pack/MPI_Unpack cursor semantics (reference pack.cpp:28 advances
+    *position; packer_1d.cu:16-50 writes at outbuf+position): successive
+    packs into ONE buffer thread the advancing cursor; successive unpacks
+    read it back in order."""
+    import jax.numpy as jnp
+
+    from tempi_tpu import api
+
+    ty_a = st.make_2d_byte_vector(4, 8, 32)   # 32 packed bytes
+    ty_b = dt.contiguous(24, dt.BYTE)
+    src_a = rand_buf(ty_a.extent, seed=2)
+    src_b = rand_buf(ty_b.extent, seed=3)
+    outbuf = jnp.zeros(ty_a.size + ty_b.size + 8, jnp.uint8)
+
+    outbuf, pos = api.pack(jnp.asarray(src_a), 1, ty_a, outbuf, 0)
+    assert pos == ty_a.size
+    outbuf, pos = api.pack(jnp.asarray(src_b), 1, ty_b, outbuf, pos)
+    assert pos == ty_a.size + ty_b.size
+
+    want_a = st.oracle_pack(src_a, ty_a, 1)
+    np.testing.assert_array_equal(np.asarray(outbuf)[: ty_a.size], want_a)
+    np.testing.assert_array_equal(
+        np.asarray(outbuf)[ty_a.size: pos], src_b)
+
+    dst_a = rand_buf(ty_a.extent, seed=4)
+    dst_b = rand_buf(ty_b.extent, seed=5)
+    out_a, rpos = api.unpack(jnp.asarray(dst_a), outbuf, 1, ty_a, 0)
+    assert rpos == ty_a.size
+    out_b, rpos = api.unpack(jnp.asarray(dst_b), outbuf, 1, ty_b, rpos)
+    assert rpos == pos
+    np.testing.assert_array_equal(
+        np.asarray(out_a), st.oracle_unpack(dst_a, want_a, ty_a, 1))
+    np.testing.assert_array_equal(np.asarray(out_b)[:24], src_b)
+
+
+def test_pack_position_overflow_raises():
+    import jax.numpy as jnp
+
+    from tempi_tpu import api
+
+    ty = dt.contiguous(16, dt.BYTE)
+    src = jnp.zeros(16, jnp.uint8)
+    out = jnp.zeros(20, jnp.uint8)
+    with pytest.raises(ValueError, match="overflow"):
+        api.pack(src, 1, ty, out, 8)
+    with pytest.raises(ValueError, match="together"):
+        api.pack(src, 1, ty, out)
+    with pytest.raises(ValueError, match="overflow"):
+        api.unpack(jnp.zeros(16, jnp.uint8), out, 1, ty, 8)
