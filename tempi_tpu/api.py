@@ -200,8 +200,9 @@ def pack(src_u8, incount: int, datatype: Datatype, outbuf=None,
         raise ValueError("pack: outbuf and position must be given together")
     import jax.numpy as jnp
     outbuf = jnp.asarray(outbuf)
-    if outbuf.ndim != 1:
-        raise ValueError(f"pack: outbuf must be 1-D, got {outbuf.shape}")
+    if outbuf.ndim != 1 or outbuf.dtype != jnp.uint8:
+        raise ValueError(f"pack: outbuf must be a 1-D uint8 buffer, got "
+                         f"{outbuf.dtype}{list(outbuf.shape)}")
     nb = packer.packed_size * incount
     if position < 0 or position + nb > outbuf.shape[0]:
         # MPI_ERR_TRUNCATE analog: the reference's outsize contract
@@ -224,9 +225,11 @@ def unpack(dst_u8, packed_u8, outcount: int, datatype: Datatype,
     packer = rec.best_packer()
     if position is None:
         return packer.unpack(dst_u8, packed_u8, outcount)
-    if packed_u8.ndim != 1:
-        raise ValueError(
-            f"unpack: pack buffer must be 1-D, got {packed_u8.shape}")
+    import jax.numpy as jnp
+    packed_u8 = jnp.asarray(packed_u8)
+    if packed_u8.ndim != 1 or packed_u8.dtype != jnp.uint8:
+        raise ValueError(f"unpack: pack buffer must be a 1-D uint8 buffer, "
+                         f"got {packed_u8.dtype}{list(packed_u8.shape)}")
     nb = packer.packed_size * outcount
     if position < 0 or position + nb > packed_u8.shape[0]:
         raise ValueError(

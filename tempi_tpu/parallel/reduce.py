@@ -81,7 +81,12 @@ def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
             raise RuntimeError("communicator has been freed")
         fn = cache_get(comm, key)
     if fn is None:
+        # AOT: jax.jit is lazy, so the un-traced wrapper must be lowered
+        # and compiled HERE — merely building it outside the lock would
+        # push the multi-second trace+compile into the locked dispatch
+        # below (the fused-halo _build_fused discipline)
         built = _build(comm, buf.nbytes, dtype, op, root)
+        built = built.lower(buf.data).compile()
         with comm._progress_lock:
             if comm.freed:
                 raise RuntimeError("communicator has been freed")
