@@ -644,8 +644,7 @@ def _two_proc_pingpong_child(pid: str, nproc: str, coord: str) -> int:
         t0 = time.perf_counter()
         pingpong()
         times.append(time.perf_counter() - t0)
-    times.sort()
-    p50 = times[len(times) // 2]
+    p50 = _median_of(times)  # true midpoint, like every other p50 here
     api.finalize()
     if pid == "0":
         print(json.dumps({
@@ -661,6 +660,8 @@ def _two_proc_pingpong(timeout_s: float = 240.0) -> dict:
     import socket
     import subprocess
 
+    procs = []  # bound before the try: a failed second spawn must still
+    #             kill-and-reap the first child in the except path
     try:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))

@@ -26,6 +26,9 @@ def main() -> int:
     p.add_argument("--engine", action="store_true",
                    help="pin the persistent-replay engine path "
                         "(TEMPI_NO_FUSED) instead of the fused program")
+    p.add_argument("--no-phases", action="store_true",
+                   help="skip the per-phase pack/comm/unpack attribution "
+                        "pass (it compiles extra phase-isolated programs)")
     args = p.parse_args()
     if args.engine:
         import os
@@ -81,18 +84,105 @@ def main() -> int:
     t_ex /= split_iters
     t_comp /= split_iters
 
+    # phase attribution per iteration, matching the reference CSV's
+    # lcr,comm,pack,alltoallv,unpack shape (bench_halo_exchange.cpp:977-1006)
+    phases = _phase_split(ex, buf, min(iters, 10)) if not args.no_phases \
+        else {}
+
     halo_bytes = sum(e.cells for e in ex.edges) * 4
     emit_csv(("grid", "ranks", "iters", "path", "total_s", "iter_s",
               "iters_per_s", "exchange_s_per_iter", "compute_s_per_iter",
-              "halo_MB_per_iter"),
+              "halo_MB_per_iter", "lcr_s", "pack_s", "comm_s", "unpack_s",
+              "self_s"),
              [(args.grid, comm.size, iters,
                # label the path actually TAKEN: external knobs
                # (TEMPI_NO_FUSED/DISABLE/DATATYPE_*) also deselect fused
                "fused" if ex._fused_eligible() else "engine",
                dt, dt / iters, iters / dt,
-               t_ex, t_comp, halo_bytes / 1e6)])
+               t_ex, t_comp, halo_bytes / 1e6,
+               t_comp,  # lcr = local compute (the stencil), reference naming
+               phases.get("pack_s", ""), phases.get("comm_s", ""),
+               phases.get("unpack_s", ""), phases.get("self_s", ""))])
     api.finalize()
     return 0
+
+
+def _phase_split(ex, buf, iters: int) -> dict:
+    """Per-iteration pack/comm/unpack attribution for the exchange
+    (reference bench_halo_exchange.cpp:977-1006 CSV: lcr,comm,pack,
+    alltoallv,unpack — here the exchange rides ppermute rounds, so there
+    is no separate alltoallv phase).
+
+    The DEVICE plan compiles pack -> ppermute -> unpack into ONE program,
+    so phases are measured by dispatching phase-ISOLATED programs built
+    from the same plan (the staged transport's per-round pack/unpack
+    programs), with comm reported as the residual total - pack - unpack -
+    self — the same attribution the reference gets from events around its
+    pack kernels and MPI calls. Self rounds (periodic wrap edges) run
+    pack+unpack as one local program and are reported as their own
+    ``self_s`` phase. Donation is disabled for these throwaway programs so
+    repeated phase dispatches don't consume the grid buffer; the summed
+    phase times therefore slightly overstate the donating production
+    program, which is why comm is clamped at 0."""
+    import os
+    import time as _time
+
+    import jax
+
+    from tempi_tpu.parallel.plan import ExchangePlan
+
+    saved = os.environ.get("TEMPI_NO_DONATE")
+    os.environ["TEMPI_NO_DONATE"] = "1"
+    try:
+        plan = ExchangePlan(ex.comm, ex._edge_messages(buf))
+        fns = plan._build_round_fns(None)
+        datas = [b.data for b in plan.bufs]
+        xfer = [(i, e) for i, (k, e) in enumerate(fns) if k == "xfer"]
+        selfs = [e for k, e in fns if k == "self"]
+
+        payloads = {}
+        for i, (pf, uf) in xfer:  # compile + capture payloads for unpack
+            payloads[i] = pf(*datas)
+            jax.block_until_ready(payloads[i])
+            jax.block_until_ready(uf(payloads[i], *datas))
+        for e in selfs:
+            jax.block_until_ready(e(*datas))
+        plan.run_device()  # compile the full program
+        for b, d in zip(plan.bufs, datas):
+            b.data = d  # run_device rebinds; restore the originals
+
+        def timed(fn):
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                fn()
+            return (_time.perf_counter() - t0) / iters
+
+        t_pack = timed(lambda: jax.block_until_ready(
+            [pf(*datas) for _, (pf, _u) in xfer])) if xfer else 0.0
+        t_unpack = timed(lambda: jax.block_until_ready(
+            [uf(payloads[i], *datas) for i, (_p, uf) in xfer])) \
+            if xfer else 0.0
+        t_self = timed(lambda: jax.block_until_ready(
+            [e(*datas) for e in selfs])) if selfs else 0.0
+
+        def total_once():
+            plan.run_device()
+            jax.block_until_ready([b.data for b in plan.bufs])
+
+        t_total = timed(total_once)
+        return {"pack_s": round(t_pack, 6),
+                "unpack_s": round(t_unpack, 6),
+                "self_s": round(t_self, 6),
+                "comm_s": round(max(0.0, t_total - t_pack - t_unpack
+                                    - t_self), 6)}
+    except Exception as e:
+        print(f"# phase split failed: {e!r}", file=sys.stderr)
+        return {}
+    finally:
+        if saved is None:
+            os.environ.pop("TEMPI_NO_DONATE", None)
+        else:
+            os.environ["TEMPI_NO_DONATE"] = saved
 
 
 if __name__ == "__main__":

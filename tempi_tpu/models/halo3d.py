@@ -359,18 +359,20 @@ class HaloExchange:
         self._fused_exchange = self._build_fused(None)
         return self._fused_exchange
 
-    def _edge_messages(self):
-        """The edge set as plan Messages over one identity grid-buffer
-        slot. The fused builders trace (never run) the private plan, and
-        the AUTO eligibility check models these messages, so only buffer
-        IDENTITY (every message touches the same buffer) matters."""
+    def _edge_messages(self, buf=None):
+        """The edge set as plan Messages over one grid buffer. With no
+        ``buf``, an identity placeholder slot is used: the fused builders
+        trace (never run) the private plan, and the AUTO eligibility check
+        models these messages, so only buffer IDENTITY (every message
+        touches the same buffer) matters. Pass a real DistBuffer to get a
+        runnable message set (the halo bench's phase-attribution plan)."""
         from ..ops import type_cache
         from ..parallel.plan import Message
 
         class _GridSlot:
             nbytes = self.nbytes
 
-        slot = _GridSlot()
+        slot = buf if buf is not None else _GridSlot()
         msgs = []
         for e in self.edges:
             sp = type_cache.get_or_commit(e.send_type).best_packer()

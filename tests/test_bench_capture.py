@@ -114,7 +114,13 @@ def test_trials_and_median(bench_mod):
 def test_two_proc_pingpong_real(bench_mod):
     """The 2-process pingpong-nd (REAL 0<->1 pair over jax.distributed/
     Gloo — the judged 2-rank config, bench_mpi_pingpong_nd.cpp:30-99)
-    produces a positive p50 and its honest mode label."""
+    produces a positive p50 and its honest mode label.
+
+    Deliberately in the default suite despite spawning two JAX processes
+    (~25 s): the repo's test strategy treats one real multi-process run as
+    a tier, not an optional extra (test_multihost_process.py is the
+    precedent), and this is the only coverage of the bench's 2-proc
+    spawn/parse path."""
     out = bench_mod._two_proc_pingpong(timeout_s=220)
     if not out:
         # the helper's designed degrade (port race, Gloo unavailable, box
