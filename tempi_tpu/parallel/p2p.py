@@ -89,8 +89,8 @@ class Request:
     def wait(self) -> None:
         wait(self)
 
-    def test(self) -> bool:
-        return test(self)
+    def test(self, progress: bool = True) -> bool:
+        return test(self, progress=progress)
 
 
 @dataclass(slots=True)
@@ -390,7 +390,8 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
         req.buf = None
 
 
-def test(req: Request, strategy: Optional[str] = None) -> bool:
+def test(req: Request, strategy: Optional[str] = None,
+         progress: bool = True) -> bool:
     """MPI_Test analog: nonblocking completion query. The reference's async
     engine is poll-based — wake() advances the state machine with
     cudaEventQuery/MPI_Test and never blocks (async_operation.cpp:154-194);
@@ -399,8 +400,16 @@ def test(req: Request, strategy: Optional[str] = None) -> bool:
     complete when its exchange has been dispatched AND the exchanged buffer
     is ready (Event.query, the cudaEventQuery analog). An unmatched peer is
     simply "not yet" — False, never the deadlock error wait() raises,
-    because MPI_Test on a not-yet-matched request is legal polling."""
-    if not req.done:
+    because MPI_Test on a not-yet-matched request is legal polling.
+
+    COST NOTE: the default progress attempt is UNBOUNDED work — it may
+    plan, compile (first use), and dispatch every currently-matched
+    exchange on the polling thread (MPI_Test is likewise allowed to
+    progress). A tight polling loop that must stay cheap passes
+    ``progress=False``: a pure completion query (at most one pooled event
+    query, nothing dispatched) — the natural mode when the background
+    progress pump (TEMPI_PROGRESS_THREAD) owns dispatching."""
+    if not req.done and progress:
         try_progress(req.comm, strategy)
     if not req.done:
         if req.error is not None:
@@ -426,18 +435,24 @@ def _buf_ready(buf: DistBuffer) -> bool:
     return ready
 
 
-def testall(reqs, strategy: Optional[str] = None) -> bool:
+def testall(reqs, strategy: Optional[str] = None,
+            progress: bool = True) -> bool:
     """MPI_Testall analog: True only when EVERY request is complete, and
     only then are the requests' completion events considered drained (a
-    False return leaves each request individually testable/waitable)."""
+    False return leaves each request individually testable/waitable).
+    ``progress=False`` is the bounded-work pure query (see test())."""
     if not all(r.done for r in reqs):
-        # one progress attempt per DISTINCT communicator (a batch may span
-        # comms, like waitall's per-request try_progress)
-        seen: List[Communicator] = []
-        for r in reqs:
-            if not r.done and all(r.comm is not c for c in seen):
-                seen.append(r.comm)
-                try_progress(r.comm, strategy)
+        if progress:
+            # one progress attempt per DISTINCT communicator (a batch may
+            # span comms, like waitall's per-request try_progress)
+            seen: List[Communicator] = []
+            for r in reqs:
+                if not r.done and all(r.comm is not c for c in seen):
+                    seen.append(r.comm)
+                    try_progress(r.comm, strategy)
+        # the error check runs in BOTH modes: a bounded polling loop
+        # (progress=False, pump owns dispatch) must surface an engine
+        # failure, not spin on False forever
         for r in reqs:
             if not r.done and r.error is not None:
                 raise RuntimeError(
@@ -528,16 +543,17 @@ class PersistentRequest:
     def wait(self) -> None:
         waitall_persistent([self])
 
-    def test(self) -> bool:
+    def test(self, progress: bool = True) -> bool:
         """MPI_Test on an active persistent request: True completes the
         active instance (the request becomes inactive and startable again,
         like a successful MPI_Test); False leaves it active. Raising on an
         engine failure mirrors wait(): the failed instance is withdrawn and
-        the request returns to the inactive, restartable state."""
+        the request returns to the inactive, restartable state.
+        ``progress=False`` is the bounded-work pure query (see test())."""
         act = self.active
         if act is None:
             raise RuntimeError("test() on an inactive persistent request")
-        if not act.done:
+        if not act.done and progress:
             try_progress(self.comm)
         if not act.done:
             if act.error is not None:
