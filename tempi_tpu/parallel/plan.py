@@ -296,41 +296,129 @@ class ExchangePlan:
 
     # -- STAGED / ONESHOT: pack on device, move through the host -------------
 
+    @staticmethod
+    def _self_totals(rnd: List[Message]) -> Dict[int, int]:
+        """Per-rank concatenated payload bytes of an all-self round."""
+        totals: Dict[int, int] = {}
+        for m in rnd:
+            totals[m.src] = totals.get(m.src, 0) + m.nbytes
+        return totals
+
+    def _round_maxb(self, rnd: List[Message]) -> int:
+        """Staged payload row width for one round: the largest single
+        message for an xfer round, the largest per-rank CONCATENATED
+        payload for the all-self round (a rank's self messages share one
+        host round trip, _self_pack_branches)."""
+        if all(m.src == m.dst for m in rnd):
+            return max(self._self_totals(rnd).values())
+        return max(m.nbytes for m in rnd)
+
+    def _self_pack_branches(self, rnd: List[Message], maxb: int):
+        """Staged pack branches for the all-self round: each rank packs
+        ALL of its self messages into one concatenated payload (posted
+        order) — one host round trip for the whole round, not one per
+        message (the branch-per-rank tables of _send_branches can express
+        only one message per rank)."""
+        bidx = {id(b): i for i, b in enumerate(self.bufs)}
+        by_rank: Dict[int, List[Message]] = {}
+        for m in rnd:
+            by_rank.setdefault(m.src, []).append(m)
+        branches = [lambda locs: jnp.zeros((maxb,), jnp.uint8)]
+        table = np.zeros((self.comm.size,), dtype=np.int32)
+        keys: Dict[tuple, int] = {}
+        for rank, msgs in by_rank.items():
+            key = tuple((bidx[id(m.sbuf)], m.soffset, id(m.spacker),
+                         m.scount, m.nbytes) for m in msgs)
+            if key not in keys:
+                def mk(msgs=msgs):
+                    def f(locs):
+                        parts = []
+                        for m in msgs:
+                            bi = bidx[id(m.sbuf)]
+                            src = (locs[bi] if m.soffset == 0
+                                   else locs[bi][m.soffset:])
+                            parts.append(
+                                m.spacker.pack(src, m.scount)[: m.nbytes])
+                        cat = (parts[0] if len(parts) == 1
+                               else jnp.concatenate(parts))
+                        return _pad_to(cat, maxb)
+                    return f
+
+                keys[key] = len(branches)
+                branches.append(mk())
+            table[rank] = keys[key]
+        return branches, table
+
+    def _self_unpack_branches(self, rnd: List[Message], maxb: int):
+        """Inverse of _self_pack_branches: each rank walks its slice
+        cursor through the concatenated payload, unpacking message by
+        message in posted order."""
+        bidx = {id(b): i for i, b in enumerate(self.bufs)}
+        by_rank: Dict[int, List[Message]] = {}
+        for m in rnd:
+            by_rank.setdefault(m.dst, []).append(m)
+        branches = [lambda payload, locs: locs]
+        table = np.zeros((self.comm.size,), dtype=np.int32)
+        keys: Dict[tuple, int] = {}
+        for rank, msgs in by_rank.items():
+            key = tuple((bidx[id(m.rbuf)], m.roffset, id(m.rpacker),
+                         m.rcount, m.nbytes) for m in msgs)
+            if key not in keys:
+                def mk(msgs=msgs):
+                    def f(payload, locs):
+                        off = 0
+                        for m in msgs:
+                            bi = bidx[id(m.rbuf)]
+                            dst = (locs[bi] if m.roffset == 0
+                                   else locs[bi][m.roffset:])
+                            new = m.rpacker.unpack(
+                                dst, payload[off: off + m.nbytes], m.rcount)
+                            if m.roffset != 0:
+                                new = jnp.concatenate(
+                                    [locs[bi][: m.roffset], new])
+                            locs = tuple(new if i == bi else l
+                                         for i, l in enumerate(locs))
+                            off += m.nbytes
+                        return locs
+                    return f
+
+                keys[key] = len(branches)
+                branches.append(mk())
+            table[rank] = keys[key]
+        return branches, table
+
     def _build_round_fns(self, host_kind: Optional[str]):
-        """Per-round entries: ("self", fn) for self-only rounds (one local
-        jitted update, nothing to stage through the host) or
-        ("xfer", (pack_fn, unpack_fn)) for transfer rounds."""
+        """Per-round (pack_fn, unpack_fn) entries. Self rounds stage
+        through the host like any other round: STAGED/ONESHOT mean "pack
+        output moves via host memory" (the reference's staged sender
+        D2H-stages unconditionally, even for self sends,
+        sender.cpp:194-249) — a device-local shortcut here would make a
+        1-rank oneshot exchange silently measure the device path and leave
+        num_oneshot_landed unattributable on single-chip systems. A rank's
+        self messages ride ONE concatenated payload (one host round trip
+        for a 26-edge single-rank halo, not 26)."""
         comm = self.comm
         fns = []
         for rnd in self.rounds:
-            if all(m.src == m.dst for m in rnd):
-                def mk_self(rnd=rnd):
-                    def self_step(*datas):
-                        return self._step_body([rnd], datas)
+            maxb = self._round_maxb(rnd)
+            is_self = all(m.src == m.dst for m in rnd)
 
-                    n = len(self.bufs)
-                    sf = jax.shard_map(self_step, mesh=comm.mesh,
-                                       in_specs=(P(AXIS, None),) * n,
-                                       out_specs=(P(AXIS, None),) * n,
-                                       check_vma=False)
-                    return jax.jit(sf, donate_argnums=donation_argnums(n))
-
-                fns.append(("self", mk_self()))
-                continue
-            maxb = max(m.nbytes for m in rnd)
-
-            def mk(rnd=rnd, maxb=maxb):
+            def mk(rnd=rnd, maxb=maxb, is_self=is_self):
                 def pack_step(*datas):
                     locs = tuple(d.reshape(-1) for d in datas)
                     r = jax.lax.axis_index(AXIS)
-                    sbr, stab = self._send_branches(rnd, maxb)
+                    sbr, stab = (self._self_pack_branches(rnd, maxb)
+                                 if is_self
+                                 else self._send_branches(rnd, maxb))
                     payload = jax.lax.switch(jnp.asarray(stab)[r], sbr, locs)
                     return payload.reshape(1, -1)
 
                 def unpack_step(payload, *datas):
                     locs = tuple(d.reshape(-1) for d in datas)
                     r = jax.lax.axis_index(AXIS)
-                    rbr, rtab = self._recv_branches(rnd, maxb)
+                    rbr, rtab = (self._self_unpack_branches(rnd, maxb)
+                                 if is_self
+                                 else self._recv_branches(rnd, maxb))
                     locs = jax.lax.switch(jnp.asarray(rtab)[r], rbr,
                                           payload.reshape(-1), locs)
                     return tuple(l.reshape(1, -1) for l in locs)
@@ -358,10 +446,11 @@ class ExchangePlan:
                         pass
                 return pf, uf
 
-            fns.append(("xfer", mk()))
+            fns.append(mk())
         return fns
 
-    def run_staged(self, host_kind: Optional[str] = None) -> None:
+    def run_staged(self, host_kind: Optional[str] = None,
+                   start_ri: int = 0) -> None:
         """Pack on device -> D2H -> permute on host -> H2D -> unpack.
 
         ``host_kind='pinned_host'`` asks XLA to commit the pack output
@@ -390,13 +479,9 @@ class ExchangePlan:
             for b, d in zip(self.bufs, datas):
                 b.data = d
 
-        for ri, (kind, entry) in enumerate(self._round_fns[host_kind]):
-            if kind == "self":
-                # local pack->unpack on device; nothing crosses the host
-                datas = list(entry(*datas))
-                rebind()
-                continue
-            pf, uf = entry
+        fns = self._round_fns[host_kind]
+        for ri in range(start_ri, len(fns)):
+            pf, uf = fns[ri]
             if host_kind is not None:
                 try:
                     payload = pf(*datas)
@@ -416,14 +501,18 @@ class ExchangePlan:
                 except Exception:
                     # platform without host memory kinds (e.g. CPU): fall
                     # back to plain device outputs for the pack stage, and
-                    # remember so later runs don't retry the broken programs
+                    # remember so later runs don't retry the broken programs.
+                    # RESUME at this round — rounds < ri already ran and
+                    # applied their exchanges (a pack failure mutates
+                    # nothing: pf does not donate), so restarting from 0
+                    # would re-apply them to already-exchanged buffers
                     ctr.counters.send.num_oneshot_degraded += 1
                     log.debug(f"memory kind {host_kind!r} unsupported; "
                               "staged pack falls back to device outputs")
                     if None not in self._round_fns:
                         self._round_fns[None] = self._build_round_fns(None)
                     self._round_fns[host_kind] = self._round_fns[None]
-                    return self.run_staged(host_kind=None)
+                    return self.run_staged(host_kind=None, start_ri=ri)
             else:
                 payload = pf(*datas)
             ctr.counters.device.num_transfers += 1
@@ -457,11 +546,18 @@ class ExchangePlan:
         shape — is O(1) Python iterations instead of O(size)."""
         mv = self._host_moves.get(ri)
         if mv is None:
+            rnd = self.rounds[ri]
+            if all(m.src == m.dst for m in rnd):
+                # self round: one concatenated payload per rank
+                items = [(nb, r, r)
+                         for r, nb in self._self_totals(rnd).items()]
+            else:
+                items = [(m.nbytes, m.src, m.dst) for m in rnd]
             by_nb: Dict[int, Tuple[list, list]] = {}
-            for m in self.rounds[ri]:
-                s, d = by_nb.setdefault(m.nbytes, ([], []))
-                s.append(m.src)
-                d.append(m.dst)
+            for nb, src, dst in items:
+                s, d = by_nb.setdefault(nb, ([], []))
+                s.append(src)
+                d.append(dst)
             mv = [(nb, np.asarray(s, np.intp), np.asarray(d, np.intp))
                   for nb, (s, d) in by_nb.items()]
             self._host_moves[ri] = mv
@@ -490,12 +586,10 @@ class ExchangePlan:
         return self._staging[:nbytes].view(dtype).reshape(shape)
 
     def _staging_capacity(self) -> int:
-        """Largest per-round staging footprint of this plan. Self-only
-        rounds never touch the host slab (run_staged skips them), so they
-        don't size it."""
-        return max((self.comm.size * max(m.nbytes for m in rnd)
-                    for rnd in self.rounds
-                    if rnd and any(m.src != m.dst for m in rnd)), default=0)
+        """Largest per-round staging footprint of this plan (self rounds
+        stage through the slab too since round 4)."""
+        return max((self.comm.size * self._round_maxb(rnd)
+                    for rnd in self.rounds if rnd), default=0)
 
     def release_staging(self) -> None:
         if self._staging_inflight is not None:

@@ -52,7 +52,7 @@ def test_wedged_child_keeps_partial_burst(bench_mod, tmp_path):
         "sys.stdout.write(json.dumps({'pingpong_nd_p50_us': 5}) + '\\n')\n"
         "sys.stdout.flush()\n"
         "time.sleep(600)\n"
-    ))._device_bench(inactivity_s=3, overall_s=30)
+    ))._device_bench(inactivity_s=15, overall_s=60)
     assert m["pack_gbs"] == 9.9 and m["pingpong_nd_p50_us"] == 5
     assert m["device_bench_complete"] is False
 

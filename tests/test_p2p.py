@@ -884,3 +884,53 @@ def test_barrier(world):
     c2.free()
     with pytest.raises(RuntimeError, match="freed"):
         api.barrier(c2)
+
+
+@pytest.mark.parametrize("strategy", ["staged", "oneshot"])
+def test_multiple_self_messages_staged(world, strategy):
+    """A rank with SEVERAL self messages in one STAGED/ONESHOT batch must
+    apply ALL of them: the scheduler batches every self message into one
+    round, and the staged path concatenates a rank's self payloads into
+    ONE staged payload per round (_self_pack_branches) because the plain
+    branch tables can express only one pack per rank per round.
+    Regression: the round-4 staged-self rework initially dropped all but
+    the last self message per rank."""
+    ty = dt.contiguous(8, dt.BYTE)
+    sbuf, rows = fill(world, 32, seed=33)
+    rbuf = world.alloc(32)
+    reqs = []
+    for r in range(world.size):
+        # two self messages per rank, disjoint source/dest windows
+        reqs.append(api.isend(world, r, sbuf, r, ty, tag=1, offset=0))
+        reqs.append(api.irecv(world, r, rbuf, r, ty, tag=1, offset=16))
+        reqs.append(api.isend(world, r, sbuf, r, ty, tag=2, offset=8))
+        reqs.append(api.irecv(world, r, rbuf, r, ty, tag=2, offset=24))
+    api.waitall(reqs, strategy=strategy)
+    for r in range(world.size):
+        got = np.asarray(rbuf.get_rank(r))
+        np.testing.assert_array_equal(got[16:24], rows[r][0:8])
+        np.testing.assert_array_equal(got[24:32], rows[r][8:16])
+
+
+def test_staged_plan_rebind_fresh_buffers(world):
+    """A cached plan rebound to fresh same-signature DistBuffers must build
+    staged round fns against the NEW binding (get_plan rebinds
+    bufs/messages/rounds; _build_round_fns must read the current rounds,
+    never a cache of Message objects from an earlier binding, else it
+    raises KeyError on buffers absent from self.bufs)."""
+    ty = dt.contiguous(16, dt.BYTE)
+
+    def run(seed, strategy):
+        sbuf, rows = fill(world, 16, seed=seed)
+        rbuf = world.alloc(16)
+        reqs = []
+        for r in range(world.size):
+            reqs.append(api.isend(world, r, sbuf, r, ty))
+            reqs.append(api.irecv(world, r, rbuf, r, ty))
+        api.waitall(reqs, strategy=strategy)
+        for r in range(world.size):
+            np.testing.assert_array_equal(rbuf.get_rank(r), rows[r])
+
+    run(51, "staged")    # builds the plan + split rounds for binding A
+    run(52, "oneshot")   # same signature, fresh buffers: rebound plan must
+    run(53, "staged")    # rebuild round fns for the new binding, both kinds
