@@ -162,11 +162,29 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
 
     grids = [("pack_device", False, False), ("unpack_device", True, False),
              ("pack_host", False, True), ("unpack_host", True, True)]
+    ni, _ = _grid_dims(quick)
     for name, is_unpack, to_host in grids:
-        if getattr(sp, name):
+        prior = getattr(sp, name)
+        dirty = prior and any(t >= _UNMEASURABLE_S for row in prior
+                              for t in row)
+        if prior and (len(prior) > ni or (len(prior) == ni and not dirty)):
+            # the incremental skip: same-size and clean, or LARGER than
+            # this run would produce (a quick 3x3 re-sweep must not
+            # shrink a full 9x9 sheet, sentinel or not). A clean but
+            # SMALLER grid falls through — a full sweep upgrades a
+            # quick-mode sheet to full coverage instead of keeping its
+            # three single-trial sizes forever.
             continue
+        # absent, or carrying unmeasurable-sentinel cells from an earlier
+        # sweep (a transient compile/OOM blip must not poison the cached
+        # sheet forever): re-measure sentinel cells, keep good ones.
+        # Prior cells are reused only from a SAME-SIZE grid — a full
+        # sweep healing a dirty quick grid re-measures everything rather
+        # than freezing single-trial quick samples into the full sheet.
         setattr(sp, name,
-                _pack_grid(device, is_unpack, to_host, quick, kw))
+                _pack_grid(device, is_unpack, to_host, quick, kw,
+                           prior=prior if prior and len(prior) == ni
+                           else None))
         log.debug(f"{name}: grid measured")
 
     msys.set_system(sp)
@@ -279,20 +297,33 @@ def _staged_pingpong_curve(devs, quick, kw):
     return curve
 
 
-def _pack_grid(device, is_unpack, to_host, quick, kw):
+def _grid_dims(quick: bool):
+    """(rows, cols) every pack grid of this sweep mode uses — the single
+    source of truth for measure_all's skip/keep policy AND _pack_grid's
+    build size (they must agree or the keep-larger rule misclassifies)."""
+    return ((3, 3) if quick
+            else (len(GRID_BYTES), len(GRID_BLOCKLEN)))
+
+
+def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None):
     """9x9 grid of (bytes=2^(2i+6), blockLength=2^j), stride 512
-    (measure_system.cu:254-373)."""
+    (measure_system.cu:254-373). ``prior`` (a previous same-size sweep's
+    grid) re-measures only its unmeasurable-sentinel cells and keeps the
+    rest."""
     import jax
     import jax.numpy as jnp
 
     from ..ops.packer import PackerND
     from ..ops.strided_block import StridedBlock
 
-    ni = 3 if quick else len(GRID_BYTES)
-    nj = 3 if quick else len(GRID_BLOCKLEN)
+    ni, nj = _grid_dims(quick)
     grid = [[0.0] * nj for _ in range(ni)]
     for i in range(ni):
         for j in range(nj):
+            if prior is not None and i < len(prior) and j < len(prior[i]) \
+                    and prior[i][j] and prior[i][j] < _UNMEASURABLE_S:
+                grid[i][j] = prior[i][j]
+                continue
             nbytes, bl = GRID_BYTES[i], GRID_BLOCKLEN[j]
             count = max(1, nbytes // bl)
             sb = StridedBlock(start=0, extent=count * GRID_STRIDE,
