@@ -106,12 +106,17 @@ def _accelerator_usable() -> bool:
 
 
 def bench_pack(jax, devices, quick: bool = False, nblocks: int = 8192,
-               batch_k: int = PACK_BATCH_K):
+               batch_k: int = PACK_BATCH_K, incount: bool = False):
     """Packed-object bandwidth for an ``nblocks x 512B @ 1024B-stride`` 2-D
     subarray. The reference benchmarks pack at three object sizes
     {1 KiB, 1 MiB, 4 MiB} (bin/bench_mpi_pack.cpp:127): nblocks 2 / 2048 /
     8192 at this shape. Small objects are dispatch-bound, so callers raise
-    ``batch_k`` for them (more independent packs per dispatch)."""
+    ``batch_k`` for them (more independent packs per dispatch).
+
+    ``incount=True`` batches as ONE ``pack(buf, K)`` call over K
+    extent-spaced objects in one buffer (MPI_Pack's own incount form):
+    compile time is O(1) in K and the whole batch is a single kernel, the
+    fastest supported small-object discipline."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -129,11 +134,17 @@ def bench_pack(jax, devices, quick: bool = False, nblocks: int = 8192,
     # dispatch — per-dispatch gaps otherwise add ~6 us/op; (c) 2 ms samples
     # so the ~100 us flush round trip amortizes below 1%.
     K = batch_k
-    bufs = [jax.device_put(
-        jnp.asarray(np.random.default_rng(i).integers(0, 256, ty.extent,
-                                                      np.uint8)),
-        devices[0]) for i in range(K)]
-    mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
+    if incount:
+        big = jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(
+                0, 256, ty.extent * K, np.uint8)), devices[0])
+        mega, bufs = jax.jit(lambda b: packer.pack(b, K)), big
+    else:
+        bufs = [jax.device_put(
+            jnp.asarray(np.random.default_rng(i).integers(0, 256, ty.extent,
+                                                          np.uint8)),
+            devices[0]) for i in range(K)]
+        mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
     jax.block_until_ready(mega(bufs))  # compile
     last = []
 
@@ -231,8 +242,18 @@ def bench_pingpong_nd(jax, quick: bool):
             rp_p50 / hops, per_strategy)
 
 
-def bench_halo(jax, n_devices: int, quick: bool):
-    """Halo-exchange iterations/s at matched per-device bytes."""
+def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False):
+    """Halo-exchange iterations/s at matched per-device bytes.
+
+    ``engine=True`` pins ``strategy="device"``, which routes through the
+    persistent-replay engine with DEVICE transport on every edge instead
+    of the fused exchange program — the round-2 bench's effective code
+    path (engine + AUTO-falling-through-to-device), kept measurable for
+    the fused-vs-engine hardware A/B (VERDICT r3 item 2).
+    ``benches/bench_halo_exchange.py --engine`` pins via TEMPI_NO_FUSED
+    with per-edge strategy selection instead; on an unmeasured system
+    both land on DEVICE, but they can diverge once a perf sheet is
+    live."""
     from tempi_tpu import api
     from tempi_tpu.models import halo3d
     from tempi_tpu.parallel.communicator import Communicator
@@ -246,16 +267,17 @@ def bench_halo(jax, n_devices: int, quick: bool):
         # 512^3 / 8 ranks = 256^3 cells per rank; periodic wrap gives this
         # one rank the full 26-edge exchange of an interior rank
         X, periodic = 256 if not quick else 32, True
+    strategy = "device" if engine else None
     ex = halo3d.HaloExchange(comm, X=X, periodic=periodic)
     buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
     for _ in range(3):  # compile + settle the tunnel
-        ex.exchange(buf)
+        ex.exchange(buf, strategy=strategy)
         buf.data.block_until_ready()
     iters = 5 if quick else 50
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        ex.exchange(buf)
+        ex.exchange(buf, strategy=strategy)
         buf.data.block_until_ready()
         times.append(time.perf_counter() - t0)
     med = _median_of(times)  # median: robust to tunnel hiccups
@@ -486,6 +508,14 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
     except Exception as e:
         print(f"halo failed: {e!r}", file=sys.stderr)
         emit({"halo_iters_per_s": None, "halo_config": "failed"})
+    try:
+        # same config through the persistent-replay ENGINE path: the
+        # fused-vs-engine hardware A/B lands in every capture
+        eng_ips, _ = bench_halo(jax, len(devices), quick, engine=True)
+        emit({"halo_engine_iters_per_s": round(eng_ips, 2)})
+    except Exception as e:
+        print(f"halo engine A/B failed: {e!r}", file=sys.stderr)
+        emit({"halo_engine_iters_per_s": None})
     for label, reorder in (("alltoallv_sparse_s", False),
                            ("alltoallv_sparse_remap_s", True)):
         try:
@@ -509,6 +539,22 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
             emit({label: round(
                 bench_pack(jax, devices, quick, nblocks=nblocks,
                            batch_k=k), 3),
+                  klabel: k})
+        except Exception as e:
+            print(f"{label} failed: {e!r}", file=sys.stderr)
+            emit({label: None, klabel: k})
+    # the same two objects batched as ONE pack(buf, K) call (MPI_Pack
+    # incount semantics, O(1) compile in K): the framework's fastest
+    # small-object discipline, reported beside the unrolled numbers with
+    # its own K so the disciplines stay distinguishable
+    for label, klabel, nblocks, k, kq in (
+            ("pack_gbs_1m_incount", "pack_incount_k_1m", 2048, 256, 32),
+            ("pack_gbs_1k_incount", "pack_incount_k_1k", 2, 4096, 512)):
+        k = kq if quick else k  # quick smoke: skip the 512 MiB buffer
+        try:
+            emit({label: round(
+                bench_pack(jax, devices, quick, nblocks=nblocks,
+                           batch_k=k, incount=True), 3),
                   klabel: k})
         except Exception as e:
             print(f"{label} failed: {e!r}", file=sys.stderr)
@@ -917,6 +963,7 @@ def main() -> int:
                          ("pingpong_nd_staged_p50_us", None),
                          ("pingpong_nd_oneshot_p50_us", None),
                          ("halo_iters_per_s", None),
+                         ("halo_engine_iters_per_s", None),
                          ("halo_config", "missing"),
                          ("alltoallv_sparse_s", None),
                          ("alltoallv_sparse_remap_s", None),
@@ -925,6 +972,10 @@ def main() -> int:
                          ("pack_gbs_1k", None),
                          ("pack_batch_k_1m", None),
                          ("pack_batch_k_1k", None),
+                         ("pack_gbs_1m_incount", None),
+                         ("pack_gbs_1k_incount", None),
+                         ("pack_incount_k_1m", None),
+                         ("pack_incount_k_1k", None),
                          *((k, None) for k in _MODEL_EVIDENCE_KEYS)):
         dev.setdefault(key, default)
     a2av_platform = platform

@@ -2,19 +2,24 @@
 """On-chip pack-kernel tuning sweep (VERDICT r2 item 4: close the gap to
 the ~819 GB/s v5e HBM roofline).
 
-Sweeps the two dispatch knobs that govern the direct-DMA pack kernel's
-sustained bandwidth at the bench-mpi-pack headline shape:
+Sweeps the dispatch knobs that govern pack bandwidth at the three judged
+bench-mpi-pack object sizes (bench_mpi_pack.cpp:127):
 
   * TEMPI_PACK_SPLIT — single-combo DMA row splitting (1 = one big strided
     make_async_copy; S = S concurrent DMAs over disjoint row chunks)
-  * batch K — independent packs jitted into one dispatch
+  * batch K — independent packs amortizing one dispatch, in two forms:
+      - "unroll": K separate buffers, K pack calls jitted into one program
+        (compile time grows with K — capped at 256)
+      - "incount": ONE buffer holding K extent-spaced objects, a single
+        ``pack(buf, K)`` call (MPI_Pack's own incount semantics; compile
+        time is O(1) in K, so K can grow until bandwidth saturates)
 
 Each config runs in its OWN subprocess (the split target is read at module
-import) with a short fixed schedule, so a full sweep costs ~1-2 min of chip
-time. Prints one JSON line per config and a final "best" line; feed the
-winner back into pack_pallas._DMA_SPLIT_TARGET's default.
+import) with a short fixed schedule. Prints one JSON line per config and a
+"best" line per shape; feed winners back into pack_pallas defaults and
+bench.py's per-target batch sizes.
 
-Usage: python benches/bench_pack_tuning.py [--quick]
+Usage: python benches/bench_pack_tuning.py [--quick] [4m|1m|1k ...]
 """
 
 import json
@@ -22,12 +27,32 @@ import os
 import subprocess
 import sys
 
-SPLITS = (1, 2, 4, 8, 16)
-BATCHES = (8, 16)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # child subprocesses import tempi_tpu by path
+    sys.path.insert(0, REPO)
+
+# shape label -> ((nblocks, blockLength, stride), [(mode, split, K), ...])
+SHAPES = {
+    "4m": ((8192, 512, 1024),
+           [("unroll", s, k) for s in (1, 2, 4, 8, 16) for k in (8, 16)]
+           + [("incount", s, k) for s in (1, 4) for k in (8, 32)]),
+    "1m": ((2048, 512, 1024),
+           [("unroll", s, 32) for s in (1, 2, 4)]
+           + [("incount", 1, k) for k in (32, 128, 512)]),
+    "1k": ((2, 512, 1024),
+           [("unroll", 1, k) for k in (64, 256)]
+           + [("incount", 1, k) for k in (256, 1024, 4096)]),
+}
 
 
 def _child() -> int:
     import time
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # hermetic CPU smoke runs: the site's axon registration overrides
+        # JAX_PLATFORMS and would dial the (possibly wedged) TPU tunnel
+        from tempi_tpu.utils.platform import force_cpu
+        force_cpu()
 
     import jax
     import jax.numpy as jnp
@@ -38,32 +63,52 @@ def _child() -> int:
 
     split = int(os.environ.get("TEMPI_PACK_SPLIT", "1"))
     k = int(os.environ.get("TEMPI_TUNE_BATCH_K", "8"))
+    mode = os.environ.get("TEMPI_TUNE_MODE", "unroll")
     quick = os.environ.get("TEMPI_TUNE_QUICK") == "1"
-    nblocks, bl, stride = 8192, 512, 1024  # the 4 MiB headline shape
+    shape = os.environ.get("TEMPI_TUNE_SHAPE", "4m")
+    nblocks, bl, stride = SHAPES[shape][0]
     ty = dt.subarray([nblocks, stride], [nblocks, bl], [0, 0], dt.BYTE)
     rec = type_cache.get_or_commit(ty)
     packer = rec.best_packer()
     dev = jax.devices()[0]
-    bufs = [jax.device_put(
-        jnp.asarray(np.random.default_rng(i).integers(
-            0, 256, ty.extent, np.uint8)), dev) for i in range(k)]
-    mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
-    jax.block_until_ready(mega(bufs))  # compile
-    # fixed schedule: reps sized for ~2 ms samples, median of N samples
-    reps = max(1, int(2e-3 / 40e-6 / k))
+    if mode == "incount":
+        if quick:
+            # hermetic smoke mode: cap the batched buffer at 64 MiB so a
+            # small CI host neither OOMs nor blows the child timeout
+            k = min(k, max(1, (64 << 20) // ty.extent))
+        big = jax.device_put(jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, ty.extent * k, np.uint8)), dev)
+        mega = jax.jit(lambda b: packer.pack(b, k))
+        args = (big,)
+    else:
+        bufs = [jax.device_put(
+            jnp.asarray(np.random.default_rng(i).integers(
+                0, 256, ty.extent, np.uint8)), dev) for i in range(k)]
+        mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
+        args = (bufs,)
+    jax.block_until_ready(mega(*args))  # compile
+    # fixed schedule: reps CALIBRATED so each timed sample spans ~2 ms
+    # (amortizing the ~100 us tunneled dispatch/flush round trip below
+    # 5%) — a per-call guess would be off by orders of magnitude between
+    # the unroll and single-kernel incount disciplines
+    t0 = time.perf_counter()
+    jax.block_until_ready(mega(*args))
+    once = max(time.perf_counter() - t0, 1e-7)
+    reps = max(1, int(2e-3 / once))
     samples = 10 if quick else 30
     times = []
     for _ in range(samples):
         t0 = time.perf_counter()
         last = None
         for _ in range(reps):
-            last = mega(bufs)
+            last = mega(*args)
         jax.block_until_ready(last)
         times.append((time.perf_counter() - t0) / reps)
     times.sort()
     med = times[len(times) // 2]
-    print(json.dumps({"split": split, "batch_k": k,
-                      "gbs": round(ty.size * k / med / 1e9, 1)}))
+    print(json.dumps({"shape": shape, "mode": mode, "split": split,
+                      "batch_k": k,
+                      "gbs": round(ty.size * k / med / 1e9, 3)}))
     return 0
 
 
@@ -71,11 +116,21 @@ def main() -> int:
     if "--child" in sys.argv:
         return _child()
     quick = "--quick" in sys.argv
+    bad = [a for a in sys.argv[1:] if a not in SHAPES and a != "--quick"]
+    if bad:
+        # a typo must fail fast, not silently burn the full 25-config
+        # chip sweep
+        print(f"unknown argument(s) {bad}; valid: "
+              f"{['--quick'] + sorted(SHAPES)}", file=sys.stderr)
+        return 2
+    wanted = [a for a in sys.argv[1:] if a in SHAPES] or list(SHAPES)
     results = []
-    for split in SPLITS:
-        for k in BATCHES:
+    for shape in wanted:
+        for mode, split, k in SHAPES[shape][1]:
             env = dict(os.environ, TEMPI_PACK_SPLIT=str(split),
                        TEMPI_TUNE_BATCH_K=str(k),
+                       TEMPI_TUNE_MODE=mode,
+                       TEMPI_TUNE_SHAPE=shape,
                        TEMPI_TUNE_QUICK="1" if quick else "0")
             try:
                 r = subprocess.run(
@@ -85,10 +140,12 @@ def main() -> int:
                 results.append(line)
                 print(json.dumps(line), flush=True)
             except Exception as e:
-                print(f"split={split} k={k} failed: {e!r}", file=sys.stderr)
-    if results:
-        best = max(results, key=lambda d: d["gbs"])
-        print(json.dumps({"best": best}))
+                print(f"shape={shape} mode={mode} split={split} k={k} "
+                      f"failed: {e!r}", file=sys.stderr)
+        shaped = [d for d in results if d["shape"] == shape]
+        if shaped:
+            best = max(shaped, key=lambda d: d["gbs"])
+            print(json.dumps({"best": best}), flush=True)
     return 0
 
 

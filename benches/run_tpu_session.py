@@ -104,7 +104,10 @@ def ship() -> bool:
 
 
 def tune() -> bool:
-    return _run([sys.executable, "benches/bench_pack_tuning.py"], 1800,
+    # 25 configs x (subprocess startup + tunneled compile + schedule):
+    # budget well past the worst case so a slow-compiling child doesn't
+    # abort the session before bench2
+    return _run([sys.executable, "benches/bench_pack_tuning.py"], 3000,
                 "tune")
 
 
@@ -113,10 +116,20 @@ STEPS = {"probe": probe, "bench": bench, "measure": measure, "ship": ship,
 ORDER = ["probe", "bench", "measure", "ship", "tune", "bench2"]
 
 
+# best-effort steps: a failure (even a timeout) must not stop the session
+# — bench2's judged re-capture matters more than a complete tuning sweep,
+# and tune's 25-child worst case exceeds any sane fixed budget
+NON_FATAL = {"tune"}
+
+
 def main() -> int:
     wanted = [a for a in sys.argv[1:] if a in STEPS] or ORDER
     for name in wanted:
-        if STEPS[name]() is not True:  # False OR "timeout" both stop
+        res = STEPS[name]()
+        if res is not True and name in NON_FATAL:
+            print(f"{name} incomplete (non-fatal); continuing", flush=True)
+            continue
+        if res is not True:  # False OR "timeout" both stop
             print(f"session stopped at {name}", flush=True)
             return 1
     print("session complete", flush=True)
