@@ -236,9 +236,24 @@ def test_fused_step_defers_to_engine_with_pending_ops(world):
         ex.comm._pending.clear()
 
 
+def _pin_fused(monkeypatch):
+    """Make the fused path deterministically eligible: pin the DEVICE
+    transport (fused is unconditionally eligible under it,
+    _fused_eligible) and clear every knob that disables it — including
+    AUTO, whose verdict would depend on whatever perf sheet this machine
+    has cached."""
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setenv("TEMPI_DATATYPE_DEVICE", "1")
+    monkeypatch.delenv("TEMPI_DATATYPE_ONESHOT", raising=False)
+    monkeypatch.delenv("TEMPI_DISABLE", raising=False)
+    monkeypatch.delenv("TEMPI_NO_FUSED", raising=False)
+    envmod.read_environment()
+
+
 def test_fused_exchange_matches_engine_path(world, monkeypatch):
     """exchange() fast path (one fused program) must be byte-identical to
     the persistent-engine path (TEMPI_NO_FUSED pins the engine)."""
+    _pin_fused(monkeypatch)
     X = 8
     ex1 = halo3d.HaloExchange(world, X=X, periodic=True)
     ex2 = halo3d.HaloExchange(world, X=X, periodic=True)
@@ -298,6 +313,7 @@ def test_fused_donation_failure_diagnosed(world, monkeypatch):
     """A fused dispatch that fails AFTER donating its input must raise a
     clear diagnosis (grid contents lost), not leave buf.data pointing at a
     deleted array whose next use fails far from the cause (ADVICE r3)."""
+    _pin_fused(monkeypatch)
     ex = halo3d.HaloExchange(world, X=8, periodic=True)
     buf = ex.alloc_grid(fill=_coord_fill(ex))
 
