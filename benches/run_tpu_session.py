@@ -67,7 +67,7 @@ def measure() -> bool:
         "from tempi_tpu import api\n"
         "from tempi_tpu.measure import sweep, system as msys\n"
         "api.init(jax.devices())\n"
-        "sp = sweep.measure_all()\n"
+        "sp = sweep.measure_all(checkpoint=True)\n"
         "print('sections:', {k: bool(getattr(sp, k)) for k in ('d2h',"
         "'h2d','host_pingpong','intra_node_pingpong',"
         "'inter_node_pingpong','pack_device','unpack_device','pack_host',"

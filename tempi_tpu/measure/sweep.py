@@ -41,9 +41,20 @@ def _transfer_sizes(quick: bool) -> List[int]:
 
 
 def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
-                device=None) -> SystemPerformance:
+                device=None, checkpoint: bool = False) -> SystemPerformance:
+    """``checkpoint=True`` persists the sheet after EVERY completed section
+    (d2h, h2d, each pingpong curve, each pack grid): on a wedge-prone
+    tunnel a crash mid-sweep costs only the section in flight — the next
+    attempt resumes from the saved sections instead of starting over."""
     import jax
     import jax.numpy as jnp
+
+    def _ckpt():
+        # process 0 only: on a shared cache dir, N processes checkpointing
+        # at divergent sweep points would race (and a lagging process
+        # could overwrite a more complete sheet)
+        if checkpoint and jax.process_index() == 0:
+            msys.save(sp)
 
     if sp is None:
         sp = msys.load_cached() or SystemPerformance()
@@ -86,6 +97,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             r = benchmark(lambda: np.asarray(buf), **kw)
             sp.d2h.append((nb, r.trimean))
             dev_alloc.release(scratch)
+        _ckpt()
         log.debug(f"d2h: {len(sp.d2h)} points")
 
     if not sp.h2d:
@@ -96,6 +108,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
                 **kw)
             sp.h2d.append((nb, r.trimean))
             dev_alloc.release(host)
+        _ckpt()
         log.debug(f"h2d: {len(sp.h2d)} points")
 
     if not sp.host_pingpong:
@@ -107,6 +120,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             sp.host_pingpong.append((nb, r.trimean))
             host_alloc.release(a)
             host_alloc.release(b)
+        _ckpt()
 
     if not sp.intra_node_pingpong:
         # LOCAL devices only: a global-device mesh would span processes —
@@ -129,6 +143,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             log.debug("single local device: measuring self-ppermute "
                       "stand-in for the intra-node pingpong curve")
             sp.intra_node_pingpong = _self_pingpong_curve(devs[0], quick, kw)
+        _ckpt()
 
     pair = _cross_process_pair(jax.devices())
     if pair is not None:
@@ -152,11 +167,13 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             arr = np.asarray(mhu.broadcast_one_to_all(
                 arr, is_source=jax.process_index() == src))
             sp.inter_node_pingpong = [(int(b), float(t)) for b, t in arr]
+            _ckpt()
     elif not sp.inter_node_pingpong:
         # single-process: the staged D2H->host->H2D path stands in
         # (measuring same-host ICI would overestimate DCN badly)
         sp.inter_node_pingpong = _staged_pingpong_curve(
             jax.devices(), quick, kw)
+        _ckpt()
     if sp.inter_node_pingpong:
         log.debug(f"inter_node_pingpong: {len(sp.inter_node_pingpong)} points")
 
@@ -185,6 +202,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
                 _pack_grid(device, is_unpack, to_host, quick, kw,
                            prior=prior if prior and len(prior) == ni
                            else None))
+        _ckpt()
         log.debug(f"{name}: grid measured")
 
     msys.set_system(sp)

@@ -25,6 +25,7 @@ pack_device + device transport + unpack_device.
 
 from __future__ import annotations
 
+import glob
 import json
 import math
 import os
@@ -137,11 +138,23 @@ def cache_path() -> str:
 
 
 def save(sp: SystemPerformance) -> str:
-    """Export to TEMPI_CACHE_DIR/perf.json (measure_system.cpp:134-153)."""
+    """Export to TEMPI_CACHE_DIR/perf.json (measure_system.cpp:134-153).
+
+    Atomic (temp file + rename): the sweep checkpoints this file and may
+    be killed at any moment (wedged-tunnel timeouts) — a truncated sheet
+    would make the next attempt fall back to stale shipped curves
+    instead of resuming."""
     path = cache_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
+    for stale in glob.glob(f"{path}.tmp.*"):
+        try:  # temp files stranded by an earlier mid-save kill
+            os.remove(stale)
+        except OSError:
+            pass
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(sp.to_json(), f, indent=1)
+    os.replace(tmp, path)
     return path
 
 
