@@ -174,3 +174,12 @@ def test_pack_position_overflow_raises():
         api.pack(src, 1, ty, out)
     with pytest.raises(ValueError, match="overflow"):
         api.unpack(jnp.zeros(16, jnp.uint8), out, 1, ty, 8)
+
+
+def test_large_incount_batched_pack():
+    """ONE pack(buf, K) over K extent-spaced objects (the MPI_Pack incount
+    discipline bench.py's pack_gbs_*_incount fields measure) must match
+    the oracle at a K far beyond the fuzz sweep's 1-2: the DMA kernels
+    treat incount as an outer copy level, and a mis-scaled outer stride
+    would corrupt every object past the first."""
+    roundtrip(dt.subarray([4, 64], [4, 48], [0, 8], dt.BYTE), incount=64)
