@@ -26,6 +26,7 @@ import os
 import shutil
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # runnable as `python benches/run_tpu_session.py`
@@ -74,13 +75,48 @@ def measure() -> bool:
         "'unpack_host')})\n"
         "print('saved to', msys.save(sp))\n"
         "api.finalize()\n")
+    # 4 pack grids x 81 cells x ~20 s of tunneled compile each (~6500 s)
+    # plus the transfer/pingpong curves: a fresh full sweep can exceed any
+    # one attempt's budget while perfectly healthy. Per-cell checkpointing
+    # (sweep._pack_grid on_cell) makes the wedge/slow distinction
+    # observable: if the checkpoint advanced near the kill, the tunnel was
+    # alive and the attempt deserves a resume; a stale checkpoint means a
+    # genuine wedge, where retrying wastes the serialized session.
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.utils import env as envmod
+    envmod.read_environment()
+    ckpt = msys.cache_path()
+
+    def _ckpt_stamp():
+        try:
+            st = os.stat(ckpt)
+            return (st.st_mtime, st.st_size)
+        except OSError:
+            return None
+
     for attempt in range(3):
-        res = _run([sys.executable, "-c", code], 2400,
+        before = _ckpt_stamp()
+        res = _run([sys.executable, "-c", code], 7200,
                    f"measure (attempt {attempt + 1})")
         if res is True:
             return True
-        if res == "timeout":  # wedge: retrying against a dead tunnel
-            return False      # wastes the serialized session
+        if res == "timeout":
+            after = _ckpt_stamp()
+            if after is None or after == before:
+                return False  # no progress all attempt: a genuine wedge
+            # progress happened: the sweep is resumable. If the tunnel
+            # wedged AFTER that progress, the next attempt burns one
+            # bounded timeout and then stops here (no further advance) —
+            # cheaper than abandoning a nearly-complete sheet. No
+            # freshness window: curve sections and large grid cells can
+            # legitimately go >10 min between saves.
+            if attempt == 2:
+                print("measure: timed out with progress, but attempts "
+                      "exhausted", flush=True)
+            else:
+                print("measure: timed out but checkpoint advanced "
+                      f"{time.time() - after[0]:.0f}s ago — resuming",
+                      flush=True)
     return False
 
 

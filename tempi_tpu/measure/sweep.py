@@ -26,6 +26,30 @@ from .system import (GRID_BLOCKLEN, GRID_BYTES, GRID_STRIDE,
 # decisively worse than any real path yet finite (see _pack_grid)
 _UNMEASURABLE_S = 1e9
 
+# strided extents at or past 2**31 overflow int32 in the backend's HLO
+# proto path (observed on-chip 2026-07-31: the bytes=4MiB/blocklen=1 cell,
+# extent exactly 2**31, SIGABRTs the compile server in
+# LiteralBase::ToProto "Input too large"). Such cells are pre-skipped to
+# the sentinel without touching the device — the cell is genuinely
+# pathological (4M one-byte blocks at stride 512), so steering the model
+# away from it is the honest answer, and one grid point must not crash
+# the session's compile service.
+_EXTENT_CAP = 1 << 31
+
+
+def _grid_cell(i: int, j: int):
+    """(nbytes, blocklen, count, extent) of grid cell (i, j) — the single
+    source of truth for the cell's StridedBlock geometry; _extent_capped
+    and _pack_grid's block construction must agree or the cap predicate
+    drifts from the extent actually compiled."""
+    nbytes, bl = GRID_BYTES[i], GRID_BLOCKLEN[j]
+    count = max(1, nbytes // bl)
+    return nbytes, bl, count, count * GRID_STRIDE
+
+
+def _extent_capped(i: int, j: int) -> bool:
+    return _grid_cell(i, j)[3] >= _EXTENT_CAP
+
 
 def _bench_kwargs(quick: bool) -> dict:
     if quick:
@@ -182,8 +206,13 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     ni, _ = _grid_dims(quick)
     for name, is_unpack, to_host in grids:
         prior = getattr(sp, name)
-        dirty = prior and any(t >= _UNMEASURABLE_S for row in prior
-                              for t in row)
+        # extent-capped cells hold the sentinel PERMANENTLY (pre-skipped,
+        # never measured) — they must not count as dirty or every future
+        # sweep re-enters a complete grid forever
+        dirty = prior and any(
+            t >= _UNMEASURABLE_S and not
+            (len(prior) == ni and _extent_capped(i, j))
+            for i, row in enumerate(prior) for j, t in enumerate(row))
         if prior and (len(prior) > ni or (len(prior) == ni and not dirty)):
             # the incremental skip: same-size and clean, or LARGER than
             # this run would produce (a quick 3x3 re-sweep must not
@@ -198,10 +227,15 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         # Prior cells are reused only from a SAME-SIZE grid — a full
         # sweep healing a dirty quick grid re-measures everything rather
         # than freezing single-trial quick samples into the full sheet.
+        def _cell_ckpt(partial, _name=name):
+            setattr(sp, _name, partial)
+            _ckpt()
+
         setattr(sp, name,
                 _pack_grid(device, is_unpack, to_host, quick, kw,
                            prior=prior if prior and len(prior) == ni
-                           else None))
+                           else None,
+                           on_cell=_cell_ckpt if checkpoint else None))
         _ckpt()
         log.debug(f"{name}: grid measured")
 
@@ -323,11 +357,15 @@ def _grid_dims(quick: bool):
             else (len(GRID_BYTES), len(GRID_BLOCKLEN)))
 
 
-def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None):
+def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
+               on_cell=None):
     """9x9 grid of (bytes=2^(2i+6), blockLength=2^j), stride 512
     (measure_system.cu:254-373). ``prior`` (a previous same-size sweep's
     grid) re-measures only its unmeasurable-sentinel cells and keeps the
-    rest."""
+    rest. ``on_cell(grid)`` is invoked after every freshly measured cell
+    (remaining cells still hold the unmeasurable sentinel) so callers can
+    checkpoint mid-grid: at ~20 s of tunneled compile per cell a wedge
+    mid-section would otherwise lose the full 81-point sweep."""
     import jax
     import jax.numpy as jnp
 
@@ -335,16 +373,26 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None):
     from ..ops.strided_block import StridedBlock
 
     ni, nj = _grid_dims(quick)
-    grid = [[0.0] * nj for _ in range(ni)]
+    grid = [[_UNMEASURABLE_S] * nj for _ in range(ni)]
+    # copy ALL reusable prior cells up front, not lazily inside the loop:
+    # every on_cell checkpoint must be a superset of the prior sheet, or a
+    # wedge mid-heal would persist a grid missing good cells the loop had
+    # not reached yet (re-measuring them costs ~30 s of tunneled compile
+    # each on the next resume)
+    if prior is not None:
+        for i in range(min(ni, len(prior))):
+            for j in range(min(nj, len(prior[i]))):
+                if prior[i][j] and prior[i][j] < _UNMEASURABLE_S:
+                    grid[i][j] = prior[i][j]
     for i in range(ni):
         for j in range(nj):
-            if prior is not None and i < len(prior) and j < len(prior[i]) \
-                    and prior[i][j] and prior[i][j] < _UNMEASURABLE_S:
-                grid[i][j] = prior[i][j]
+            if grid[i][j] < _UNMEASURABLE_S:
+                continue  # kept from prior
+            if _extent_capped(i, j):
+                grid[i][j] = _UNMEASURABLE_S
                 continue
-            nbytes, bl = GRID_BYTES[i], GRID_BLOCKLEN[j]
-            count = max(1, nbytes // bl)
-            sb = StridedBlock(start=0, extent=count * GRID_STRIDE,
+            nbytes, bl, count, extent = _grid_cell(i, j)
+            sb = StridedBlock(start=0, extent=extent,
                               counts=[bl, count], strides=[1, GRID_STRIDE])
             packer = PackerND(sb)
             buf = jax.device_put(np.zeros(sb.extent, np.uint8), device)
@@ -369,4 +417,6 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None):
                 log.warn(f"pack grid point bytes={nbytes} bl={bl} "
                          f"unmeasurable: {e!r}")
                 grid[i][j] = _UNMEASURABLE_S
+            if on_cell is not None:
+                on_cell(grid)
     return grid

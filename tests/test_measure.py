@@ -169,6 +169,108 @@ def test_sentinel_grid_cells_remeasured(tmp_path, monkeypatch):
     assert out2.pack_host == big
 
 
+def test_extent_capped_cells_preskipped(tmp_path, monkeypatch):
+    """Cells whose strided extent reaches 2**31 (the bytes=4MiB/bl=1 cell:
+    int32 overflow SIGABRTs the backend compile server, observed on-chip
+    2026-07-31) are pre-skipped to the sentinel without touching the
+    device, and their PERMANENT sentinel does not mark a complete grid as
+    dirty — a full sheet must not re-enter measurement forever."""
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    # cap predicate pins to the StridedBlock geometry actually compiled
+    assert sweep._extent_capped(8, 0), "2**31-extent cell must be capped"
+    assert not sweep._extent_capped(8, 1), "2**30 cell must stay measurable"
+    assert not sweep._extent_capped(0, 0)
+    assert sweep._grid_cell(8, 0)[3] == 1 << 31
+    # a full-size grid whose ONLY sentinel is the capped cell is complete:
+    # measure_all must skip it (no _pack_grid call)
+    sp = sweep.measure_all(SystemPerformance(), quick=True)
+    ni, nj = sweep._grid_dims(False)
+    full = [[1e-6] * nj for _ in range(ni)]
+    full[8][0] = sweep._UNMEASURABLE_S
+    for name in ("pack_device", "unpack_device", "pack_host", "unpack_host"):
+        setattr(sp, name, [row[:] for row in full])
+    calls = []
+    monkeypatch.setattr(sweep, "_pack_grid",
+                        lambda *a, **k: calls.append(1) or full)
+    out = sweep.measure_all(sp, quick=False)
+    assert not calls, "capped-only-sentinel grid was re-entered"
+    assert out.pack_device[8][0] == sweep._UNMEASURABLE_S
+    # but a NON-capped sentinel still triggers healing
+    sp.pack_device[2][2] = sweep._UNMEASURABLE_S
+    sweep.measure_all(sp, quick=False)
+    assert calls, "non-capped sentinel did not re-enter the grid"
+
+
+def test_per_cell_checkpointing(tmp_path, monkeypatch):
+    """checkpoint=True persists after EVERY measured grid cell (not just
+    per section): at ~20 s of tunneled compile per cell, a wedge mid-grid
+    must cost one cell, not the 81-point section. Unvisited cells hold
+    the sentinel so the resume's healing pass re-measures exactly them."""
+    import json
+
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    counts = []
+    real_save = msys.save
+
+    def counting_save(sp):
+        p = real_save(sp)
+        with open(p) as f:
+            grid = json.load(f).get("pack_device") or []
+        counts.append(sum(1 for row in grid for t in row
+                          if t < sweep._UNMEASURABLE_S))
+        return p
+
+    monkeypatch.setattr(msys, "save", counting_save)
+    sweep.measure_all(SystemPerformance(), quick=True, checkpoint=True)
+    grid_counts = [c for c in counts if c]
+    # quick grid = 9 cells: measured-cell count must grow 1..9 cell by cell
+    assert grid_counts[:9] == list(range(1, 10)), grid_counts[:12]
+
+
+def test_heal_checkpoints_keep_prior_cells(tmp_path, monkeypatch):
+    """Every mid-heal checkpoint is a SUPERSET of the prior sheet: prior
+    cells are copied up front, so a wedge while re-measuring sentinel
+    cell N cannot persist a grid that dropped good cells after N."""
+    import json
+
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = sweep.measure_all(SystemPerformance(), quick=True)
+    # poison an EARLY and a LATE cell; mark the rest with recognizable times
+    for i in range(3):
+        for j in range(3):
+            sp.pack_device[i][j] = 100.0 + 10 * i + j
+    sp.pack_device[0][1] = sweep._UNMEASURABLE_S
+    sp.pack_device[2][2] = sweep._UNMEASURABLE_S
+    first_grid_save = {}
+    real_save = msys.save
+
+    def capturing_save(s):
+        p = real_save(s)
+        if not first_grid_save:
+            with open(p) as f:
+                first_grid_save["grid"] = json.load(f)["pack_device"]
+        return p
+
+    monkeypatch.setattr(msys, "save", capturing_save)
+    sweep.measure_all(sp, quick=True, checkpoint=True)
+    g = first_grid_save["grid"]
+    # the first checkpoint happens right after healing cell (0,1): every
+    # prior-good cell — including ones AFTER the healed cell — must be there
+    assert g[0][1] < sweep._UNMEASURABLE_S, "healed cell missing"
+    for i in range(3):
+        for j in range(3):
+            if (i, j) in ((0, 1), (2, 2)):
+                continue
+            assert g[i][j] == 100.0 + 10 * i + j, \
+                f"prior cell ({i},{j}) dropped from mid-heal checkpoint"
+
+
 def test_measure_checkpoint_persists_sections(tmp_path, monkeypatch):
     """checkpoint=True saves the sheet after every completed section, so a
     crash mid-sweep resumes instead of restarting (wedge-prone tunnels)."""
