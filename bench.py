@@ -133,27 +133,38 @@ def bench_pack(jax, devices, quick: bool = False, nblocks: int = 8192,
     # call, slower than the ~7 us kernel; (b) batch K independent packs per
     # dispatch — per-dispatch gaps otherwise add ~6 us/op; (c) 2 ms samples
     # so the ~100 us flush round trip amortizes below 1%.
+    from tempi_tpu.measure.benchmark import chained_pack_fn
+
     K = batch_k
+    # token-chained drain (see chained_pack_fn): blocking on the final
+    # token forces every enqueued pack to completion even if the remote
+    # runtime overlaps independent programs — blocking on only the last
+    # batch's output measured roofline-impossible bandwidths here
     if incount:
-        big = jax.device_put(
+        bufs = jax.device_put(
             jnp.asarray(np.random.default_rng(0).integers(
                 0, 256, ty.extent * K, np.uint8)), devices[0])
-        mega, bufs = jax.jit(lambda b: packer.pack(b, K)), big
     else:
         bufs = [jax.device_put(
             jnp.asarray(np.random.default_rng(i).integers(0, 256, ty.extent,
                                                           np.uint8)),
             devices[0]) for i in range(K)]
-        mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
-    jax.block_until_ready(mega(bufs))  # compile
-    last = []
+    mega = chained_pack_fn(packer, K, incount)
+    tok0 = jax.device_put(jnp.zeros((), jnp.uint32), devices[0])
+    jax.block_until_ready(mega(bufs, tok0))  # compile
+    state = {"tok": tok0}
 
     def enqueue():
-        last[:] = [mega(bufs)]
+        # outs are discarded at the Python level but remain PROGRAM
+        # outputs, so the pack work cannot be dead-code-eliminated
+        _, state["tok"] = mega(bufs, state["tok"])
+
+    def flush():
+        state["tok"].block_until_ready()
 
     gbs = []
     for _ in range(_trials(quick)):
-        r = benchmark(enqueue, flush=lambda: jax.block_until_ready(last[0]),
+        r = benchmark(enqueue, flush=flush,
                       min_sample_secs=PACK_SAMPLE_MS * 1e-3,
                       max_trial_secs=3.0)
         gbs.append(ty.size * K / r.trimean / 1e9)

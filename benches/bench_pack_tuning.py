@@ -71,38 +71,40 @@ def _child() -> int:
     rec = type_cache.get_or_commit(ty)
     packer = rec.best_packer()
     dev = jax.devices()[0]
+    from tempi_tpu.measure.benchmark import chained_pack_fn
+
+    # token-chained drain, shared with bench.py's bench_pack (see
+    # chained_pack_fn): blocking on the final token drains every rep even
+    # if the remote runtime overlaps independent programs
     if mode == "incount":
         if quick:
             # hermetic smoke mode: cap the batched buffer at 64 MiB so a
             # small CI host neither OOMs nor blows the child timeout
             k = min(k, max(1, (64 << 20) // ty.extent))
-        big = jax.device_put(jnp.asarray(np.random.default_rng(0).integers(
+        bufs = jax.device_put(jnp.asarray(np.random.default_rng(0).integers(
             0, 256, ty.extent * k, np.uint8)), dev)
-        mega = jax.jit(lambda b: packer.pack(b, k))
-        args = (big,)
     else:
         bufs = [jax.device_put(
             jnp.asarray(np.random.default_rng(i).integers(
                 0, 256, ty.extent, np.uint8)), dev) for i in range(k)]
-        mega = jax.jit(lambda bs: [packer.pack(b, 1) for b in bs])
-        args = (bufs,)
-    jax.block_until_ready(mega(*args))  # compile
+    mega = chained_pack_fn(packer, k, mode == "incount")
+    tok = jax.device_put(jnp.zeros((), jnp.uint32), dev)
+    jax.block_until_ready(mega(bufs, tok))  # compile
     # fixed schedule: reps CALIBRATED so each timed sample spans ~2 ms
     # (amortizing the ~100 us tunneled dispatch/flush round trip below
     # 5%) — a per-call guess would be off by orders of magnitude between
     # the unroll and single-kernel incount disciplines
     t0 = time.perf_counter()
-    jax.block_until_ready(mega(*args))
+    jax.block_until_ready(mega(bufs, tok))
     once = max(time.perf_counter() - t0, 1e-7)
     reps = max(1, int(2e-3 / once))
     samples = 10 if quick else 30
     times = []
     for _ in range(samples):
         t0 = time.perf_counter()
-        last = None
         for _ in range(reps):
-            last = mega(*args)
-        jax.block_until_ready(last)
+            _, tok = mega(bufs, tok)
+        tok.block_until_ready()
         times.append((time.perf_counter() - t0) / reps)
     times.sort()
     med = times[len(times) // 2]

@@ -29,6 +29,40 @@ class Result:
     stats: Statistics
 
 
+def chained_pack_fn(packer, k: int, incount: bool):
+    """Jitted ``(bufs, tok) -> (outs, tok')`` pack dispatch whose uint32
+    token data-depends on every pack output AND the incoming token.
+
+    Blocking on the final token of a chain of calls therefore drains every
+    enqueued pack, even if the runtime overlaps or reorders independent
+    programs — blocking on only the last call's output assumes strict
+    in-order execution, which produced roofline-impossible pack readings
+    on the tunneled TPU (589/402/1075 GB/s across three sessions of one
+    819 GB/s-HBM chip). The pack outputs stay program OUTPUTS on purpose:
+    were the token the only live result, XLA could slice-sink the
+    multi-MiB pack down to computing one element (the XLA-lowered packer
+    paths are transparent to DCE). Cost when execution is in order: one
+    element gather + adds per dispatch.
+
+    ``incount`` selects MPI_Pack's one-call ``pack(buf, k)`` discipline;
+    otherwise k independent ``pack(buf_i, 1)`` calls are unrolled."""
+    import jax
+    import jax.numpy as jnp
+
+    if incount:
+        def _mega(b, tok):
+            out = packer.pack(b, k)
+            return out, tok + out[0].astype(jnp.uint32)
+    else:
+        def _mega(bs, tok):
+            outs = [packer.pack(b, 1) for b in bs]
+            dep = outs[0][0]
+            for o in outs[1:]:
+                dep = dep + o[0]
+            return outs, tok + dep.astype(jnp.uint32)
+    return jax.jit(_mega)
+
+
 def benchmark(fn: Callable[[], None],
               min_sample_secs: float = 200e-6,
               max_trial_secs: float = 1.0,
