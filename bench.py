@@ -810,9 +810,12 @@ def _device_bench(inactivity_s: float = None,
             return default  # malformed knob must not cost the capture
 
     if inactivity_s is None:
-        inactivity_s = _env_s("TEMPI_BENCH_INACTIVITY_S", 300.0)
+        # a cold-cache capture spends many minutes in back-to-back
+        # tunneled compiles with no output between metrics: 300 s killed
+        # a healthy child after its first metric (2026-07-31 03:53)
+        inactivity_s = _env_s("TEMPI_BENCH_INACTIVITY_S", 600.0)
     if overall_s is None:
-        overall_s = _env_s("TEMPI_BENCH_OVERALL_S", 1200.0)
+        overall_s = _env_s("TEMPI_BENCH_OVERALL_S", 1500.0)
 
     merged: dict = {}
 

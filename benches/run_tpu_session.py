@@ -56,8 +56,14 @@ def probe() -> bool:
 
 
 def bench(label="bench") -> bool:
+    # generous watchdog windows: a cold-cache capture spends minutes in
+    # back-to-back tunneled compiles with no output — the default 300 s
+    # inactivity window killed a healthy device child mid-capture
+    # (2026-07-31 03:53, two metrics kept of a full line)
     env = dict(os.environ, TEMPI_BENCH_FORCE="tpu")
-    return _run([sys.executable, "bench.py"], 1800, label, env=env)
+    env.setdefault("TEMPI_BENCH_INACTIVITY_S", "900")
+    env.setdefault("TEMPI_BENCH_OVERALL_S", "2700")
+    return _run([sys.executable, "bench.py"], 3600, label, env=env)
 
 
 def measure() -> bool:
