@@ -169,6 +169,22 @@ def test_sentinel_grid_cells_remeasured(tmp_path, monkeypatch):
     assert out2.pack_host == big
 
 
+def test_d2h_measures_real_transfers(tmp_path, monkeypatch):
+    """The d2h curve must read a FRESH device array per call: jax caches
+    an Array's host copy after its first D2H, so np.asarray(buf) in a
+    loop times a ~5 us attribute lookup (observed on-chip: a flat 2 us
+    "d2h" at every size on a tunnel whose h2d takes 66 ms/MiB). A real
+    1 MiB transfer cannot be attribute-lookup fast even on host memory."""
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = sweep.measure_all(SystemPerformance(), quick=True)
+    biggest = max(sp.d2h)  # (nbytes, seconds); quick mode tops at 1 MiB
+    assert biggest[0] >= 1 << 20
+    assert biggest[1] > 10e-6, \
+        f"d2h at {biggest[0]}B took {biggest[1]*1e6:.1f}us: cached read?"
+
+
 def test_extent_capped_cells_preskipped(tmp_path, monkeypatch):
     """Cells whose strided extent reaches 2**31 (the bytes=4MiB/bl=1 cell:
     int32 overflow SIGABRTs the backend compile server, observed on-chip
