@@ -54,6 +54,54 @@ def _fresh(buf):
 
 _INC = None
 
+# once a host-read probe hangs in this process, every later to_host grid
+# cell is sentineled instead of attempted: the hang is a backend/tunnel
+# property, not a per-shape one, and a second hung call would freeze the
+# sweep for good (observed on-chip 2026-07-31: two consecutive measure
+# attempts blocked forever in futex_wait on the FIRST pack_host cell's
+# device-to-host read while every pure-device section measured fine)
+_HOST_READ_BROKEN = [False]
+
+
+def _call_with_timeout(fn, timeout_s: float):
+    """Run ``fn()`` on a daemon thread; "timeout" if it does not finish in
+    ``timeout_s`` (the thread is abandoned — it is blocked in C where no
+    Python timeout can reach), the exception if it raised, else True."""
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            err.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    if not done.wait(timeout_s):
+        return "timeout"
+    return err[0] if err else True
+
+
+def _probe_host_reads(fn, what: str, timeout_s: float = 120.0):
+    """One guarded ``fn()`` before a section that times device-to-host
+    reads. A hung D2H blocks in C forever (no Python timeout can reach
+    it); for the curve sections there is nothing to measure around the
+    hang, so fail LOUDLY instead of freezing the sweep. Callers must warm
+    any compiles first — the timeout must cover only the read."""
+    res = _call_with_timeout(fn, timeout_s)
+    if res == "timeout":
+        _HOST_READ_BROKEN[0] = True
+        raise RuntimeError(
+            f"device-to-host read hung >120s probing {what}: host reads "
+            "are broken on this backend/tunnel; curves that time them "
+            "cannot be measured")
+    if isinstance(res, Exception):
+        raise res
+
 
 def _grid_cell(i: int, j: int):
     """(nbytes, blocklen, count, extent) of grid cell (i, j) — the single
@@ -134,10 +182,14 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     if not sp.d2h:
         # read a fresh array per call (see _fresh): a repeated
         # np.asarray(buf) times jax's cached host copy, not the transfer
+        probed = False
         for nb in _transfer_sizes(quick):
             scratch = dev_alloc.allocate(nb)
             buf = jax.device_put(scratch, device)
-            buf.block_until_ready()
+            _fresh(buf).block_until_ready()  # warm compile device-side
+            if not probed:
+                _probe_host_reads(lambda: np.asarray(_fresh(buf)), "d2h")
+                probed = True
             r = benchmark(lambda: np.asarray(_fresh(buf)), **kw)
             sp.d2h.append((nb, r.trimean))
             dev_alloc.release(scratch)
@@ -358,9 +410,14 @@ def _staged_pingpong_curve(devs, quick, kw):
     # copy after the first call — the first leg's D2H would otherwise
     # cost nothing from the second call on (y is fresh per hop already)
     curve = []
+    probed = False
     for nb in _transfer_sizes(quick):
         x = jax.device_put(np.zeros(nb, np.uint8), a)
-        x.block_until_ready()
+        _fresh(x).block_until_ready()  # warm compile device-side
+        if not probed:
+            _probe_host_reads(lambda: np.asarray(_fresh(x)),
+                              "staged pingpong")
+            probed = True
 
         def hop():
             y = jax.device_put(np.asarray(_fresh(x)), b)  # D2H+H2D to peer
@@ -407,12 +464,21 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
             for j in range(min(nj, len(prior[i]))):
                 if prior[i][j] and prior[i][j] < _UNMEASURABLE_S:
                     grid[i][j] = prior[i][j]
+    # only the pack-to-host grid's fn performs a device-to-host read;
+    # unpack_host's fn is pure device work (is_unpack wins the branch)
+    reads_host = to_host and not is_unpack
     for i in range(ni):
         for j in range(nj):
             if grid[i][j] < _UNMEASURABLE_S:
                 continue  # kept from prior
             if _extent_capped(i, j):
                 grid[i][j] = _UNMEASURABLE_S
+                continue
+            if reads_host and _HOST_READ_BROKEN[0]:
+                # skip BEFORE building buffers: cells approach 1 GiB of
+                # H2D setup each — pointless when the cell is known
+                # unmeasurable. grid already holds the sentinel; the
+                # section save records it, so no per-cell checkpoint.
                 continue
             nbytes, bl, count, extent = _grid_cell(i, j)
             sb = StridedBlock(start=0, extent=extent,
@@ -423,10 +489,33 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
             if is_unpack:
                 fn = lambda: packer.unpack(buf, packed, 1).block_until_ready()
             elif to_host:
-                fn = lambda: np.asarray(packer.pack(buf, 1))
+                # _fresh routes the host read through a standard XLA add
+                # output (and defeats the cached-host-copy pitfall for
+                # any packer path that may return an aliased buffer)
+                fn = lambda: np.asarray(_fresh(packer.pack(buf, 1)))
             else:
                 fn = lambda: packer.pack(buf, 1).block_until_ready()
             try:
+                if reads_host:
+                    # warm the pack+add compiles DEVICE-side first so the
+                    # probe's timeout covers only the host read — a slow
+                    # cold-cache tunneled compile must not be
+                    # misclassified as a hung read
+                    _fresh(packer.pack(buf, 1)).block_until_ready()
+                    # probe ONE call under a timeout before handing the
+                    # cell to the benchmark loop: a hung device-to-host
+                    # read blocks in C forever and would freeze the sweep
+                    probe = _call_with_timeout(fn, 120.0)
+                    if probe == "timeout":
+                        log.warn("host-read probe hung >120s; sentineling "
+                                 "this and all remaining host-grid cells")
+                        _HOST_READ_BROKEN[0] = True
+                        grid[i][j] = _UNMEASURABLE_S
+                        if on_cell is not None:
+                            on_cell(grid)
+                        continue
+                    if isinstance(probe, Exception):
+                        raise probe
                 r = benchmark(fn, **kw)
                 grid[i][j] = r.trimean
             except Exception as e:
