@@ -155,6 +155,17 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         log.warn(f"discarding {sp.platform!r} curves; measuring {plat!r}")
         sp = SystemPerformance()
     sp.platform = plat
+    if sp.schema < msys.GRID_SCHEMA:
+        # sections whose MEANING changed since this sheet was measured
+        # must re-measure — the skip logic would otherwise keep them as
+        # "clean" priors forever (schema 2: unpack_host gained the H2D
+        # leg of the host-landed payload)
+        if sp.schema < 2 and sp.unpack_host:
+            log.warn("re-measuring unpack_host: sheet predates the "
+                     "H2D-inclusive semantics (schema "
+                     f"{sp.schema} < 2)")
+            sp.unpack_host = []
+        sp.schema = msys.GRID_SCHEMA
     if device is None:
         device = jax.devices()[0]
     kw = _bench_kwargs(quick)
@@ -464,8 +475,10 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
             for j in range(min(nj, len(prior[i]))):
                 if prior[i][j] and prior[i][j] < _UNMEASURABLE_S:
                     grid[i][j] = prior[i][j]
-    # only the pack-to-host grid's fn performs a device-to-host read;
-    # unpack_host's fn is pure device work (is_unpack wins the branch)
+    # only the pack-to-host grid's fn performs a DEVICE-TO-HOST read (the
+    # direction observed to hang); unpack_host's fn moves host memory too,
+    # but in the host-to-device direction, which measures fine even when
+    # D2H reads are broken
     reads_host = to_host and not is_unpack
     for i in range(ni):
         for j in range(nj):
@@ -485,8 +498,19 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
                               counts=[bl, count], strides=[1, GRID_STRIDE])
             packer = PackerND(sb)
             buf = jax.device_put(np.zeros(sb.extent, np.uint8), device)
-            packed = jax.device_put(np.zeros(bl * count, np.uint8), device)
-            if is_unpack:
+            if is_unpack and to_host:
+                # unpack_host prices the ONESHOT receive side: the packed
+                # payload LANDED IN HOST MEMORY and must ride H2D before
+                # the device unpack (model_oneshot sums pack_host +
+                # host transport + unpack_host, system.py:257-262) — a
+                # pure device unpack here would omit the H2D leg
+                packed_np = np.zeros(bl * count, np.uint8)
+                fn = lambda: packer.unpack(
+                    buf, jax.device_put(packed_np, device), 1
+                ).block_until_ready()
+            elif is_unpack:
+                packed = jax.device_put(np.zeros(bl * count, np.uint8),
+                                        device)
                 fn = lambda: packer.unpack(buf, packed, 1).block_until_ready()
             elif to_host:
                 # _fresh routes the host read through a standard XLA add

@@ -67,9 +67,17 @@ def current_platform() -> str:
     return f"{backend}/{kind}/n{count}"
 
 
+# bump when a section's MEANING changes so sheets measured under the old
+# semantics re-measure instead of being kept as "clean" priors. History:
+# 2 = unpack_host includes the H2D leg of the host-landed payload (older
+#     sheets measured a pure device unpack, underpricing model_oneshot)
+GRID_SCHEMA = 2
+
+
 @dataclass
 class SystemPerformance:
     platform: str = ""
+    schema: int = GRID_SCHEMA
     device_launch: float = 0.0
     d2h: List[Tuple[int, float]] = field(default_factory=list)
     h2d: List[Tuple[int, float]] = field(default_factory=list)
@@ -84,6 +92,7 @@ class SystemPerformance:
     def to_json(self) -> dict:
         return {
             "platform": self.platform,
+            "schema": self.schema,
             "device_launch": self.device_launch,
             **{k: [[int(b), t] for b, t in getattr(self, k)]
                for k in ("d2h", "h2d", "intra_node_pingpong",
@@ -100,6 +109,7 @@ class SystemPerformance:
     def from_json(d: dict) -> "SystemPerformance":
         sp = SystemPerformance()
         sp.platform = str(d.get("platform", ""))
+        sp.schema = int(d.get("schema", 1))  # pre-versioning sheets = 1
         sp.device_launch = float(d.get("device_launch", 0.0))
         for k in ("d2h", "h2d", "intra_node_pingpong", "inter_node_pingpong",
                   "host_pingpong"):

@@ -169,6 +169,33 @@ def test_sentinel_grid_cells_remeasured(tmp_path, monkeypatch):
     assert out2.pack_host == big
 
 
+def test_schema_migration_remeasures_unpack_host(tmp_path, monkeypatch):
+    """Sheets measured before unpack_host included the H2D leg (schema 1)
+    must re-measure that grid — the skip logic would otherwise keep the
+    underpriced cells as clean priors forever."""
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = sweep.measure_all(SystemPerformance(), quick=True)
+    assert sp.schema == msys.GRID_SCHEMA
+    # round-trip keeps the schema; a legacy sheet (no field) reads as 1
+    rt = SystemPerformance.from_json(sp.to_json())
+    assert rt.schema == msys.GRID_SCHEMA
+    legacy = sp.to_json()
+    del legacy["schema"]
+    old = SystemPerformance.from_json(legacy)
+    assert old.schema == 1
+    old.unpack_host = [[123.0] * 3 for _ in range(3)]  # stale, "clean"
+    out = sweep.measure_all(old, quick=True)
+    assert out.schema == msys.GRID_SCHEMA
+    assert all(t != 123.0 for r in out.unpack_host for t in r), \
+        "stale pre-schema-2 unpack_host cells were kept"
+    # same-schema sheets keep their clean grids untouched
+    out.unpack_host = [[7e-6] * 3 for _ in range(3)]
+    out2 = sweep.measure_all(out, quick=True)
+    assert out2.unpack_host == [[7e-6] * 3 for _ in range(3)]
+
+
 def test_d2h_measures_real_transfers(tmp_path, monkeypatch):
     """The d2h curve must read a FRESH device array per call: jax caches
     an Array's host copy after its first D2H, so np.asarray(buf) in a
