@@ -495,24 +495,6 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
         print(f"pack failed: {e!r}", file=sys.stderr)
         emit({"pack_gbs": None, "pack_gbs_4m": None})
     try:
-        pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
-        emit({"pingpong_nd_p50_us": round(pp_p50 * 1e6, 2),
-              "pingpong_nd_mode": pp_mode,
-              "pingpong_nd_persistent_p50_us": (
-                  round(pp_pers * 1e6, 2) if pp_pers is not None else None),
-              "pingpong_nd_staged_p50_us": (
-                  round(pp_strat["staged"] * 1e6, 2)
-                  if pp_strat.get("staged") is not None else None),
-              "pingpong_nd_oneshot_p50_us": (
-                  round(pp_strat["oneshot"] * 1e6, 2)
-                  if pp_strat.get("oneshot") is not None else None)})
-    except Exception as e:
-        print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
-        emit({"pingpong_nd_p50_us": None, "pingpong_nd_mode": "failed",
-              "pingpong_nd_persistent_p50_us": None,
-              "pingpong_nd_staged_p50_us": None,
-              "pingpong_nd_oneshot_p50_us": None})
-    try:
         halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
         emit({"halo_iters_per_s": round(halo_ips, 2),
               "halo_config": halo_cfg})
@@ -527,17 +509,8 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
     except Exception as e:
         print(f"halo engine A/B failed: {e!r}", file=sys.stderr)
         emit({"halo_engine_iters_per_s": None})
-    for label, reorder in (("alltoallv_sparse_s", False),
-                           ("alltoallv_sparse_remap_s", True)):
-        try:
-            emit({label: round(
-                bench_alltoallv_sparse(jax, quick, reorder), 6)})
-        except Exception as e:  # single chip: configs 4/5 are multi-rank
-            print(f"{label} skipped: {e!r}", file=sys.stderr)
-            emit({label: None})
     # the reference's other two judged pack targets
-    # (bin/bench_mpi_pack.cpp:127): 1 MiB and 1 KiB objects. Run LAST so a
-    # stall here cannot cost the long-established metrics above. Small
+    # (bin/bench_mpi_pack.cpp:127): 1 MiB and 1 KiB objects. Small
     # objects are dispatch-bound, so more packs ride one dispatch — the
     # per-target batch size is emitted beside the number because bandwidth
     # is only comparable within the same batching discipline (the 1 KiB
@@ -580,6 +553,37 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
     except Exception as e:
         print(f"pinned-host probe failed: {e!r}", file=sys.stderr)
         emit({"pinned_host_landed": None})
+    for label, reorder in (("alltoallv_sparse_s", False),
+                           ("alltoallv_sparse_remap_s", True)):
+        try:
+            emit({label: round(
+                bench_alltoallv_sparse(jax, quick, reorder), 6)})
+        except Exception as e:  # single chip: configs 4/5 are multi-rank
+            print(f"{label} skipped: {e!r}", file=sys.stderr)
+            emit({label: None})
+    # the pingpong block runs LAST: its staged and oneshot strategies
+    # read pack outputs back to the host every round (the staged-self
+    # discipline), the one operation class observed to hang a wedgy
+    # tunnel's D2H path (BENCH_NOTES_r04) — a hang here costs only these
+    # fields, not the pack/halo/alltoallv/model evidence above
+    try:
+        pp_p50, pp_mode, pp_pers, pp_strat = bench_pingpong_nd(jax, quick)
+        emit({"pingpong_nd_p50_us": round(pp_p50 * 1e6, 2),
+              "pingpong_nd_mode": pp_mode,
+              "pingpong_nd_persistent_p50_us": (
+                  round(pp_pers * 1e6, 2) if pp_pers is not None else None),
+              "pingpong_nd_staged_p50_us": (
+                  round(pp_strat["staged"] * 1e6, 2)
+                  if pp_strat.get("staged") is not None else None),
+              "pingpong_nd_oneshot_p50_us": (
+                  round(pp_strat["oneshot"] * 1e6, 2)
+                  if pp_strat.get("oneshot") is not None else None)})
+    except Exception as e:
+        print(f"pingpong-nd failed: {e!r}", file=sys.stderr)
+        emit({"pingpong_nd_p50_us": None, "pingpong_nd_mode": "failed",
+              "pingpong_nd_persistent_p50_us": None,
+              "pingpong_nd_staged_p50_us": None,
+              "pingpong_nd_oneshot_p50_us": None})
 
 
 def _pinned_host_probe(jax, device) -> bool:
