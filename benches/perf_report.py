@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Summarize a measured system-performance sheet (perf.json / PERF_TPU.json).
+
+Prints the transfer/pingpong curves at decade sizes, the four pack-grid
+corners, and the composed per-strategy models for the judged message
+shapes — the quickest way to see what AUTO will decide from a sheet and
+why. Reference analog: the measured-curve dumps of bin/measure-system
+(/root/reference/src/internal/measure_system.cu:377-606).
+
+Usage: python benches/perf_report.py [path-to-sheet.json]
+       (default: the active TEMPI_CACHE_DIR/perf.json)
+"""
+
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _fmt_t(t: float) -> str:
+    if t >= 1e9:
+        return "SENTINEL"
+    if t >= 1.0:
+        return f"{t:.2f}s"
+    if t >= 1e-3:
+        return f"{t * 1e3:.2f}ms"
+    return f"{t * 1e6:.1f}us"
+
+
+def main() -> int:
+    from tempi_tpu.measure import system as msys
+
+    # purely a FILE reader: this tool must never call jax (current_platform
+    # or load_cached would dial the tunneled accelerator just to print a
+    # report, and a wedged tunnel would hang it). Default resolution
+    # mirrors load_cached's search order minus its platform check — the
+    # runtime re-applies that check itself at init.
+    if len(sys.argv) > 1:
+        path = sys.argv[1]
+    else:
+        from tempi_tpu.utils import env as envmod
+        envmod.read_environment()
+        path = msys.cache_path()
+        if not os.path.exists(path):
+            path = os.path.join(REPO, "PERF_TPU.json")
+        if not os.path.exists(path):
+            print(f"no sheet: neither {msys.cache_path()} nor shipped "
+                  "PERF_TPU.json exists")
+            return 1
+    with open(path) as f:
+        sp = msys.SystemPerformance.from_json(json.load(f))
+    print(f"sheet: {path}")
+    print(f"platform: {sp.platform!r}  schema: {sp.schema}  "
+          f"device_launch: {_fmt_t(sp.device_launch)}")
+    print("(the runtime accepts this sheet only if its platform stamp "
+          "matches the running system)")
+
+    for name in ("d2h", "h2d", "host_pingpong", "intra_node_pingpong",
+                 "inter_node_pingpong"):
+        curve = getattr(sp, name)
+        if not curve:
+            print(f"{name}: EMPTY")
+            continue
+        picks = []
+        for nb in (1, 1024, 1 << 20, 1 << 23):
+            # interp_time is what the models read — report the same view
+            t = msys.interp_time(curve, nb)
+            if t == math.inf:
+                continue
+            bw = nb / t / 1e9
+            picks.append(f"{nb}B={_fmt_t(t)}"
+                         + (f" ({bw:.2f}GB/s)" if nb >= 1024 else ""))
+        print(f"{name}: " + "  ".join(picks))
+
+    for name in ("pack_device", "unpack_device", "pack_host", "unpack_host"):
+        g = getattr(sp, name)
+        if not g:
+            print(f"{name}: EMPTY")
+            continue
+        ni, nj = len(g), len(g[0])
+        sent = sum(1 for r in g for t in r if t >= 1e9)
+        corners = {(0, 0): g[0][0], (0, nj - 1): g[0][nj - 1],
+                   (ni - 1, 0): g[ni - 1][0],
+                   (ni - 1, nj - 1): g[ni - 1][nj - 1]}
+        cs = "  ".join(f"[{i},{j}]={_fmt_t(t)}"
+                       for (i, j), t in corners.items())
+        print(f"{name}: {ni}x{nj}, {sent} sentinel  {cs}")
+
+    msys.set_system(sp)
+    print("\ncomposed models (judged shapes; colocated):")
+    print(f"{'shape':>22} {'device':>10} {'oneshot':>10} "
+          f"{'staged1d':>10} {'direct1d':>10}")
+    for label, nbytes, bl in (("1 KiB (2x512B)", 1024, 512),
+                              ("1 MiB (4Kx256B)", 1 << 20, 256),
+                              ("4 MiB (8Kx512B)", 4 << 20, 512)):
+        dev = msys.model_device(nbytes, bl, True)
+        one = msys.model_oneshot(nbytes, bl, True)
+        st = msys.model_staged_1d(nbytes)
+        di = msys.model_direct_1d(nbytes, True)
+        row = [(_fmt_t(v) if v < math.inf else "inf")
+               for v in (dev, one, st, di)]
+        best = min((dev, "device"), (one, "oneshot"))[1]
+        print(f"{label:>22} {row[0]:>10} {row[1]:>10} "
+              f"{row[2]:>10} {row[3]:>10}   -> {best}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
