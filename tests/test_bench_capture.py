@@ -129,3 +129,29 @@ def test_two_proc_pingpong_real(bench_mod):
     assert out.get("pingpong_nd_2proc_p50_us") is not None, out
     assert out["pingpong_nd_2proc_p50_us"] > 0
     assert out["pingpong_nd_2proc_mode"] == "gloo-2proc-1dev-each"
+
+
+def test_hang_exposed_metrics_run_last(bench_mod, monkeypatch):
+    """The staged/oneshot pingpong strategies read pack outputs back to
+    the host every round — the operation class observed to hang a wedged
+    tunnel's D2H path. They must run after every other tunnel-bound
+    metric so a hang there costs only the pingpong fields."""
+    order = []
+    m = bench_mod
+    monkeypatch.setattr(m, "bench_pack", lambda *a, **k: 1.0)
+    monkeypatch.setattr(m, "bench_pingpong_nd",
+                        lambda *a, **k: (1e-6, "self", None, {}))
+    monkeypatch.setattr(m, "bench_halo", lambda *a, **k: (1.0, "cfg"))
+    monkeypatch.setattr(m, "bench_alltoallv_sparse", lambda *a, **k: 0.1)
+    monkeypatch.setattr(m, "_model_evidence",
+                        lambda: {"auto_choice_nd_1m": "device"})
+    monkeypatch.setattr(m, "_pinned_host_probe", lambda jax, dev: True)
+    m._collect_device_metrics(None, [None], True, lambda d:
+                              order.extend(d.keys()))
+    pp = order.index("pingpong_nd_p50_us")
+    for earlier in ("pack_gbs_4m", "halo_iters_per_s",
+                    "halo_engine_iters_per_s", "pack_gbs_1k",
+                    "pack_gbs_1m_incount", "auto_choice_nd_1m",
+                    "pinned_host_landed", "alltoallv_sparse_s"):
+        assert order.index(earlier) < pp, \
+            f"{earlier} must run before the hang-exposed pingpong block"
