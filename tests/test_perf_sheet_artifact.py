@@ -1,0 +1,71 @@
+"""Sanity checks on the committed PERF_TPU.json artifact.
+
+The shipped sheet is what `system.load_cached` falls back to on a box
+whose platform stamp matches; a malformed or nonsensical sheet would
+silently steer every AUTO decision. These checks pin the invariants any
+honest measured sheet must satisfy without assuming anything about the
+machine that measured it."""
+
+import json
+import os
+
+import pytest
+
+from tempi_tpu.measure.system import (GRID_BLOCKLEN, GRID_BYTES,
+                                      SystemPerformance)
+
+_SHEET = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "PERF_TPU.json")
+
+
+@pytest.fixture()
+def sheet():
+    if not os.path.exists(_SHEET):
+        pytest.skip("no committed PERF_TPU.json")
+    with open(_SHEET) as f:
+        return SystemPerformance.from_json(json.load(f))
+
+
+def test_platform_stamp_is_tpu_with_device_count(sheet):
+    assert sheet.platform.startswith("tpu"), sheet.platform
+    assert "/n" in sheet.platform, \
+        "stamp must encode device count (ADVICE r3: backend/kind/nN)"
+
+
+def test_curves_positive_and_sized(sheet):
+    for name in ("d2h", "h2d", "host_pingpong", "intra_node_pingpong",
+                 "inter_node_pingpong"):
+        curve = getattr(sheet, name)
+        assert curve, f"{name} empty in shipped sheet"
+        assert all(b > 0 and t > 0 for b, t in curve), name
+        # sizes strictly increasing (the interpolator assumes it)
+        sizes = [b for b, _ in curve]
+        assert sizes == sorted(set(sizes)), name
+
+
+def test_d2h_not_cached_artifact(sheet):
+    """The cached-host-copy bug read a flat ~2-5 us at EVERY size; any
+    real transfer of 8 MiB takes longer than 100 us on any link."""
+    big = dict(sheet.d2h).get(1 << 23)
+    if big is None:
+        pytest.skip("sheet lacks the 8 MiB point")
+    assert big > 100e-6, f"8 MiB d2h in {big*1e6:.1f}us: cached read?"
+
+
+def test_grids_full_size_and_positive(sheet):
+    ni, nj = len(GRID_BYTES), len(GRID_BLOCKLEN)
+    nonempty = 0
+    for name in ("pack_device", "unpack_device", "pack_host",
+                 "unpack_host"):
+        g = getattr(sheet, name)
+        if not g:
+            continue  # a grid the hardware could not measure may be absent
+        nonempty += 1
+        assert len(g) == ni and all(len(r) == nj for r in g), name
+        assert all(t > 0 for r in g for t in r), name
+    assert nonempty >= 2, "shipped sheet must carry measured pack grids"
+
+
+def test_device_launch_sane(sheet):
+    # dispatch overhead: positive, and below a second even over a tunnel
+    assert 0 < sheet.device_launch < 1.0
