@@ -53,11 +53,29 @@ def main() -> int:
             return 1
     with open(path) as f:
         sp = msys.SystemPerformance.from_json(json.load(f))
+    # the runtime drops schema-stale sections at load (migrate_schema in
+    # load_cached) — report the same view, or a schema-1 sheet would
+    # print curves and winners AUTO can never see
+    cleared = msys.migrate_schema(sp)
     print(f"sheet: {path}")
+    if cleared:
+        print(f"NOTE: dropped schema-stale sections {cleared} — the "
+              "runtime discards these at load (re-run measure_all)")
     print(f"platform: {sp.platform!r}  schema: {sp.schema}  "
           f"device_launch: {_fmt_t(sp.device_launch)}")
     print("(the runtime accepts this sheet only if its platform stamp "
           "matches the running system)")
+    mc = sp.measured_conditions
+    if mc:
+        print("measured under: "
+              + "  ".join(f"{k}={v}" for k, v in mc.items()
+                          if k != "notes"))
+        if mc.get("notes"):
+            print(f"  caveat: {mc['notes']}")
+    else:
+        print("measured under: UNKNOWN (sheet predates the "
+              "measured_conditions stamp — absolute latency scale is "
+              "session-dependent on a tunneled device)")
 
     for name in ("d2h", "h2d", "host_pingpong", "intra_node_pingpong",
                  "inter_node_pingpong"):
@@ -91,6 +109,10 @@ def main() -> int:
         print(f"{name}: {ni}x{nj}, {sent} sentinel  {cs}")
 
     msys.set_system(sp)
+    # the winner columns mirror the chooser's arms exactly (p2p.py): a
+    # STRIDED message's AUTO compares device vs oneshot pack paths; a
+    # CONTIGUOUS message's AUTO compares direct1d vs staged1d. Mixing the
+    # four into one min() would print winners AUTO can never pick.
     print("\ncomposed models (judged shapes; colocated):")
     print(f"{'shape':>22} {'device':>10} {'oneshot':>10} "
           f"{'staged1d':>10} {'direct1d':>10}")
@@ -103,9 +125,18 @@ def main() -> int:
         di = msys.model_direct_1d(nbytes, True)
         row = [(_fmt_t(v) if v < math.inf else "inf")
                for v in (dev, one, st, di)]
-        best = min((dev, "device"), (one, "oneshot"))[1]
+
+        def _winner(*cands):
+            # all-inf means AUTO's arm falls through unmodeled — naming
+            # a "winner" there would claim a decision that never happens
+            t, name = min(cands)
+            return name if t < math.inf else "unmodeled"
+
+        best = _winner((dev, "device"), (one, "oneshot"))
+        best1d = _winner((di, "direct"), (st, "staged"))
         print(f"{label:>22} {row[0]:>10} {row[1]:>10} "
-              f"{row[2]:>10} {row[3]:>10}   -> {best}")
+              f"{row[2]:>10} {row[3]:>10}   -> strided: {best}, "
+              f"contiguous: {best1d}")
     return 0
 
 

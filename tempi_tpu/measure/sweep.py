@@ -86,21 +86,29 @@ def _call_with_timeout(fn, timeout_s: float):
     return err[0] if err else True
 
 
-def _probe_host_reads(fn, what: str, timeout_s: float = 120.0):
-    """One guarded ``fn()`` before a section that times device-to-host
-    reads. A hung D2H blocks in C forever (no Python timeout can reach
-    it); for the curve sections there is nothing to measure around the
-    hang, so fail LOUDLY instead of freezing the sweep. Callers must warm
-    any compiles first — the timeout must cover only the read."""
+def _probe_host_reads(fn, what: str, timeout_s: float = 120.0,
+                      fatal: bool = True) -> bool:
+    """One guarded ``fn()`` before handing a device-to-host read to the
+    benchmark loop. A hung D2H blocks in C forever (no Python timeout can
+    reach it). ``fatal`` hangs raise LOUDLY (a section with no data at
+    all cannot proceed); non-fatal hangs — a size-dependent hang midway
+    through a curve — return False so the caller keeps the partial curve
+    instead of freezing the sweep. Callers must warm any compiles first —
+    the timeout must cover only the read."""
     res = _call_with_timeout(fn, timeout_s)
     if res == "timeout":
         _HOST_READ_BROKEN[0] = True
-        raise RuntimeError(
-            f"device-to-host read hung >120s probing {what}: host reads "
-            "are broken on this backend/tunnel; curves that time them "
-            "cannot be measured")
+        if fatal:
+            raise RuntimeError(
+                f"device-to-host read hung >120s probing {what}: host "
+                "reads are broken on this backend/tunnel; curves that "
+                "time them cannot be measured")
+        log.warn(f"device-to-host read hung >120s probing {what}; "
+                 "keeping the partial curve measured so far")
+        return False
     if isinstance(res, Exception):
         raise res
+    return True
 
 
 def _grid_cell(i: int, j: int):
@@ -155,30 +163,46 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         log.warn(f"discarding {sp.platform!r} curves; measuring {plat!r}")
         sp = SystemPerformance()
     sp.platform = plat
-    if sp.schema < msys.GRID_SCHEMA:
-        # sections whose MEANING changed since this sheet was measured
-        # must re-measure — the skip logic would otherwise keep them as
-        # "clean" priors forever (schema 2: unpack_host gained the H2D
-        # leg of the host-landed payload)
-        if sp.schema < 2 and sp.unpack_host:
-            log.warn("re-measuring unpack_host: sheet predates the "
-                     "H2D-inclusive semantics (schema "
-                     f"{sp.schema} < 2)")
-            sp.unpack_host = []
-        sp.schema = msys.GRID_SCHEMA
+    cleared = msys.migrate_schema(sp)
+    if cleared:
+        log.warn(f"re-measuring {cleared}: sheet predates schema "
+                 f"{msys.GRID_SCHEMA} semantics")
+    # a hung-host-read verdict is a property of the SESSION, not the
+    # process: a sweep retried after tunnel recovery must re-probe once
+    # instead of sentineling every host cell forever
+    _HOST_READ_BROKEN[0] = False
     if device is None:
         device = jax.devices()[0]
     kw = _bench_kwargs(quick)
 
+    rtt, rtt_fn, rtt_x = _dispatch_rtt(device)
+    _session_staleness(sp, rtt, checkpoint=_ckpt)
+    # the stamp describes the session that measured the RTT-sensitive
+    # curves — update it ONLY when this run will (re)measure at least one
+    # of them (or no stamp exists yet). A run that keeps a healthier
+    # session's curves must not overwrite their provenance with its own
+    # (worse) RTT, or the next healthy session would see a degraded stamp
+    # and needlessly wipe already-healthy curves.
+    stamping = (not sp.measured_conditions.get("dispatch_rtt_us")
+                or any(not getattr(sp, k) for k in _RTT_SENSITIVE))
+    if stamping:
+        sp.measured_conditions.update(
+            dispatch_rtt_us=round(rtt * 1e6, 1),
+            notes=("per-call curves (d2h/h2d/pingpongs) include one "
+                   "dispatch round trip per sample: their absolute scale "
+                   "is session-dependent on a tunneled device; compare "
+                   "strategies within one sheet, and distrust cross-sheet "
+                   "absolute latencies"),
+        )
+
     if sp.device_launch == 0.0:
-        x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
-        f = jax.jit(lambda v: v + 1.0)
-        f(x).block_until_ready()
+        # reuse _dispatch_rtt's warmed jitted add (a second identical
+        # compile would cost another tunneled round trip at sweep start)
         t0 = time.perf_counter()
         n = 100
         for _ in range(n):
-            f(x)  # dispatch only: launch overhead analog
-        jax.block_until_ready(f(x))
+            rtt_fn(rtt_x)  # dispatch only: launch overhead analog
+        jax.block_until_ready(rtt_fn(rtt_x))
         sp.device_launch = (time.perf_counter() - t0) / n
         log.debug(f"device_launch = {sp.device_launch:.2e}s")
 
@@ -193,14 +217,17 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     if not sp.d2h:
         # read a fresh array per call (see _fresh): a repeated
         # np.asarray(buf) times jax's cached host copy, not the transfer
-        probed = False
         for nb in _transfer_sizes(quick):
             scratch = dev_alloc.allocate(nb)
             buf = jax.device_put(scratch, device)
             _fresh(buf).block_until_ready()  # warm compile device-side
-            if not probed:
-                _probe_host_reads(lambda: np.asarray(_fresh(buf)), "d2h")
-                probed = True
+            # probe EVERY size (not just the first): a size-dependent
+            # D2H hang at MiB scale would otherwise freeze benchmark()
+            # with no watchdog; a mid-curve hang keeps the partial curve
+            if not _probe_host_reads(lambda: np.asarray(_fresh(buf)),
+                                     f"d2h {nb}B", fatal=not sp.d2h):
+                dev_alloc.release(scratch)
+                break
             r = benchmark(lambda: np.asarray(_fresh(buf)), **kw)
             sp.d2h.append((nb, r.trimean))
             dev_alloc.release(scratch)
@@ -236,6 +263,7 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         devs = jax.local_devices()
         if len(devs) >= 2:
             sp.intra_node_pingpong = _pingpong_curve(devs, quick, kw)
+            sp.measured_conditions["intra_node_mode"] = "2dev-mesh"
         else:
             # single local device (the judged 1-chip box): without a curve
             # model_direct_1d is infinite and the contiguous AUTO path
@@ -250,6 +278,9 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             log.debug("single local device: measuring self-ppermute "
                       "stand-in for the intra-node pingpong curve")
             sp.intra_node_pingpong = _self_pingpong_curve(devs[0], quick, kw)
+            # understates true ICI latency (no inter-chip hop) — a sheet
+            # reader must be able to tell this curve is a 1-chip proxy
+            sp.measured_conditions["intra_node_mode"] = "self-ppermute-proxy"
         _ckpt()
 
     pair = _cross_process_pair(jax.devices())
@@ -322,8 +353,82 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         _ckpt()
         log.debug(f"{name}: grid measured")
 
+    if stamping:
+        # per the SystemPerformance docstring: the time the LAST section
+        # was measured, not the sweep's start
+        sp.measured_conditions["captured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%S%z")
+        _ckpt()
     msys.set_system(sp)
     return sp
+
+
+def _dispatch_rtt(device):
+    """Median jitted-add round trip (dispatch + tiny compute + ready):
+    the session-health yardstick stamped into measured_conditions. On a
+    tunneled device this swings ~100 us (healthy) to ~40 ms (degraded)
+    between sessions and sets the absolute scale of every per-call
+    curve. Returns (rtt_seconds, warmed_fn, its_arg) so the
+    device_launch block can reuse the compiled add instead of paying a
+    second tunneled compile."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
+    f = jax.jit(lambda v: v + 1.0)
+    f(x).block_until_ready()
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2], f, x
+
+
+# a sheet measured in a session this many times SLOWER (by dispatch round
+# trip) than the current one has its per-call curves re-measured: their
+# absolute scale was the old session's tunnel, not the hardware
+_STALE_RTT_RATIO = 4.0
+
+# curve sections whose every sample pays one dispatch round trip; the pack
+# grids amortize dispatch over many enqueued iterations per sample
+# (benchmark's enqueue/flush throughput mode) and keep their relative
+# validity across sessions, so they are NOT invalidated. host_pingpong
+# never touches the device at all.
+_RTT_SENSITIVE = ("d2h", "h2d", "intra_node_pingpong",
+                  "inter_node_pingpong")
+
+
+def _session_staleness(sp, rtt_now: float, checkpoint=None) -> None:
+    """If the sheet's curves were measured in a much sicker session than
+    this one (e.g. a 40 ms-RTT tunnel vs a healthy ~100 us one), clear the
+    RTT-sensitive sections so this sweep re-measures them at the better
+    scale. One-directional: a DEGRADED current session never clears a
+    healthier sheet's curves — measuring now would only contaminate them."""
+    prev = sp.measured_conditions.get("dispatch_rtt_us")
+    if prev and float(prev) <= rtt_now * 1e6 * _STALE_RTT_RATIO:
+        return
+    cleared = [k for k in _RTT_SENSITIVE if getattr(sp, k)]
+    if not cleared:
+        return
+    for k in cleared:
+        setattr(sp, k, [])
+    if prev:
+        log.warn(f"re-measuring {cleared}: sheet measured at dispatch "
+                 f"RTT {float(prev):.0f} us, session is now "
+                 f"{rtt_now * 1e6:.0f} us — old absolute scale was the "
+                 "tunnel's, not the hardware's")
+    else:
+        # a pre-stamp sheet's curves have UNKNOWN provenance — they may
+        # carry any past session's latency floor; re-measure them once
+        # at a known RTT (the grids are kept: their enqueue/flush
+        # samples amortize dispatch and stay relatively valid)
+        log.warn(f"re-measuring {cleared}: sheet predates the "
+                 "measured_conditions stamp (unknown session health at "
+                 "measure time)")
+    if checkpoint is not None:
+        checkpoint()
 
 
 def _cross_process_pair(devs):
@@ -421,14 +526,14 @@ def _staged_pingpong_curve(devs, quick, kw):
     # copy after the first call — the first leg's D2H would otherwise
     # cost nothing from the second call on (y is fresh per hop already)
     curve = []
-    probed = False
     for nb in _transfer_sizes(quick):
         x = jax.device_put(np.zeros(nb, np.uint8), a)
         _fresh(x).block_until_ready()  # warm compile device-side
-        if not probed:
-            _probe_host_reads(lambda: np.asarray(_fresh(x)),
-                              "staged pingpong")
-            probed = True
+        # per-size probe: a size-dependent hang keeps the partial curve
+        if not _probe_host_reads(lambda: np.asarray(_fresh(x)),
+                                 f"staged pingpong {nb}B",
+                                 fatal=not curve):
+            break
 
         def hop():
             y = jax.device_put(np.asarray(_fresh(x)), b)  # D2H+H2D to peer

@@ -79,6 +79,17 @@ class SystemPerformance:
     platform: str = ""
     schema: int = GRID_SCHEMA
     device_launch: float = 0.0
+    # provenance of the measuring session: the absolute scale of the
+    # per-call curves (d2h/h2d/pingpongs) is set by the dispatch round
+    # trip of the session that measured them — on a tunneled device that
+    # varies by 100x between sessions. A reader of the sheet (and
+    # measure_all's staleness check) must be able to tell. Keys:
+    #   dispatch_rtt_us   — median jitted-add round trip at measure time
+    #   captured_at       — ISO timestamp of the LAST section measured
+    #   intra_node_mode   — "2dev-mesh" or "self-ppermute-proxy" (1-chip
+    #                       stand-in that understates real ICI latency)
+    #   notes             — free-text caveats
+    measured_conditions: Dict[str, object] = field(default_factory=dict)
     d2h: List[Tuple[int, float]] = field(default_factory=list)
     h2d: List[Tuple[int, float]] = field(default_factory=list)
     intra_node_pingpong: List[Tuple[int, float]] = field(default_factory=list)
@@ -94,6 +105,7 @@ class SystemPerformance:
             "platform": self.platform,
             "schema": self.schema,
             "device_launch": self.device_launch,
+            "measured_conditions": self.measured_conditions,
             **{k: [[int(b), t] for b, t in getattr(self, k)]
                for k in ("d2h", "h2d", "intra_node_pingpong",
                          "inter_node_pingpong", "host_pingpong")},
@@ -111,12 +123,38 @@ class SystemPerformance:
         sp.platform = str(d.get("platform", ""))
         sp.schema = int(d.get("schema", 1))  # pre-versioning sheets = 1
         sp.device_launch = float(d.get("device_launch", 0.0))
+        mc = d.get("measured_conditions", {})
+        sp.measured_conditions = dict(mc) if isinstance(mc, dict) else {}
         for k in ("d2h", "h2d", "intra_node_pingpong", "inter_node_pingpong",
                   "host_pingpong"):
             sp.__setattr__(k, [(int(b), float(t)) for b, t in d.get(k, [])])
         for k in ("pack_device", "unpack_device", "pack_host", "unpack_host"):
             sp.__setattr__(k, [list(map(float, row)) for row in d.get(k, [])])
         return sp
+
+
+def migrate_schema(sp: SystemPerformance) -> List[str]:
+    """Clear sections whose MEANING changed since ``sp`` was measured, so
+    stale curves re-measure instead of surviving as "clean" priors. Shared
+    by measure_all (before its skip logic) and load_cached (so a schema-1
+    checkpoint never feeds models bogus curves even if no sweep runs).
+    Returns the names of the sections cleared.
+
+    Schema 1 -> 2: three sections were measured under broken semantics —
+      * unpack_host lacked the H2D leg of the host-landed payload;
+      * d2h timed np.asarray of the SAME Array, i.e. jax's cached host
+        copy (~us flat) rather than the transfer;
+      * inter_node_pingpong's single-process staged stand-in rode that
+        same cached-copy D2H after the first hop.
+    All three fed model_oneshot/model_staged_1d wildly underpriced."""
+    cleared = []
+    if sp.schema < 2:
+        for name in ("unpack_host", "d2h", "inter_node_pingpong"):
+            if getattr(sp, name):
+                setattr(sp, name, [])
+                cleared.append(name)
+    sp.schema = GRID_SCHEMA
+    return cleared
 
 
 _system: Optional[SystemPerformance] = None
@@ -203,6 +241,14 @@ def load_cached() -> Optional[SystemPerformance]:
                          f"{sp.platform!r}, running on {plat!r} "
                          f"(re-run measure_all to refresh)")
                 continue
+            cleared = migrate_schema(sp)
+            if cleared:
+                log.info(f"perf sheet {path} predates schema "
+                         f"{GRID_SCHEMA}; dropped stale sections "
+                         f"{cleared} (re-run measure_all to refresh)")
+            mc = sp.measured_conditions
+            if mc:
+                log.debug(f"sheet measured under: {mc}")
             set_system(sp)
             log.debug(f"loaded system performance cache from {path}")
             return sp

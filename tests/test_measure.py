@@ -127,6 +127,10 @@ def test_quick_sweep_fills_sections(tmp_path, monkeypatch):
     monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
     sp = SystemPerformance()
     sp.d2h = [(1, 99.0)]  # pre-existing section must be preserved
+    # stamp a healthier-than-now RTT: an UNSTAMPED sheet's RTT-sensitive
+    # curves are re-measured (unknown session provenance), which would
+    # defeat this test's incremental-keep assertion
+    sp.measured_conditions["dispatch_rtt_us"] = 0.01
     out = sweep.measure_all(sp, quick=True)
     assert out.d2h == [(1, 99.0)]
     assert out.h2d and out.host_pingpong
@@ -194,6 +198,62 @@ def test_schema_migration_remeasures_unpack_host(tmp_path, monkeypatch):
     out.unpack_host = [[7e-6] * 3 for _ in range(3)]
     out2 = sweep.measure_all(out, quick=True)
     assert out2.unpack_host == [[7e-6] * 3 for _ in range(3)]
+
+
+def test_schema_migration_drops_stale_curves_on_load(tmp_path, monkeypatch):
+    """ADVICE r4 (medium): schema-1 sheets' d2h (cached-host-copy
+    artifact) and staged-measured inter_node_pingpong were captured under
+    the same broken semantics as unpack_host — both the sweep AND
+    load_cached must drop them, or a pre-fix checkpoint feeds
+    model_staged_1d/model_oneshot bogus curves forever."""
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = SystemPerformance()
+    sp.platform = msys.current_platform()
+    sp.d2h = [(1, 1e-6), (1024, 2e-6)]
+    sp.inter_node_pingpong = [(1, 1e-6), (1024, 2e-6)]
+    sp.host_pingpong = [(1, 1e-6)]
+    legacy = sp.to_json()
+    del legacy["schema"]  # pre-versioning checkpoint
+    import json as _json
+    (tmp_path / "perf.json").write_text(_json.dumps(legacy))
+    loaded = msys.load_cached()
+    assert loaded is not None
+    assert loaded.schema == msys.GRID_SCHEMA
+    assert not loaded.d2h, "schema-1 d2h survived load_cached"
+    assert not loaded.inter_node_pingpong
+    assert loaded.host_pingpong  # unaffected sections are kept
+    assert msys.model_staged_1d(1024) == math.inf
+
+
+def test_stale_session_curves_remeasured(tmp_path, monkeypatch):
+    """A sheet measured in a much sicker session (dispatch RTT stamp far
+    above the current session's) has its per-call curves re-measured so
+    a healthy session heals tunnel-contaminated absolute scales; pack
+    grids (dispatch-amortized) are kept. One-directional: a sheet from a
+    HEALTHIER session is never cleared by a degraded one."""
+    from tempi_tpu.measure import sweep
+    from tempi_tpu.utils import env as envmod
+    monkeypatch.setattr(envmod.env, "cache_dir", str(tmp_path))
+    sp = sweep.measure_all(SystemPerformance(), quick=True)
+    assert sp.measured_conditions.get("dispatch_rtt_us", 0) > 0
+    assert sp.measured_conditions.get("intra_node_mode")
+    # forge a tunnel-degraded provenance: 40 ms dispatch round trips
+    sp.measured_conditions["dispatch_rtt_us"] = 40000.0
+    sp.d2h = [(1, 0.095)]
+    sp.h2d = [(1, 0.069)]
+    marker = [(1, 123.0)]
+    sp.intra_node_pingpong = list(marker)
+    out = sweep.measure_all(sp, quick=True)
+    assert out.d2h and out.d2h != [(1, 0.095)], "stale d2h kept"
+    assert out.intra_node_pingpong != marker, "stale pingpong kept"
+    # pack grids survive the staleness clearing
+    assert out.pack_device
+    # healthier-sheet direction: stamp BELOW current RTT -> keep curves
+    out.measured_conditions["dispatch_rtt_us"] = 0.001
+    out.d2h = [(1, 55.0)]
+    out2 = sweep.measure_all(out, quick=True)
+    assert out2.d2h == [(1, 55.0)], "healthy sheet cleared by re-run"
 
 
 def test_d2h_measures_real_transfers(tmp_path, monkeypatch):

@@ -12,7 +12,7 @@ import os
 import pytest
 
 from tempi_tpu.measure.system import (GRID_BLOCKLEN, GRID_BYTES,
-                                      SystemPerformance)
+                                      GRID_SCHEMA, SystemPerformance)
 
 _SHEET = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "PERF_TPU.json")
@@ -69,3 +69,24 @@ def test_grids_full_size_and_positive(sheet):
 def test_device_launch_sane(sheet):
     # dispatch overhead: positive, and below a second even over a tunnel
     assert 0 < sheet.device_launch < 1.0
+
+
+def test_schema_is_current(sheet):
+    """A schema-less sheet is treated as schema 1 and has its d2h /
+    inter_node_pingpong / unpack_host dropped at load (migrate_schema) —
+    the committed artifact must carry the semantics it was measured
+    under or it ships curves load_cached immediately discards."""
+    assert sheet.schema == GRID_SCHEMA, sheet.schema
+
+
+def test_measured_conditions_stamp(sheet):
+    """A reader of the sheet alone must be able to tell the absolute
+    latency scale is session-dependent (tunnel-contaminated sessions
+    swing dispatch RTT ~100 us to ~40 ms) and that a 1-chip sheet's
+    intra-node curve is a self-ppermute proxy."""
+    mc = sheet.measured_conditions
+    assert mc.get("dispatch_rtt_us", 0) > 0
+    assert mc.get("captured_at")
+    if sheet.platform.endswith("/n1"):
+        assert mc.get("intra_node_mode") == "self-ppermute-proxy"
+    assert "session" in str(mc.get("notes", ""))
