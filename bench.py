@@ -253,8 +253,10 @@ def bench_pingpong_nd(jax, quick: bool):
             rp_p50 / hops, per_strategy)
 
 
-def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False):
-    """Halo-exchange iterations/s at matched per-device bytes.
+def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False,
+               X: int = None, phases: bool = False):
+    """Halo-exchange iterations/s at matched per-device bytes, plus an
+    optional per-phase pack/comm/unpack/self attribution.
 
     ``engine=True`` pins ``strategy="device"``, which routes through the
     persistent-replay engine with DEVICE transport on every edge instead
@@ -264,7 +266,14 @@ def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False):
     ``benches/bench_halo_exchange.py --engine`` pins via TEMPI_NO_FUSED
     with per-edge strategy selection instead; on an unmeasured system
     both land on DEVICE, but they can diverge once a perf sheet is
-    live."""
+    live.
+
+    ``X`` overrides the grid edge: X=512 on one rank is the judged
+    config's TOTAL volume on a single chip (the judged config is 512^3
+    over 8 ranks = 256^3 cells per device; X=512 here puts the whole
+    536 MB f32 grid on the one chip, comfortably inside 16 GB HBM).
+    ``phases`` runs the phase-isolated attribution pass (extra compiles)
+    and returns its dict as the third element."""
     from tempi_tpu import api
     from tempi_tpu.models import halo3d
     from tempi_tpu.parallel.communicator import Communicator
@@ -272,14 +281,16 @@ def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False):
     world = api.comm_world()
     if n_devices >= 8:
         comm = Communicator(world.devices[:8])
-        X, periodic = 512 if not quick else 64, False
+        X0, periodic = 512 if not quick else 64, False
     else:
         comm = Communicator(world.devices[:1])
         # 512^3 / 8 ranks = 256^3 cells per rank; periodic wrap gives this
         # one rank the full 26-edge exchange of an interior rank
-        X, periodic = 256 if not quick else 32, True
+        X0, periodic = 256 if not quick else 32, True
+    if X is not None:
+        X0 = X
     strategy = "device" if engine else None
-    ex = halo3d.HaloExchange(comm, X=X, periodic=periodic)
+    ex = halo3d.HaloExchange(comm, X=X0, periodic=periodic)
     buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
     for _ in range(3):  # compile + settle the tunnel
         ex.exchange(buf, strategy=strategy)
@@ -292,7 +303,19 @@ def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False):
         buf.data.block_until_ready()
         times.append(time.perf_counter() - t0)
     med = _median_of(times)  # median: robust to tunnel hiccups
-    return 1.0 / med, f"X={X} ranks={comm.size} periodic={periodic}"
+    ph = {}
+    if phases:
+        import os
+
+        # the benches are flat scripts importing each other as top-level
+        # modules (python benches/foo.py) — mirror that here
+        bdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benches")
+        if bdir not in sys.path:
+            sys.path.insert(0, bdir)
+        from bench_halo_exchange import _phase_split
+        ph = _phase_split(ex, buf, min(iters, 10))
+    return (1.0 / med, f"X={X0} ranks={comm.size} periodic={periodic}", ph)
 
 
 def bench_alltoallv_sparse(jax, quick: bool, reorder: bool):
@@ -495,16 +518,33 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
         print(f"pack failed: {e!r}", file=sys.stderr)
         emit({"pack_gbs": None, "pack_gbs_4m": None})
     try:
-        halo_ips, halo_cfg = bench_halo(jax, len(devices), quick)
+        halo_ips, halo_cfg, halo_ph = bench_halo(jax, len(devices), quick,
+                                                 phases=not quick)
         emit({"halo_iters_per_s": round(halo_ips, 2),
-              "halo_config": halo_cfg})
+              "halo_config": halo_cfg,
+              **({"halo_phases": halo_ph} if halo_ph else {})})
     except Exception as e:
         print(f"halo failed: {e!r}", file=sys.stderr)
         emit({"halo_iters_per_s": None, "halo_config": "failed"})
+    if not quick and len(devices) < 8:
+        # single-chip judged-volume point: the judged config is 512^3
+        # over 8 ranks (BASELINE.md); X=512 on the one chip matches the
+        # judged TOTAL volume (536 MB f32 grid) while X=256 above stays
+        # the per-device trend point
+        try:
+            ips512, cfg512, ph512 = bench_halo(jax, len(devices), quick,
+                                               X=512, phases=True)
+            emit({"halo_iters_per_s_x512": round(ips512, 2),
+                  "halo_config_x512": cfg512,
+                  **({"halo_phases_x512": ph512} if ph512 else {})})
+        except Exception as e:
+            print(f"halo x512 failed: {e!r}", file=sys.stderr)
+            emit({"halo_iters_per_s_x512": None,
+                  "halo_config_x512": "failed"})
     try:
         # same config through the persistent-replay ENGINE path: the
         # fused-vs-engine hardware A/B lands in every capture
-        eng_ips, _ = bench_halo(jax, len(devices), quick, engine=True)
+        eng_ips, _, _ = bench_halo(jax, len(devices), quick, engine=True)
         emit({"halo_engine_iters_per_s": round(eng_ips, 2)})
     except Exception as e:
         print(f"halo engine A/B failed: {e!r}", file=sys.stderr)
@@ -981,6 +1021,8 @@ def main() -> int:
                          ("pingpong_nd_staged_p50_us", None),
                          ("pingpong_nd_oneshot_p50_us", None),
                          ("halo_iters_per_s", None),
+                         ("halo_iters_per_s_x512", None),
+                         ("halo_config_x512", "missing"),
                          ("halo_engine_iters_per_s", None),
                          ("halo_config", "missing"),
                          ("alltoallv_sparse_s", None),

@@ -135,18 +135,20 @@ def _phase_split(ex, buf, iters: int) -> dict:
     os.environ["TEMPI_NO_DONATE"] = "1"
     try:
         plan = ExchangePlan(ex.comm, ex._edge_messages(buf))
-        fns = plan._build_round_fns(None)
+        fns = plan._build_round_fns(None)  # [(pack_fn, unpack_fn)] per round
         datas = [b.data for b in plan.bufs]
-        xfer = [(i, e) for i, (k, e) in enumerate(fns) if k == "xfer"]
-        selfs = [e for k, e in fns if k == "self"]
+        # classify by the round's messages: an all-self round (periodic
+        # wrap edges landing on the same rank) is its own phase — in the
+        # production device program it is local work, not transport
+        self_rnd = [all(m.src == m.dst for m in rnd) for rnd in plan.rounds]
+        xfer = [(i, fns[i]) for i in range(len(fns)) if not self_rnd[i]]
+        selfs = [(i, fns[i]) for i in range(len(fns)) if self_rnd[i]]
 
         payloads = {}
-        for i, (pf, uf) in xfer:  # compile + capture payloads for unpack
+        for i, (pf, uf) in xfer + selfs:  # compile + capture payloads
             payloads[i] = pf(*datas)
             jax.block_until_ready(payloads[i])
             jax.block_until_ready(uf(payloads[i], *datas))
-        for e in selfs:
-            jax.block_until_ready(e(*datas))
         plan.run_device()  # compile the full program
         for b, d in zip(plan.bufs, datas):
             b.data = d  # run_device rebinds; restore the originals
@@ -163,7 +165,8 @@ def _phase_split(ex, buf, iters: int) -> dict:
             [uf(payloads[i], *datas) for i, (_p, uf) in xfer])) \
             if xfer else 0.0
         t_self = timed(lambda: jax.block_until_ready(
-            [e(*datas) for e in selfs])) if selfs else 0.0
+            [uf(payloads[i], *datas) for i, (pf, uf) in selfs]
+            + [pf(*datas) for _, (pf, _u) in selfs])) if selfs else 0.0
 
         def total_once():
             plan.run_device()

@@ -141,7 +141,7 @@ def test_hang_exposed_metrics_run_last(bench_mod, monkeypatch):
     monkeypatch.setattr(m, "bench_pack", lambda *a, **k: 1.0)
     monkeypatch.setattr(m, "bench_pingpong_nd",
                         lambda *a, **k: (1e-6, "self", None, {}))
-    monkeypatch.setattr(m, "bench_halo", lambda *a, **k: (1.0, "cfg"))
+    monkeypatch.setattr(m, "bench_halo", lambda *a, **k: (1.0, "cfg", {}))
     monkeypatch.setattr(m, "bench_alltoallv_sparse", lambda *a, **k: 0.1)
     monkeypatch.setattr(m, "_model_evidence",
                         lambda: {"auto_choice_nd_1m": "device"})
