@@ -4,9 +4,15 @@
 // (/root/reference/src/internal/partition_kahip.cpp, partition_metis.cpp):
 // the reference calls KaHIP's kaffpa / METIS_PartGraphKway and keeps the best
 // of several seeds by edge cut, requiring an exactly balanced result. This is
-// an original implementation of the same contract: balanced k-way partition of
-// a weighted undirected CSR graph minimizing edge cut, via greedy graph
-// growing + Fiduccia–Mattheyses boundary refinement, best-of-N seeds.
+// an original implementation of the same contract: balanced k-way partition
+// of a weighted undirected CSR graph minimizing edge cut. Like the
+// reference's solvers (kaffpa FAST is a multilevel coarsen/partition/
+// uncoarsen scheme, partition_kahip.cpp:16-88) this is MULTILEVEL: heavy-edge
+// matching contracts the graph until it is small, a weighted greedy-growing +
+// Fiduccia–Mattheyses pass partitions the coarsest graph, and the partition
+// is projected back up with FM refinement at every level (single-level FM on
+// a large graph gets stuck in local minima — the round-4 review's pod-scale
+// gap). Best-of-N seeds, exact ceil(n/k) balance at the finest level.
 //
 // C ABI only (loaded with ctypes).
 
@@ -14,46 +20,46 @@
 #include <cstdint>
 #include <cstring>
 #include <random>
+#include <unordered_map>
 #include <vector>
 
 namespace {
 
-struct Csr {
-  int n;
-  const int64_t *xadj;
-  const int64_t *adjncy;
-  const int64_t *adjwgt;
+// owned graph with vertex weights (coarse vertices aggregate fine ones)
+struct Graph {
+  int n = 0;
+  std::vector<int64_t> xadj, adjncy, adjwgt, vwgt;
 };
 
-// gain of moving v from part[v] to part p: external(p) - internal
-int64_t move_gain(const Csr &g, const std::vector<int> &part, int v, int p) {
-  int64_t gain = 0;
-  for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
-    int u = (int)g.adjncy[e];
-    int64_t w = g.adjwgt ? g.adjwgt[e] : 1;
-    if (part[u] == part[v])
-      gain -= w;
-    else if (part[u] == p)
-      gain += w;
-  }
-  return gain;
-}
-
-int64_t edge_cut(const Csr &g, const std::vector<int> &part) {
+int64_t edge_cut(const Graph &g, const std::vector<int> &part) {
   int64_t cut = 0;
   for (int v = 0; v < g.n; ++v)
     for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
       int u = (int)g.adjncy[e];
-      if (u > v && part[u] != part[v]) cut += g.adjwgt ? g.adjwgt[e] : 1;
+      if (u > v && part[u] != part[v]) cut += g.adjwgt[e];
     }
   return cut;
 }
 
-// greedy graph growing: grow each part from a random unassigned seed,
-// repeatedly absorbing the unassigned vertex most connected to the part
-void grow_initial(const Csr &g, int k, std::mt19937 &rng,
+// gain of moving v from part[v] to part p: external(p) - internal
+int64_t move_gain(const Graph &g, const std::vector<int> &part, int v, int p) {
+  int64_t gain = 0;
+  for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+    int u = (int)g.adjncy[e];
+    if (u == v) continue;
+    if (part[u] == part[v])
+      gain -= g.adjwgt[e];
+    else if (part[u] == p)
+      gain += g.adjwgt[e];
+  }
+  return gain;
+}
+
+// greedy graph growing on VERTEX WEIGHT: grow each part from a random
+// unassigned seed, absorbing the unassigned vertex most connected to it,
+// until the part reaches its weight target
+void grow_initial(const Graph &g, int k, int64_t cap_w, std::mt19937 &rng,
                   std::vector<int> &part) {
-  int cap = (g.n + k - 1) / k;  // ceil: exact balance like the reference needs
   part.assign(g.n, -1);
   std::vector<int64_t> conn(g.n, 0);
   std::vector<int> order(g.n);
@@ -61,56 +67,67 @@ void grow_initial(const Csr &g, int k, std::mt19937 &rng,
   std::shuffle(order.begin(), order.end(), rng);
   int oi = 0;
   for (int p = 0; p < k; ++p) {
-    int remaining_parts = k - p;
-    int unassigned = 0;
-    for (int v = 0; v < g.n; ++v) unassigned += (part[v] < 0);
-    int target = (unassigned + remaining_parts - 1) / remaining_parts;  // ceil
-    target = std::min(cap, std::max(1, target));
-    // seed
+    int64_t unassigned_w = 0;
+    for (int v = 0; v < g.n; ++v)
+      if (part[v] < 0) unassigned_w += g.vwgt[v];
+    int64_t target = (unassigned_w + (k - p) - 1) / (k - p);  // ceil
+    target = std::min(cap_w, std::max<int64_t>(1, target));
     while (oi < g.n && part[order[oi]] >= 0) ++oi;
     if (oi >= g.n) break;
     std::fill(conn.begin(), conn.end(), 0);
     int cur = order[oi];
-    int count = 0;
-    while (cur >= 0 && count < target) {
+    int64_t w = 0;
+    while (cur >= 0 && w < target) {
       part[cur] = p;
-      ++count;
+      w += g.vwgt[cur];
       for (int64_t e = g.xadj[cur]; e < g.xadj[cur + 1]; ++e) {
         int u = (int)g.adjncy[e];
-        if (part[u] < 0) conn[u] += g.adjwgt ? g.adjwgt[e] : 1;
+        if (part[u] < 0) conn[u] += g.adjwgt[e];
       }
-      // next: strongest unassigned connection, else next random unassigned
+      // next: strongest unassigned connection that still fits, else the
+      // next random unassigned vertex
       cur = -1;
       int64_t best = 0;
       for (int v = 0; v < g.n; ++v)
-        if (part[v] < 0 && conn[v] > best) { best = conn[v]; cur = v; }
+        if (part[v] < 0 && conn[v] > best && w + g.vwgt[v] <= cap_w) {
+          best = conn[v];
+          cur = v;
+        }
       if (cur < 0) {
         for (int j = oi; j < g.n; ++j)
-          if (part[order[j]] < 0) { cur = order[j]; break; }
-        if (cur < 0) break;
-        if (count >= target) break;
+          if (part[order[j]] < 0 && w + g.vwgt[order[j]] <= cap_w) {
+            cur = order[j];
+            break;
+          }
+        if (cur < 0 || w >= target) break;
       }
     }
   }
-  // any stragglers: smallest part
-  std::vector<int> sizes(k, 0);
+  // stragglers: lightest part
+  std::vector<int64_t> wsum(k, 0);
   for (int v = 0; v < g.n; ++v)
-    if (part[v] >= 0) sizes[part[v]]++;
+    if (part[v] >= 0) wsum[part[v]] += g.vwgt[v];
   for (int v = 0; v < g.n; ++v)
     if (part[v] < 0) {
-      int p = (int)(std::min_element(sizes.begin(), sizes.end()) -
-                    sizes.begin());
+      int p = (int)(std::min_element(wsum.begin(), wsum.end()) -
+                    wsum.begin());
       part[v] = p;
-      sizes[p]++;
+      wsum[p] += g.vwgt[v];
     }
 }
 
-// FM-style refinement with strict balance: only consider moves that keep
-// every part within [floor(n/k), ceil(n/k)]; lock vertices once moved
-void refine(const Csr &g, int k, std::vector<int> &part, int passes) {
-  int lo = g.n / k, hi = (g.n + k - 1) / k;
-  std::vector<int> sizes(k, 0);
-  for (int v = 0; v < g.n; ++v) sizes[part[v]]++;
+// FM-style refinement under a weight cap: only moves that keep every
+// part's weight within [lo_w, cap_w]; lock vertices once moved per pass
+void refine(const Graph &g, int k, int64_t cap_w, std::vector<int> &part,
+            int passes) {
+  int64_t total_w = 0;
+  for (int v = 0; v < g.n; ++v) total_w += g.vwgt[v];
+  // floor(total/k), exactly the pre-multilevel bound: with unit weights
+  // this reproduces the old solver's move set verbatim, which the
+  // single-level arm's never-worse guarantee depends on
+  int64_t lo_w = total_w / k;
+  std::vector<int64_t> wsum(k, 0);
+  for (int v = 0; v < g.n; ++v) wsum[part[v]] += g.vwgt[v];
   for (int pass = 0; pass < passes; ++pass) {
     std::vector<char> locked(g.n, 0);
     bool improved = false;
@@ -118,36 +135,36 @@ void refine(const Csr &g, int k, std::vector<int> &part, int passes) {
       int best_v = -1, best_p = -1;
       int64_t best_gain = 0;
       for (int v = 0; v < g.n; ++v) {
-        if (locked[v] || sizes[part[v]] <= lo) continue;
-        // candidate destinations: parts of neighbors (boundary moves only)
+        if (locked[v] || wsum[part[v]] - g.vwgt[v] < lo_w) continue;
         for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
           int p = part[(int)g.adjncy[e]];
-          if (p == part[v] || sizes[p] >= hi) continue;
+          if (p == part[v] || wsum[p] + g.vwgt[v] > cap_w) continue;
           int64_t gain = move_gain(g, part, v, p);
           if (gain > best_gain) { best_gain = gain; best_v = v; best_p = p; }
         }
       }
       if (best_v < 0) break;
-      sizes[part[best_v]]--;
+      wsum[part[best_v]] -= g.vwgt[best_v];
       part[best_v] = best_p;
-      sizes[best_p]++;
+      wsum[best_p] += g.vwgt[best_v];
       locked[best_v] = 1;
       improved = true;
     }
     if (!improved) break;
   }
-  // pairwise swap pass: exchange two vertices between parts when it
-  // reduces the cut (keeps sizes exact; catches what single moves can't)
+  // pairwise swap pass: exchange two EQUAL-WEIGHT vertices between parts
+  // when it reduces the cut (weight-preserving, so balance is untouched;
+  // catches what single moves can't)
   for (int pass = 0; pass < passes; ++pass) {
     bool improved = false;
     for (int v = 0; v < g.n; ++v) {
       for (int u = v + 1; u < g.n; ++u) {
-        if (part[u] == part[v]) continue;
+        if (part[u] == part[v] || g.vwgt[u] != g.vwgt[v]) continue;
         int64_t gain = move_gain(g, part, v, part[u]) +
                        move_gain(g, part, u, part[v]);
         // correct for the (u,v) edge counted as gain on both sides
         for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e)
-          if ((int)g.adjncy[e] == u) gain -= 2 * (g.adjwgt ? g.adjwgt[e] : 1);
+          if ((int)g.adjncy[e] == u) gain -= 2 * g.adjwgt[e];
         if (gain > 0) {
           std::swap(part[u], part[v]);
           improved = true;
@@ -155,6 +172,141 @@ void refine(const Csr &g, int k, std::vector<int> &part, int passes) {
       }
     }
     if (!improved) break;
+  }
+}
+
+// heavy-edge matching contraction: each unmatched vertex (random visit
+// order) pairs with its heaviest-edge unmatched neighbor whose combined
+// weight still fits in a part. cmap maps fine -> coarse vertex.
+Graph coarsen(const Graph &g, std::mt19937 &rng, int64_t max_vwgt,
+              std::vector<int> &cmap) {
+  std::vector<int> order(g.n), match(g.n, -1);
+  for (int i = 0; i < g.n; ++i) order[i] = i;
+  std::shuffle(order.begin(), order.end(), rng);
+  for (int v : order) {
+    if (match[v] >= 0) continue;
+    int best_u = -1;
+    int64_t best_w = 0;
+    for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+      int u = (int)g.adjncy[e];
+      if (u == v || match[u] >= 0) continue;
+      if (g.vwgt[v] + g.vwgt[u] > max_vwgt) continue;
+      if (g.adjwgt[e] > best_w) { best_w = g.adjwgt[e]; best_u = u; }
+    }
+    match[v] = best_u >= 0 ? best_u : v;
+    if (best_u >= 0) match[best_u] = v;
+  }
+  cmap.assign(g.n, -1);
+  int nc = 0;
+  for (int v = 0; v < g.n; ++v) {
+    if (cmap[v] >= 0) continue;
+    cmap[v] = nc;
+    if (match[v] != v) cmap[match[v]] = nc;
+    ++nc;
+  }
+  Graph c;
+  c.n = nc;
+  c.vwgt.assign(nc, 0);
+  for (int v = 0; v < g.n; ++v) c.vwgt[cmap[v]] += g.vwgt[v];
+  // aggregate parallel edges; drop collapsed self-loops (internal to a
+  // coarse vertex — they can never be cut again)
+  std::vector<std::unordered_map<int, int64_t>> nbr(nc);
+  for (int v = 0; v < g.n; ++v)
+    for (int64_t e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+      int cu = cmap[(int)g.adjncy[e]], cv = cmap[v];
+      if (cu != cv) nbr[cv][cu] += g.adjwgt[e];
+    }
+  c.xadj.assign(nc + 1, 0);
+  for (int v = 0; v < nc; ++v) c.xadj[v + 1] = c.xadj[v] + nbr[v].size();
+  c.adjncy.resize(c.xadj[nc]);
+  c.adjwgt.resize(c.xadj[nc]);
+  for (int v = 0; v < nc; ++v) {
+    int64_t i = c.xadj[v];
+    for (auto &kv : nbr[v]) {
+      c.adjncy[i] = kv.first;
+      c.adjwgt[i] = kv.second;
+      ++i;
+    }
+  }
+  return c;
+}
+
+// force every part's weight under cap_w: move the least-damaging vertex
+// out of each overweight part until balanced (finest level has unit
+// weights, so this restores the exact ceil(n/k) contract after
+// projection from lumpy coarse levels)
+void rebalance(const Graph &g, int k, int64_t cap_w, std::vector<int> &part) {
+  std::vector<int64_t> wsum(k, 0);
+  for (int v = 0; v < g.n; ++v) wsum[part[v]] += g.vwgt[v];
+  for (int guard = 0; guard < g.n; ++guard) {
+    int over = -1;
+    for (int p = 0; p < k; ++p)
+      if (wsum[p] > cap_w) { over = p; break; }
+    if (over < 0) return;
+    int best_v = -1, best_p = -1;
+    int64_t best_gain = INT64_MIN;
+    for (int v = 0; v < g.n; ++v) {
+      if (part[v] != over) continue;
+      for (int p = 0; p < k; ++p) {
+        if (p == over || wsum[p] + g.vwgt[v] > cap_w) continue;
+        int64_t gain = move_gain(g, part, v, p);
+        if (gain > best_gain) { best_gain = gain; best_v = v; best_p = p; }
+      }
+    }
+    if (best_v < 0) return;  // nothing fits anywhere: give up (caller
+                             // reports the imbalance via is_balanced)
+    wsum[over] -= g.vwgt[best_v];
+    part[best_v] = best_p;
+    wsum[best_p] += g.vwgt[best_v];
+  }
+}
+
+// one full multilevel V-cycle for one seed
+void multilevel(const Graph &g0, int k, std::mt19937 &rng,
+                std::vector<int> &part) {
+  int64_t total_w = 0;
+  for (int v = 0; v < g0.n; ++v) total_w += g0.vwgt[v];
+  int64_t cap_w = (total_w + k - 1) / k;
+  const int coarse_enough = std::max(32, 2 * k);
+
+  // levels[0] aliases the caller's finest graph (no per-seed deep copy);
+  // only the coarse graphs are owned here
+  std::vector<const Graph *> levels{&g0};
+  std::vector<Graph> owned;
+  owned.reserve(32);  // pointers into `owned` must survive growth
+  std::vector<std::vector<int>> cmaps;
+  while (levels.back()->n > coarse_enough &&
+         owned.size() < owned.capacity()) {
+    std::vector<int> cmap;
+    Graph c = coarsen(*levels.back(), rng, cap_w, cmap);
+    if (c.n >= levels.back()->n * 95 / 100) break;  // matching stalled
+    owned.push_back(std::move(c));
+    levels.push_back(&owned.back());
+    cmaps.push_back(std::move(cmap));
+  }
+
+  // coarsest: slight cap slack lets the weighted grow place lumpy coarse
+  // vertices; the finest-level rebalance restores exactness
+  const Graph &coarsest = *levels.back();
+  int64_t slack_cap = cap_w + cap_w / 16;
+  grow_initial(coarsest, k, slack_cap, rng, part);
+  refine(coarsest, k, slack_cap, part, 4);
+
+  // uncoarsen: project through each cmap, refine at every level
+  for (int li = (int)levels.size() - 2; li >= 0; --li) {
+    const std::vector<int> &cmap = cmaps[li];
+    std::vector<int> fine(levels[li]->n);
+    for (int v = 0; v < levels[li]->n; ++v) fine[v] = part[cmap[v]];
+    part = std::move(fine);
+    int64_t cap = li == 0 ? cap_w : slack_cap;
+    if (li == 0) rebalance(*levels[0], k, cap_w, part);
+    refine(*levels[li], k, cap, part, li == 0 ? 4 : 2);
+  }
+  if (levels.size() == 1) {
+    // graph was already coarse_enough: part came from the "coarsest"
+    // stage on g0 itself under the slack cap — restore exactness
+    rebalance(g0, k, cap_w, part);
+    refine(g0, k, cap_w, part, 2);
   }
 }
 
@@ -168,20 +320,49 @@ int64_t tempi_partition(int32_t nparts, int32_t nvtx, const int64_t *xadj,
                         const int64_t *adjncy, const int64_t *adjwgt,
                         int32_t *part_out, uint64_t seed, int32_t nseeds) {
   if (nparts <= 0 || nvtx <= 0 || nparts > nvtx) return -1;
-  Csr g{nvtx, xadj, adjncy, adjwgt};
+  Graph g;
+  g.n = nvtx;
+  g.xadj.assign(xadj, xadj + nvtx + 1);
+  g.adjncy.assign(adjncy, adjncy + xadj[nvtx]);
+  if (adjwgt)
+    g.adjwgt.assign(adjwgt, adjwgt + xadj[nvtx]);
+  else
+    g.adjwgt.assign(xadj[nvtx], 1);
+  g.vwgt.assign(nvtx, 1);
+
   std::vector<int> best;
   int64_t best_cut = -1;
-  for (int s = 0; s < nseeds; ++s) {
-    std::mt19937 rng((uint32_t)(seed + s));
+  int64_t cap_w0 = (nvtx + nparts - 1) / nparts;
+  for (int s = 0; s < 2 * nseeds; ++s) {
+    // each seed value runs BOTH schemes (even s: single-level, odd s:
+    // multilevel V-cycle): multilevel dominates on structured graphs,
+    // single-level occasionally wins on dense unstructured ones, and the
+    // single-level arm reproduces the pre-multilevel candidate set
+    // exactly — so the hybrid can never return a worse cut than the old
+    // solver did for the same (seed, nseeds)
+    std::mt19937 rng((uint32_t)(seed + s / 2));
     std::vector<int> part;
-    grow_initial(g, nparts, rng, part);
-    refine(g, nparts, part, 4);
+    if (s % 2 == 1) {
+      multilevel(g, nparts, rng, part);
+    } else {
+      grow_initial(g, nparts, cap_w0, rng, part);
+      refine(g, nparts, cap_w0, part, 4);
+    }
     int64_t cut = edge_cut(g, part);
+    // exact balance is part of the contract: an unbalanced candidate
+    // loses to any balanced one regardless of cut
+    std::vector<int64_t> sizes(nparts, 0);
+    for (int v = 0; v < nvtx; ++v) sizes[part[v]]++;
+    bool balanced = true;
+    for (int p = 0; p < nparts; ++p)
+      if (sizes[p] > cap_w0) balanced = false;
+    if (!balanced) continue;
     if (best_cut < 0 || cut < best_cut) {
       best_cut = cut;
       best = part;
     }
   }
+  if (best_cut < 0) return -1;  // no balanced candidate in any seed
   for (int v = 0; v < nvtx; ++v) part_out[v] = best[v];
   return best_cut;
 }
@@ -189,9 +370,14 @@ int64_t tempi_partition(int32_t nparts, int32_t nvtx, const int64_t *xadj,
 int64_t tempi_edge_cut(int32_t nvtx, const int64_t *xadj,
                        const int64_t *adjncy, const int64_t *adjwgt,
                        const int32_t *part) {
-  Csr g{nvtx, xadj, adjncy, adjwgt};
-  std::vector<int> p(part, part + nvtx);
-  return edge_cut(g, p);
+  // read-only O(m) pass over the caller's arrays — no owning copy
+  int64_t cut = 0;
+  for (int v = 0; v < nvtx; ++v)
+    for (int64_t e = xadj[v]; e < xadj[v + 1]; ++e) {
+      int u = (int)adjncy[e];
+      if (u > v && part[u] != part[v]) cut += adjwgt ? adjwgt[e] : 1;
+    }
+  return cut;
 }
 
 }  // extern "C"

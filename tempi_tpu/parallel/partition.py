@@ -69,71 +69,237 @@ def _edge_cut(csr: Csr, part: np.ndarray) -> int:
     return int(cut)
 
 
+def _grow_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
+             rng) -> np.ndarray:
+    """Weighted greedy graph growing (native grow_initial analog): grow
+    each part from a random unassigned seed, absorbing the unassigned
+    vertex most connected to it, until the part's VERTEX WEIGHT reaches
+    its target. All-ones ``vwgt`` reproduces the unit-count behavior."""
+    n = csr.n
+    part = np.full(n, -1, dtype=np.int32)
+    order = rng.permutation(n)
+    oi = 0
+    for p in range(nparts):
+        unassigned_w = int(vwgt[part < 0].sum())
+        target = min(cap_w, max(1, -(-unassigned_w // (nparts - p))))
+        conn = np.zeros(n, dtype=np.int64)
+        while oi < n and part[order[oi]] >= 0:
+            oi += 1
+        if oi >= n:
+            break
+        cur, w = int(order[oi]), 0
+        while cur >= 0 and w < target:
+            part[cur] = p
+            w += int(vwgt[cur])
+            sl = slice(csr.xadj[cur], csr.xadj[cur + 1])
+            for u, ew in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+                if part[u] < 0:
+                    conn[u] += ew
+            conn[cur] = 0
+            fits = (part < 0) & (w + vwgt <= cap_w)
+            masked = np.where(fits, conn, 0)
+            cur = int(masked.argmax()) if masked.max() > 0 else -1
+            if cur < 0 and w < target:
+                rest = order[oi:][(part[order[oi:]] < 0)
+                                  & (w + vwgt[order[oi:]] <= cap_w)]
+                cur = int(rest[0]) if len(rest) else -1
+    wsum = np.zeros(nparts, dtype=np.int64)
+    for v in range(n):
+        if part[v] >= 0:
+            wsum[part[v]] += vwgt[v]
+    for v in np.where(part < 0)[0]:
+        p = int(wsum.argmin())
+        part[v] = p
+        wsum[p] += vwgt[v]
+    return part
+
+
+def _refine_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
+               part: np.ndarray, passes: int = 4) -> None:
+    """Greedy single moves within the weight cap (native refine analog,
+    first-improvement order)."""
+    n = csr.n
+    total_w = int(vwgt.sum())
+    # floor(total/k), matching the native bound (and, with unit weights,
+    # the pre-multilevel solver's exact move set)
+    lo_w = total_w // nparts
+    wsum = np.zeros(nparts, dtype=np.int64)
+    for v in range(n):
+        wsum[part[v]] += vwgt[v]
+    for _ in range(passes):
+        improved = False
+        for v in range(n):
+            pv = part[v]
+            if wsum[pv] - vwgt[v] < lo_w:
+                continue
+            sl = slice(csr.xadj[v], csr.xadj[v + 1])
+            gains = {}
+            internal = 0
+            for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+                if u == v:
+                    continue
+                if part[u] == pv:
+                    internal += w
+                else:
+                    gains[part[u]] = gains.get(part[u], 0) + w
+            for p, ext in gains.items():
+                if wsum[p] + vwgt[v] <= cap_w and ext - internal > 0:
+                    wsum[pv] -= vwgt[v]
+                    part[v] = p
+                    wsum[p] += vwgt[v]
+                    improved = True
+                    break
+        if not improved:
+            break
+
+
+def _coarsen_py(csr: Csr, vwgt: np.ndarray, max_vwgt: int, rng):
+    """Heavy-edge matching contraction (native coarsen analog). Returns
+    (coarse_csr, coarse_vwgt, cmap)."""
+    n = csr.n
+    match = np.full(n, -1, dtype=np.int64)
+    for v in rng.permutation(n):
+        if match[v] >= 0:
+            continue
+        sl = slice(csr.xadj[v], csr.xadj[v + 1])
+        best_u, best_w = -1, 0
+        for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+            if u == v or match[u] >= 0:
+                continue
+            if vwgt[v] + vwgt[u] > max_vwgt:
+                continue
+            if w > best_w:
+                best_u, best_w = int(u), int(w)
+        match[v] = best_u if best_u >= 0 else v
+        if best_u >= 0:
+            match[best_u] = v
+    cmap = np.full(n, -1, dtype=np.int64)
+    nc = 0
+    for v in range(n):
+        if cmap[v] >= 0:
+            continue
+        cmap[v] = nc
+        if match[v] != v:
+            cmap[match[v]] = nc
+        nc += 1
+    cvwgt = np.zeros(nc, dtype=np.int64)
+    np.add.at(cvwgt, cmap, vwgt)
+    nbr = [dict() for _ in range(nc)]
+    for v in range(n):
+        cv = int(cmap[v])
+        sl = slice(csr.xadj[v], csr.xadj[v + 1])
+        for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+            cu = int(cmap[u])
+            if cu != cv:  # self-loops are uncuttable — drop them
+                nbr[cv][cu] = nbr[cv].get(cu, 0) + int(w)
+    xadj = [0]
+    adjncy, adjwgt = [], []
+    for v in range(nc):
+        for u, w in sorted(nbr[v].items()):
+            adjncy.append(u)
+            adjwgt.append(w)
+        xadj.append(len(adjncy))
+    ccsr = Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+               np.array(adjwgt, np.int64))
+    return ccsr, cvwgt, cmap
+
+
+def _rebalance_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
+                  part: np.ndarray) -> None:
+    """Move least-damaging vertices out of overweight parts until every
+    part fits the cap (native rebalance analog)."""
+    n = csr.n
+    wsum = np.zeros(nparts, dtype=np.int64)
+    for v in range(n):
+        wsum[part[v]] += vwgt[v]
+    for _ in range(n):
+        over = int(wsum.argmax())
+        if wsum[over] <= cap_w:
+            return
+        best = None  # (gain, v, p)
+        for v in np.where(part == over)[0]:
+            sl = slice(csr.xadj[v], csr.xadj[v + 1])
+            internal = 0
+            ext = {}
+            for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+                if u == v:
+                    continue
+                if part[u] == over:
+                    internal += w
+                else:
+                    ext[part[u]] = ext.get(part[u], 0) + w
+            for p in range(nparts):
+                if p == over or wsum[p] + vwgt[v] > cap_w:
+                    continue
+                gain = ext.get(p, 0) - internal
+                if best is None or gain > best[0]:
+                    best = (gain, int(v), p)
+        if best is None:
+            return
+        _, v, p = best
+        wsum[over] -= vwgt[v]
+        part[v] = p
+        wsum[p] += vwgt[v]
+
+
+def _multilevel_py(nparts: int, csr: Csr, rng) -> np.ndarray:
+    """Multilevel V-cycle (native multilevel analog): HEM-coarsen until
+    small, weighted grow+refine at the coarsest level, project back with
+    refinement per level, exact rebalance at the finest."""
+    n = csr.n
+    cap_w = -(-n // nparts)
+    coarse_enough = max(32, 2 * nparts)
+    levels = [(csr, np.ones(n, dtype=np.int64))]
+    cmaps = []
+    while levels[-1][0].n > coarse_enough:
+        g, vw = levels[-1]
+        ccsr, cvw, cmap = _coarsen_py(g, vw, cap_w, rng)
+        if ccsr.n >= g.n * 95 // 100:
+            break
+        levels.append((ccsr, cvw))
+        cmaps.append(cmap)
+    slack_cap = cap_w + cap_w // 16
+    g, vw = levels[-1]
+    part = _grow_py(nparts, g, vw, slack_cap, rng)
+    _refine_py(nparts, g, vw, slack_cap, part)
+    for li in range(len(levels) - 2, -1, -1):
+        g, vw = levels[li]
+        part = part[cmaps[li]].astype(np.int32)
+        if li == 0:
+            _rebalance_py(nparts, g, vw, cap_w, part)
+            _refine_py(nparts, g, vw, cap_w, part, passes=4)
+        else:
+            _refine_py(nparts, g, vw, slack_cap, part, passes=2)
+    if len(levels) == 1:
+        _rebalance_py(nparts, g, vw, cap_w, part)
+        _refine_py(nparts, g, vw, cap_w, part, passes=2)
+    return part
+
+
 def _partition_py(nparts: int, csr: Csr, seed: int, nseeds: int) -> Result:
-    """Fallback: same grow+refine scheme as the native code, in numpy."""
+    """Fallback: the native solver's hybrid scheme in numpy — per seed,
+    one single-level grow+refine candidate AND one multilevel V-cycle
+    candidate, best balanced cut wins (see native/partition.cpp
+    tempi_partition)."""
     n = csr.n
     cap = -(-n // nparts)
-    lo = n // nparts
+    unit = np.ones(n, dtype=np.int64)
     best_part, best_cut = None, None
     for s in range(nseeds):
+        candidates = []
         rng = np.random.default_rng(seed + s)
-        part = np.full(n, -1, dtype=np.int32)
-        order = rng.permutation(n)
-        oi = 0
-        for p in range(nparts):
-            unassigned = int((part < 0).sum())
-            target = min(cap, max(1, -(-unassigned // (nparts - p))))
-            conn = np.zeros(n, dtype=np.int64)
-            while oi < n and part[order[oi]] >= 0:
-                oi += 1
-            if oi >= n:
-                break
-            cur, cnt = order[oi], 0
-            while cur >= 0 and cnt < target:
-                part[cur] = p
-                cnt += 1
-                sl = slice(csr.xadj[cur], csr.xadj[cur + 1])
-                for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
-                    if part[u] < 0:
-                        conn[u] += w
-                conn[cur] = 0
-                masked = np.where(part < 0, conn, 0)
-                cur = int(masked.argmax()) if masked.max() > 0 else -1
-                if cur < 0 and cnt < target:
-                    rest = order[oi:][part[order[oi:]] < 0]
-                    cur = int(rest[0]) if len(rest) else -1
-        sizes = np.bincount(part[part >= 0], minlength=nparts)
-        for v in np.where(part < 0)[0]:
-            p = int(sizes.argmin())
-            part[v] = p
-            sizes[p] += 1
-        # refinement: greedy single moves within balance
-        for _ in range(4):
-            improved = False
-            for v in range(n):
-                pv = part[v]
-                if sizes[pv] <= lo:
-                    continue
-                sl = slice(csr.xadj[v], csr.xadj[v + 1])
-                gains = {}
-                internal = 0
-                for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
-                    if part[u] == pv:
-                        internal += w
-                    else:
-                        gains[part[u]] = gains.get(part[u], 0) + w
-                for p, ext in gains.items():
-                    if sizes[p] < cap and ext - internal > 0:
-                        sizes[pv] -= 1
-                        part[v] = p
-                        sizes[p] += 1
-                        improved = True
-                        break
-            if not improved:
-                break
-        cut = _edge_cut(csr, part)
-        if best_cut is None or cut < best_cut:
-            best_part, best_cut = part.copy(), cut
+        part = _grow_py(nparts, csr, unit, cap, rng)
+        _refine_py(nparts, csr, unit, cap, part)
+        candidates.append(part)
+        candidates.append(
+            _multilevel_py(nparts, csr, np.random.default_rng(seed + s)))
+        for part in candidates:
+            counts = np.bincount(part, minlength=nparts)
+            if (counts > cap).any():
+                continue  # unbalanced candidates lose unconditionally
+            cut = _edge_cut(csr, part)
+            if best_cut is None or cut < best_cut:
+                best_part, best_cut = part.copy(), cut
     return Result(part=best_part, objective=best_cut)
 
 

@@ -59,6 +59,111 @@ def test_partition_python_fallback_matches():
     assert res.objective == 1
 
 
+def grid_csr(side):
+    """side x side unit-weight lattice — the structured family where
+    single-level FM gets stuck in local minima and multilevel shines."""
+    n = side * side
+    adj = [[] for _ in range(n)]
+    for i in range(side):
+        for j in range(side):
+            v = i * side + j
+            if i + 1 < side:
+                adj[v].append((v + side, 1))
+                adj[v + side].append((v, 1))
+            if j + 1 < side:
+                adj[v].append((v + 1, 1))
+                adj[v + 1].append((v, 1))
+    xadj = [0]
+    adjncy, adjwgt = [], []
+    for r in range(n):
+        for v, w in sorted(adj[r]):
+            adjncy.append(v)
+            adjwgt.append(w)
+        xadj.append(len(adjncy))
+    return pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                  np.array(adjwgt, np.int64))
+
+
+def sparse_csr(n, seed, density=0.3, wmax=1 << 12):
+    """Random sparse byte-count graph (the bench.py nbr32 shape)."""
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(1, wmax, (n, n))
+    counts[rng.random((n, n)) > density] = 0
+    np.fill_diagonal(counts, 0)
+    W = counts + counts.T
+    xadj, adjncy, adjwgt = [0], [], []
+    for v in range(n):
+        nb = np.flatnonzero(W[v])
+        adjncy.extend(int(u) for u in nb)
+        adjwgt.extend(int(w) for w in W[v, nb])
+        xadj.append(len(adjncy))
+    return pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                  np.array(adjwgt, np.int64))
+
+
+# edge cuts of the pre-multilevel (single-level greedy-grow + FM,
+# best-of-20-seeds) native solver at seed=0, measured 2026-07-31 — the
+# multilevel hybrid keeps the single-level candidate set, so it must
+# never do worse on any of these (VERDICT r4 item 5)
+_SINGLE_LEVEL_CUTS = {
+    ("grid16", 8): 75,
+    ("sparse32", 4): 336936,
+    ("sparse256", 8): 5505106,
+}
+
+
+def _needs_native():
+    from tempi_tpu.native import build as native_build
+    if native_build.load() is None:
+        pytest.skip("no native toolchain: baselines below were measured "
+                    "with the C++ solver (the numpy fallback's "
+                    "single-level arm has no pairwise-swap pass and "
+                    "measures looser cuts)")
+
+
+def test_multilevel_never_worse_than_single_level():
+    _needs_native()
+    cases = {
+        ("grid16", 8): grid_csr(16),
+        ("sparse32", 4): sparse_csr(32, 1),
+        ("sparse256", 8): sparse_csr(256, 3, density=0.06),
+    }
+    for (label, k), csr in cases.items():
+        res = pm.partition(k, csr, seed=0, nseeds=20)
+        assert pm.is_balanced(res, k), label
+        assert res.objective <= _SINGLE_LEVEL_CUTS[(label, k)], \
+            f"{label} k={k}: {res.objective} > single-level " \
+            f"{_SINGLE_LEVEL_CUTS[(label, k)]}"
+
+
+def test_multilevel_improves_structured_256v():
+    """The 256-vertex structured case from the round-4 review: multilevel
+    coarsening must beat the measured single-level cut on the pod-scale
+    lattice (A/B 2026-07-31: grid16x16 k=16 single-level 128 ->
+    multilevel hybrid 126; at 1024 vertices grid32x32 k=16 measured
+    294 -> 264, +10.2%)."""
+    _needs_native()
+    res = pm.partition(16, grid_csr(16), seed=0, nseeds=20)
+    assert pm.is_balanced(res, 16)
+    assert res.objective < 128  # the measured single-level cut
+
+
+def test_python_fallback_multilevel_components():
+    """The numpy fallback mirrors the native multilevel scheme: coarsen
+    halves the graph, projection preserves vertex count, and the hybrid
+    stays balanced with a sane cut on the lattice."""
+    csr = grid_csr(16)
+    vwgt = np.ones(csr.n, dtype=np.int64)
+    ccsr, cvw, cmap = pm._coarsen_py(csr, vwgt, 32,
+                                     np.random.default_rng(0))
+    assert ccsr.n < csr.n
+    assert int(cvw.sum()) == csr.n  # weight conserved
+    assert len(cmap) == csr.n and cmap.max() == ccsr.n - 1
+    res = pm._partition_py(8, csr, seed=0, nseeds=5)
+    assert pm.is_balanced(res, 8)
+    assert res.objective <= 110  # single-level py fallback measured ~>86
+
+
 def test_make_placement_greedy_slots(monkeypatch):
     monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
     from tempi_tpu.utils import env as envmod
