@@ -89,7 +89,9 @@ class Request:
     def wait(self) -> None:
         wait(self)
 
-    def test(self, progress: bool = True) -> bool:
+    def test(self, progress=True) -> bool:
+        # progress: True (bounded), "full" (unbounded), False (pure query)
+        # — see the module-level test() for the cost model
         return test(self, progress=progress)
 
 
@@ -321,12 +323,23 @@ def _block_length(m: Message) -> int:
     return m.nbytes
 
 
-def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
+def try_progress(comm: Communicator, strategy: Optional[str] = None,
+                 compiled_only: bool = False) -> int:
     """Execute every currently-matched message set; leave unmatched ops
     pending (reference: async::try_progress pumping on each call). The
     per-comm lock serializes against the background progress pump; even the
     empty-pending fast path must take it, so a waiter blocks behind a pump
-    thread that is mid-exchange instead of concluding "never posted"."""
+    thread that is mid-exchange instead of concluding "never posted".
+
+    ``compiled_only`` bounds the work: only matched groups whose plan is
+    already cached with compiled programs dispatch; first-use groups stay
+    pending for wait()/waitall()/the pump — EXCEPT that once deferred work
+    has been observed on ``_POLL_ESCALATE`` consecutive bounded calls, the
+    call runs one full attempt (the MPI progress rule: repeated MPI_Test
+    on a matched message must eventually complete it, even when steady
+    compiled traffic would otherwise keep starving the deferred group).
+    The streak bookkeeping lives under the progress lock — concurrent
+    pollers must not lose increments of the escalation counter."""
     with comm._progress_lock:
         if not comm._pending:
             return 0
@@ -336,14 +349,83 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None) -> int:
         messages, consumed, leftover = _match(comm._pending)
         if not messages:
             return 0
-        comm._pending = leftover
-        _execute_matched(comm, messages, consumed, strategy)
+        groups = None
+        if compiled_only:
+            groups = _group_by_strategy(comm, messages, strategy)
+            keep, kept_groups = [], {}
+            for strat, idxs in groups.items():
+                if _plan_compiled(comm, [messages[j] for j in idxs], strat):
+                    kept_groups[strat] = list(
+                        range(len(keep), len(keep) + len(idxs)))
+                    keep.extend(idxs)
+            if len(keep) < len(messages):
+                # deferred (uncompiled) work exists: bump the escalation
+                # streak; at the threshold, run everything THIS call
+                streak = comm.__dict__.get("_poll_streak", 0) + 1
+                if streak >= _POLL_ESCALATE:
+                    comm.__dict__["_poll_streak"] = 0
+                    comm._pending = leftover
+                    _execute_matched(comm, messages, consumed, strategy,
+                                     groups=groups)
+                    return len(messages)
+                comm.__dict__["_poll_streak"] = streak
+            else:
+                comm.__dict__["_poll_streak"] = 0
+            if not keep:
+                return 0
+            kept_ops = [op for i in keep
+                        for op in (consumed[2 * i], consumed[2 * i + 1])]
+            messages = [messages[i] for i in keep]
+            consumed = kept_ops
+            groups = kept_groups
+            comm._pending = [op for op in comm._pending
+                             if all(op is not c for c in kept_ops)]
+        else:
+            comm._pending = leftover
+            comm.__dict__["_poll_streak"] = 0  # full attempt clears deferral
+        _execute_matched(comm, messages, consumed, strategy, groups=groups)
         return len(messages)
+
+
+def _group_by_strategy(comm: Communicator, messages,
+                       strategy: Optional[str]) -> Dict[str, List[int]]:
+    """Message indices grouped by per-message strategy (the decision cache
+    makes repeated choices for the same shape free)."""
+    groups: Dict[str, List[int]] = {}
+    for i, m in enumerate(messages):
+        s = strategy or choose_strategy_message(comm, m)
+        groups.setdefault(s, []).append(i)
+    return groups
+
+
+def _plan_compiled(comm: Communicator, batch, strat: str) -> bool:
+    """True when the exchange plan for ``batch`` is cached AND its
+    ``strat`` path's programs have been built — i.e. dispatching it is
+    bounded work (no fresh XLA compile). Building a throwaway ExchangePlan
+    for the signature is pure Python (round scheduling), never a
+    compile."""
+    from . import plan as planmod
+
+    probe = planmod.ExchangePlan(comm, batch)
+    cached = planmod.cache_get(comm, probe.signature())
+    if cached is None:
+        return False
+    if strat == "device":
+        return cached._device_fn is not None
+    kind = "pinned_host" if strat == "oneshot" else None
+    if cached._round_fns.get(kind):
+        return True
+    # the device programs only substitute when run() will actually take
+    # the degrade-to-device path — otherwise run_staged would build (and
+    # compile) fresh round programs on the polling thread
+    return (cached._must_degrade_to_device()
+            and cached._device_fn is not None)
 
 
 def _execute_matched(comm: Communicator, messages, consumed,
                      strategy: Optional[str],
-                     plans_out: Optional[List] = None) -> None:
+                     plans_out: Optional[List] = None,
+                     groups: Optional[Dict[str, List[int]]] = None) -> None:
     """Group matched messages by per-message strategy and run one compiled
     plan per group (messages[i] pairs with consumed[2i], consumed[2i+1]).
     Caller holds the progress lock. ``plans_out``, when given, collects
@@ -354,11 +436,10 @@ def _execute_matched(comm: Communicator, messages, consumed,
     never turn done, and a waiter that acquires the lock the instant this
     frame unwinds must see the cause, not conclude "peer never posted".
     Scoped to this batch so an unrelated later deadlock still gets the
-    deadlock diagnosis."""
-    groups: Dict[str, List[int]] = {}
-    for i, m in enumerate(messages):
-        s = strategy or choose_strategy_message(comm, m)
-        groups.setdefault(s, []).append(i)
+    deadlock diagnosis. ``groups`` (index lists into ``messages``) skips
+    re-choosing strategies when the caller already grouped."""
+    if groups is None:
+        groups = _group_by_strategy(comm, messages, strategy)
     order = list(groups.items())
     for gi, (strat, idxs) in enumerate(order):
         batch = [messages[i] for i in idxs]
@@ -405,8 +486,30 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
         req.buf = None
 
 
+# test()/testall() progress opt-in for the pre-bounding behavior: compile
+# AND dispatch everything matched, not just already-compiled plans
+FULL_PROGRESS = "full"
+
+# after N consecutive bounded progress calls that observed (and deferred)
+# uncompiled matched work, one full attempt runs: keeps the MPI progress
+# rule (repeated MPI_Test on a matched message MUST eventually complete
+# it, even with no wait() anywhere and even when steady compiled traffic
+# keeps dispatching) while amortizing the compile cliff to at most one in
+# N polls
+_POLL_ESCALATE = 8
+
+
+def _poll_progress(comm: Communicator, strategy: Optional[str],
+                   progress) -> None:
+    """One test()/testall()-mode progress attempt: bounded (compiled
+    plans only, with try_progress's internal escalation valve) by
+    default; unbounded when ``progress`` is FULL_PROGRESS."""
+    try_progress(comm, strategy,
+                 compiled_only=progress != FULL_PROGRESS)
+
+
 def test(req: Request, strategy: Optional[str] = None,
-         progress: bool = True) -> bool:
+         progress=True) -> bool:
     """MPI_Test analog: nonblocking completion query. The reference's async
     engine is poll-based — wake() advances the state machine with
     cudaEventQuery/MPI_Test and never blocks (async_operation.cpp:154-194);
@@ -417,15 +520,25 @@ def test(req: Request, strategy: Optional[str] = None,
     simply "not yet" — False, never the deadlock error wait() raises,
     because MPI_Test on a not-yet-matched request is legal polling.
 
-    COST NOTE: the default progress attempt is UNBOUNDED work — it may
-    plan, compile (first use), and dispatch every currently-matched
-    exchange on the polling thread (MPI_Test is likewise allowed to
-    progress). A tight polling loop that must stay cheap passes
-    ``progress=False``: a pure completion query (at most one pooled event
-    query, nothing dispatched) — the natural mode when the background
-    progress pump (TEMPI_PROGRESS_THREAD) owns dispatching."""
+    COST NOTE (three progress modes):
+      * ``progress=True`` (default) — BOUNDED: dispatches only matched
+        exchanges whose plan is already compiled; a first-use exchange's
+        multi-second XLA compile stays off the polling thread (round-4
+        review's cost-cliff foot-gun) EXCEPT that after
+        ``_POLL_ESCALATE`` consecutive bounded attempts that had to
+        defer uncompiled work, one full attempt runs — the MPI progress
+        rule demands repeated MPI_Test eventually complete a matched
+        message even when nothing else drives progress (and even when
+        steady compiled traffic keeps the poll "fruitful").
+      * ``progress="full"`` — the unbounded attempt on every call: may
+        plan, compile, and dispatch every currently-matched exchange
+        (MPI_Test is allowed to progress this much; opt-in).
+      * ``progress=False`` — a pure completion query (at most one pooled
+        event query, nothing dispatched) — the natural mode when the
+        background progress pump (TEMPI_PROGRESS_THREAD) owns
+        dispatching."""
     if not req.done and progress:
-        try_progress(req.comm, strategy)
+        _poll_progress(req.comm, strategy, progress)
     if not req.done:
         if req.error is not None:
             raise RuntimeError(
@@ -451,11 +564,13 @@ def _buf_ready(buf: DistBuffer) -> bool:
 
 
 def testall(reqs, strategy: Optional[str] = None,
-            progress: bool = True) -> bool:
+            progress=True) -> bool:
     """MPI_Testall analog: True only when EVERY request is complete, and
     only then are the requests' completion events considered drained (a
     False return leaves each request individually testable/waitable).
-    ``progress=False`` is the bounded-work pure query (see test())."""
+    Progress modes as in test(): default True dispatches only
+    already-compiled plans, ``"full"`` is the unbounded attempt,
+    False is the pure query."""
     if not all(r.done for r in reqs):
         if progress:
             # one progress attempt per DISTINCT communicator (a batch may
@@ -464,7 +579,7 @@ def testall(reqs, strategy: Optional[str] = None,
             for r in reqs:
                 if not r.done and all(r.comm is not c for c in seen):
                     seen.append(r.comm)
-                    try_progress(r.comm, strategy)
+                    _poll_progress(r.comm, strategy, progress)
         # the error check runs in BOTH modes: a bounded polling loop
         # (progress=False, pump owns dispatch) must surface an engine
         # failure, not spin on False forever
@@ -558,18 +673,21 @@ class PersistentRequest:
     def wait(self) -> None:
         waitall_persistent([self])
 
-    def test(self, progress: bool = True) -> bool:
+    def test(self, progress=True) -> bool:
         """MPI_Test on an active persistent request: True completes the
         active instance (the request becomes inactive and startable again,
         like a successful MPI_Test); False leaves it active. Raising on an
         engine failure mirrors wait(): the failed instance is withdrawn and
         the request returns to the inactive, restartable state.
-        ``progress=False`` is the bounded-work pure query (see test())."""
+        Progress modes as in the module-level test(): True (default) is
+        the bounded compiled-plans-only attempt — a batch whose first
+        start fell back to the eager engine must not compile on a polling
+        thread — "full" is unbounded, False is a pure completion query."""
         act = self.active
         if act is None:
             raise RuntimeError("test() on an inactive persistent request")
         if not act.done and progress:
-            try_progress(self.comm)
+            _poll_progress(self.comm, None, progress)
         if not act.done:
             if act.error is not None:
                 with self.comm._progress_lock:
