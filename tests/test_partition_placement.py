@@ -333,3 +333,40 @@ def test_dist_graph_torus_reorder(monkeypatch):
                                           np.full(16, r, np.uint8))
     finally:
         api.finalize()
+
+
+def test_partition_fuzz_invariants():
+    """Randomized graphs: every returned partition is balanced, its
+    objective equals an independent edge-cut recount, the native and
+    numpy solvers agree on the metric (not necessarily the partition),
+    and k edge cases (k=1, k=n) hold."""
+    rng = np.random.default_rng(99)
+    for trial in range(12):
+        n = int(rng.integers(4, 40))
+        k = int(rng.integers(1, n + 1))
+        density = float(rng.uniform(0.05, 0.6))
+        W = rng.integers(1, 1000, (n, n))
+        W[rng.random((n, n)) > density] = 0
+        W = W + W.T
+        np.fill_diagonal(W, 0)
+        xadj, adjncy, adjwgt = [0], [], []
+        for v in range(n):
+            nb = np.flatnonzero(W[v])
+            adjncy.extend(int(u) for u in nb)
+            adjwgt.extend(int(w) for w in W[v, nb])
+            xadj.append(len(adjncy))
+        csr = pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                     np.array(adjwgt, np.int64))
+        res = pm.partition(k, csr, seed=trial, nseeds=4)
+        assert pm.is_balanced(res, k), (trial, n, k)
+        assert res.objective == pm._edge_cut(csr, res.part), (trial, n, k)
+        if k == 1:
+            assert res.objective == 0
+        if k == n:
+            # every vertex its own part: cut = total edge weight
+            assert res.objective == int(W.sum()) // 2
+        # the numpy fallback honors the same contract on the same graph
+        if trial % 4 == 0:
+            resp = pm._partition_py(k, csr, seed=trial, nseeds=2)
+            assert pm.is_balanced(resp, k)
+            assert resp.objective == pm._edge_cut(csr, resp.part)
