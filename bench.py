@@ -318,6 +318,52 @@ def bench_halo(jax, n_devices: int, quick: bool, engine: bool = False,
     return (1.0 / med, f"X={X0} ranks={comm.size} periodic={periodic}", ph)
 
 
+def bench_ring_attention(jax, quick: bool):
+    """Fused sequence-parallel attention step: iterations/s and achieved
+    TFLOP/s. On one chip the ring degenerates to local blockwise
+    attention — still the MXU-utilization data point (two [S,S]x[S,D]
+    matmul families per head per step); on >= 2 devices the same program
+    overlaps the K/V ppermute with compute."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.models import ring_attention as ra
+    from tempi_tpu.parallel.communicator import Communicator
+
+    world = api.comm_world()
+    ndev = min(len(world.devices), 8)
+    comm = Communicator(world.devices[:ndev])
+    s_local, H, D = (256, 2, 64) if quick else (4096, 8, 128)
+    S = s_local * comm.size
+    rng = np.random.default_rng(11)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tempi_tpu.parallel.communicator import AXIS
+
+    # pre-shard ONCE: ring_attention's device_put is then a no-op in the
+    # timed loop — otherwise every iteration pays a full reshard of all
+    # three global arrays and the number measures transfer, not MXU
+    sh = NamedSharding(comm.mesh, P(AXIS, None, None))
+    mk = lambda: jax.device_put(jnp.asarray(  # noqa: E731
+        rng.standard_normal((S, H, D)), jnp.bfloat16), sh)
+    q, k, v = mk(), mk(), mk()
+    out = ra.ring_attention(comm, q, k, v)
+    out.block_until_ready()
+    iters = 3 if quick else 20
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        ra.ring_attention(comm, q, k, v).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    med = _median_of(times)
+    # 2 matmuls (QK^T and PV), 2 FLOPs per MAC, over the FULL S x S score
+    # matrix per head (exact attention)
+    flops = 2 * 2 * (S ** 2) * H * D
+    return 1.0 / med, flops / med / 1e12, f"S={S} H={H} D={D} bf16 " \
+                                          f"ranks={comm.size}"
+
+
 def bench_alltoallv_sparse(jax, quick: bool, reorder: bool):
     """Random sparse alltoallv time, optionally after the KaHIP remap
     (BASELINE configs 4/5 shape). Needs >= 8 devices to mean anything."""
@@ -554,6 +600,16 @@ def _collect_device_metrics(jax, devices, quick: bool, emit) -> None:
     except Exception as e:
         print(f"halo engine A/B failed: {e!r}", file=sys.stderr)
         emit({"halo_engine_iters_per_s": None})
+    try:
+        # long-context flagship: fused ring-attention step (MXU number)
+        ra_ips, ra_tflops, ra_cfg = bench_ring_attention(jax, quick)
+        emit({"ring_attn_steps_per_s": round(ra_ips, 2),
+              "ring_attn_tflops": round(ra_tflops, 3),
+              "ring_attn_config": ra_cfg})
+    except Exception as e:
+        print(f"ring attention failed: {e!r}", file=sys.stderr)
+        emit({"ring_attn_steps_per_s": None, "ring_attn_tflops": None,
+              "ring_attn_config": "failed"})
     # the reference's other two judged pack targets
     # (bin/bench_mpi_pack.cpp:127): 1 MiB and 1 KiB objects. Small
     # objects are dispatch-bound, so more packs ride one dispatch — the
@@ -1100,6 +1156,9 @@ def main() -> int:
                          ("halo_config_x512", "missing"),
                          ("halo_engine_iters_per_s", None),
                          ("halo_config", "missing"),
+                         ("ring_attn_steps_per_s", None),
+                         ("ring_attn_tflops", None),
+                         ("ring_attn_config", "missing"),
                          ("alltoallv_sparse_s", None),
                          ("alltoallv_sparse_remap_s", None),
                          ("pack_gbs_4m", None),

@@ -1,1 +1,2 @@
 from . import halo3d  # noqa: F401
+from . import ring_attention  # noqa: F401
