@@ -85,14 +85,21 @@ def _causal_mask(q_start, k_start, lq, lk):
 
 
 def ring_attention(comm: Communicator, q, k, v, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   block_k: Optional[int] = None):
     """Exact sequence-parallel attention; one fused program.
 
     ``q``, ``k``, ``v`` are GLOBAL arrays of shape [S, H, D] sharded (or
     shardable) along the sequence axis over ``comm``'s mesh; returns the
     attention output with the same global shape and sharding. S must
     divide evenly by comm.size (pad upstream — a ragged final block would
-    force dynamic shapes on the MXU path)."""
+    force dynamic shapes on the MXU path).
+
+    ``block_k`` chunks each ring step's LOCAL key block into key tiles of
+    that many rows (must divide the local length): scores materialize as
+    [H, S/size, block_k] instead of [H, S/size, S/size] — the flash-style
+    memory bound that makes truly long local sequences feasible. None
+    processes the whole local block at once (fastest for short blocks)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -103,15 +110,22 @@ def ring_attention(comm: Communicator, q, k, v, causal: bool = False,
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
     lq = S // size
+    if block_k is not None and (block_k <= 0 or lq % block_k):
+        raise ValueError(f"block_k {block_k} must divide the local "
+                         f"sequence {lq}")
+    if block_k is not None and block_k >= lq:
+        block_k = None  # whole-block tiling IS the untiled program —
+        #                 share its cache entry instead of recompiling
     sh = NamedSharding(comm.mesh, P(AXIS, None, None))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     fn = _fused_ring_fn(comm, size, lq, H, D, bool(causal), float(scale),
-                        str(q.dtype))
+                        str(q.dtype), block_k)
     return fn(q, k, v)
 
 
 def _fused_ring_fn(comm: Communicator, size: int, lq: int, H: int, D: int,
-                   causal: bool, scale: float, dtype: str):
+                   causal: bool, scale: float, dtype: str,
+                   block_k: Optional[int] = None):
     """Compiled fused ring program, cached per (shape, flags) ON the
     communicator — the ring structure is static, so recompiling per call
     would waste the MPI-analog economics (commit once, replay forever),
@@ -119,7 +133,7 @@ def _fused_ring_fn(comm: Communicator, size: int, lq: int, H: int, D: int,
     Communicators and their XLA executables across init/finalize
     cycles)."""
     cache = comm.__dict__.setdefault("_ring_attn_fns", {})
-    key = (size, lq, H, D, causal, scale, dtype)
+    key = (size, lq, H, D, causal, scale, dtype, block_k)
     hit = cache.get(key)
     if hit is not None:
         return hit
@@ -137,13 +151,33 @@ def _fused_ring_fn(comm: Communicator, size: int, lq: int, H: int, D: int,
         l = jnp.zeros((lq, H), jnp.float32)
         o = jnp.zeros((lq, H, D), jnp.float32)
 
+        def accumulate(k_blk, v_blk, src, m, l, o):
+            if block_k is None or block_k >= lq:
+                mask = (_causal_mask(q_start, src * lq, lq, lq)
+                        if causal else None)
+                return _block_attn(ql, k_blk, v_blk, m, l, o, scale, mask)
+            # flash-style inner tiling: scores bounded at [H, lq, block_k]
+            nc = lq // block_k
+            kc = k_blk.reshape(nc, block_k, H, D)
+            vc = v_blk.reshape(nc, block_k, H, D)
+
+            def inner(carry, xs):
+                m, l, o = carry
+                kt, vt, j = xs
+                mask = (_causal_mask(q_start, src * lq + j * block_k,
+                                     lq, block_k) if causal else None)
+                m, l, o = _block_attn(ql, kt, vt, m, l, o, scale, mask)
+                return (m, l, o), None
+
+            (m, l, o), _ = jax.lax.scan(
+                inner, (m, l, o), (kc, vc, jnp.arange(nc)))
+            return m, l, o
+
         def step(carry, i):
             k_blk, v_blk, m, l, o = carry
             # the block arriving at step i started life on rank - i
             src = (rank - i) % size
-            mask = (_causal_mask(q_start, src * lq, lq, lq)
-                    if causal else None)
-            m, l, o = _block_attn(ql, k_blk, v_blk, m, l, o, scale, mask)
+            m, l, o = accumulate(k_blk, v_blk, src, m, l, o)
             # rotate AFTER compute: XLA schedules the collective-permute
             # of the next block concurrently with this step's matmuls
             k_blk = jax.lax.ppermute(k_blk, AXIS, perm)

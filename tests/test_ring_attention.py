@@ -87,3 +87,24 @@ def test_fused_program_is_cached(world):
     f2 = ra._fused_ring_fn(world, world.size, S // world.size, H, D,
                            False, 0.5, "float32")
     assert f1 is f2
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_ring_block_k_tiling(world, causal):
+    """The flash-style inner key tiling (block_k) computes the identical
+    result — scores never materialize beyond [H, lq, block_k]."""
+    S, H, D = 64, 2, 16
+    q, k, v = _rand_qkv(S, H, D, seed=13)
+    full = np.asarray(ra.ring_attention(world, q, k, v, causal=causal))
+    tiled = np.asarray(ra.ring_attention(world, q, k, v, causal=causal,
+                                         block_k=4))  # lq=8 -> 2 tiles
+    np.testing.assert_allclose(tiled, full, rtol=2e-6, atol=2e-6)
+    want = ra.ring_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(tiled, want, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_ring_block_k_validation(world):
+    S = world.size * 8
+    q, k, v = _rand_qkv(S, 1, 4)
+    with pytest.raises(ValueError, match="block_k"):
+        ra.ring_attention(world, q, k, v, block_k=3)  # 3 does not divide 8
