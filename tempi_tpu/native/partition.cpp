@@ -178,8 +178,12 @@ void refine(const Graph &g, int k, int64_t cap_w, std::vector<int> &part,
 // heavy-edge matching contraction: each unmatched vertex (random visit
 // order) pairs with its heaviest-edge unmatched neighbor whose combined
 // weight still fits in a part. cmap maps fine -> coarse vertex.
+// ``within`` (optional, iterated V-cycles) restricts matching to pairs in
+// the same part, so the coarse graph REPRESENTS the current partition and
+// refining its projection can only improve it.
 Graph coarsen(const Graph &g, std::mt19937 &rng, int64_t max_vwgt,
-              std::vector<int> &cmap) {
+              std::vector<int> &cmap,
+              const std::vector<int> *within = nullptr) {
   std::vector<int> order(g.n), match(g.n, -1);
   for (int i = 0; i < g.n; ++i) order[i] = i;
   std::shuffle(order.begin(), order.end(), rng);
@@ -191,6 +195,7 @@ Graph coarsen(const Graph &g, std::mt19937 &rng, int64_t max_vwgt,
       int u = (int)g.adjncy[e];
       if (u == v || match[u] >= 0) continue;
       if (g.vwgt[v] + g.vwgt[u] > max_vwgt) continue;
+      if (within && (*within)[u] != (*within)[v]) continue;
       if (g.adjwgt[e] > best_w) { best_w = g.adjwgt[e]; best_u = u; }
     }
     match[v] = best_u >= 0 ? best_u : v;
@@ -279,7 +284,8 @@ void multilevel(const Graph &g0, int k, std::mt19937 &rng,
          owned.size() < owned.capacity()) {
     std::vector<int> cmap;
     Graph c = coarsen(*levels.back(), rng, cap_w, cmap);
-    if (c.n >= levels.back()->n * 95 / 100) break;  // matching stalled
+    if ((int64_t)c.n * 100 >= (int64_t)levels.back()->n * 95)
+      break;  // matching stalled (int64: n * 95 overflows int32 at ~22M)
     owned.push_back(std::move(c));
     levels.push_back(&owned.back());
     cmaps.push_back(std::move(cmap));
@@ -308,6 +314,29 @@ void multilevel(const Graph &g0, int k, std::mt19937 &rng,
     rebalance(g0, k, cap_w, part);
     refine(g0, k, cap_w, part, 2);
   }
+}
+
+// iterated V-cycle (the kaffpa-style repetition): coarsen with matching
+// RESTRICTED to same-part pairs — the coarse graph then represents the
+// current partition exactly (projection is a no-op on the cut) — refine
+// the projection at the coarse level where FM moves whole clusters, and
+// refine again on the way back down. The cut can only improve: every
+// intermediate state starts from the current partition.
+void vcycle_refine(const Graph &g0, int k, std::mt19937 &rng,
+                   std::vector<int> &part) {
+  int64_t total_w = 0;
+  for (int v = 0; v < g0.n; ++v) total_w += g0.vwgt[v];
+  int64_t cap_w = (total_w + k - 1) / k;
+  std::vector<int> cmap;
+  Graph c = coarsen(g0, rng, cap_w, cmap, &part);
+  if ((int64_t)c.n * 100 >= (int64_t)g0.n * 95 || c.n <= k)
+    return;  // nothing contracted (int64: see the multilevel guard)
+  std::vector<int> cpart(c.n, -1);
+  for (int v = 0; v < g0.n; ++v) cpart[cmap[v]] = part[v];
+  refine(c, k, cap_w, cpart, 4);
+  for (int v = 0; v < g0.n; ++v) part[v] = cpart[cmap[v]];
+  rebalance(g0, k, cap_w, part);
+  refine(g0, k, cap_w, part, 2);
 }
 
 }  // namespace
@@ -348,18 +377,27 @@ int64_t tempi_partition(int32_t nparts, int32_t nvtx, const int64_t *xadj,
       grow_initial(g, nparts, cap_w0, rng, part);
       refine(g, nparts, cap_w0, part, 4);
     }
-    int64_t cut = edge_cut(g, part);
-    // exact balance is part of the contract: an unbalanced candidate
-    // loses to any balanced one regardless of cut
-    std::vector<int64_t> sizes(nparts, 0);
-    for (int v = 0; v < nvtx; ++v) sizes[part[v]]++;
-    bool balanced = true;
-    for (int p = 0; p < nparts; ++p)
-      if (sizes[p] > cap_w0) balanced = false;
-    if (!balanced) continue;
-    if (best_cut < 0 || cut < best_cut) {
-      best_cut = cut;
-      best = part;
+    // iterated V-cycle polish (restricted-matching re-coarsen + refine);
+    // kept only when it strictly improves the cut, so the candidate set
+    // still dominates the pre-multilevel solver's
+    std::vector<int> polished = part;
+    vcycle_refine(g, nparts, rng, polished);
+    if (polished == part) polished.clear();  // no-op polish: score once
+    for (std::vector<int> *cand : {&part, &polished}) {
+      if (cand->empty()) continue;
+      int64_t cut = edge_cut(g, *cand);
+      // exact balance is part of the contract: an unbalanced candidate
+      // loses to any balanced one regardless of cut
+      std::vector<int64_t> sizes(nparts, 0);
+      for (int v = 0; v < nvtx; ++v) sizes[(*cand)[v]]++;
+      bool balanced = true;
+      for (int p = 0; p < nparts; ++p)
+        if (sizes[p] > cap_w0) balanced = false;
+      if (!balanced) continue;
+      if (best_cut < 0 || cut < best_cut) {
+        best_cut = cut;
+        best = *cand;
+      }
     }
   }
   if (best_cut < 0) return -1;  // no balanced candidate in any seed

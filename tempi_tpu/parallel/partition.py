@@ -152,10 +152,43 @@ def _refine_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
         if not improved:
             break
 
+    def _gain(v, p):
+        sl = slice(csr.xadj[v], csr.xadj[v + 1])
+        g = 0
+        for u, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+            if u == v:
+                continue
+            if part[u] == part[v]:
+                g -= w
+            elif part[u] == p:
+                g += w
+        return g
 
-def _coarsen_py(csr: Csr, vwgt: np.ndarray, max_vwgt: int, rng):
+    # equal-weight pairwise swap pass (native refine parity): catches the
+    # relabelings exact balance forbids single moves from reaching
+    for _ in range(passes):
+        improved = False
+        for v in range(n):
+            for u in range(v + 1, n):
+                if part[u] == part[v] or vwgt[u] != vwgt[v]:
+                    continue
+                gain = _gain(v, part[u]) + _gain(u, part[v])
+                sl = slice(csr.xadj[v], csr.xadj[v + 1])
+                for uu, w in zip(csr.adjncy[sl], csr.adjwgt[sl]):
+                    if uu == u:  # the (u,v) edge counted as gain twice
+                        gain -= 2 * w
+                if gain > 0:
+                    part[v], part[u] = part[u], part[v]
+                    improved = True
+        if not improved:
+            break
+
+
+def _coarsen_py(csr: Csr, vwgt: np.ndarray, max_vwgt: int, rng,
+                within: Optional[np.ndarray] = None):
     """Heavy-edge matching contraction (native coarsen analog). Returns
-    (coarse_csr, coarse_vwgt, cmap)."""
+    (coarse_csr, coarse_vwgt, cmap). ``within`` restricts matching to
+    same-part pairs (iterated V-cycles)."""
     n = csr.n
     match = np.full(n, -1, dtype=np.int64)
     for v in rng.permutation(n):
@@ -167,6 +200,8 @@ def _coarsen_py(csr: Csr, vwgt: np.ndarray, max_vwgt: int, rng):
             if u == v or match[u] >= 0:
                 continue
             if vwgt[v] + vwgt[u] > max_vwgt:
+                continue
+            if within is not None and within[u] != within[v]:
                 continue
             if w > best_w:
                 best_u, best_w = int(u), int(w)
@@ -276,11 +311,32 @@ def _multilevel_py(nparts: int, csr: Csr, rng) -> np.ndarray:
     return part
 
 
+def _vcycle_refine_py(nparts: int, csr: Csr, part: np.ndarray,
+                      rng) -> np.ndarray:
+    """Iterated V-cycle polish (native vcycle_refine analog): re-coarsen
+    with matching restricted to same-part pairs, refine the projection
+    at the coarse level (FM moves whole clusters there), refine again at
+    the finest. Returns a new candidate; caller keeps the better cut."""
+    n = csr.n
+    cap = -(-n // nparts)
+    unit = np.ones(n, dtype=np.int64)
+    ccsr, cvw, cmap = _coarsen_py(csr, unit, cap, rng, within=part)
+    if ccsr.n >= n * 95 // 100 or ccsr.n <= nparts:
+        return part
+    cpart = np.full(ccsr.n, -1, dtype=np.int32)
+    cpart[cmap] = part
+    _refine_py(nparts, ccsr, cvw, cap, cpart, passes=4)
+    out = cpart[cmap].astype(np.int32)
+    _rebalance_py(nparts, csr, unit, cap, out)
+    _refine_py(nparts, csr, unit, cap, out, passes=2)
+    return out
+
+
 def _partition_py(nparts: int, csr: Csr, seed: int, nseeds: int) -> Result:
     """Fallback: the native solver's hybrid scheme in numpy — per seed,
     one single-level grow+refine candidate AND one multilevel V-cycle
-    candidate, best balanced cut wins (see native/partition.cpp
-    tempi_partition)."""
+    candidate, each polished by an iterated V-cycle, best balanced cut
+    wins (see native/partition.cpp tempi_partition)."""
     n = csr.n
     cap = -(-n // nparts)
     unit = np.ones(n, dtype=np.int64)
@@ -293,6 +349,11 @@ def _partition_py(nparts: int, csr: Csr, seed: int, nseeds: int) -> Result:
         candidates.append(part)
         candidates.append(
             _multilevel_py(nparts, csr, np.random.default_rng(seed + s)))
+        # a no-op polish returns the SAME object — don't re-score it
+        candidates.extend(
+            [p for c in candidates
+             for p in (_vcycle_refine_py(nparts, csr, c, rng),)
+             if p is not c])
         for part in candidates:
             counts = np.bincount(part, minlength=nparts)
             if (counts > cap).any():

@@ -370,3 +370,22 @@ def test_partition_fuzz_invariants():
             resp = pm._partition_py(k, csr, seed=trial, nseeds=2)
             assert pm.is_balanced(resp, k)
             assert resp.objective == pm._edge_cut(csr, resp.part)
+
+
+def test_vcycle_polish_improves_bad_partition():
+    """The iterated V-cycle polish (restricted-matching re-coarsen +
+    coarse-level refine) must strictly improve a deliberately interleaved
+    partition of the two-cliques graph, and the full solver's result on
+    the pod-scale lattice must reflect the polish (the pre-polish hybrid
+    measured 126 at this config; with the V-cycle it measured 121)."""
+    csr = two_cliques_csr()
+    bad = np.array([0, 1, 0, 1, 0, 1, 0, 1], np.int32)
+    before = pm._edge_cut(csr, bad)
+    out = pm._vcycle_refine_py(2, csr, bad, np.random.default_rng(0))
+    assert pm.is_balanced(pm.Result(out, 0), 2)
+    assert pm._edge_cut(csr, out) < before, \
+        "V-cycle polish failed to improve an interleaved partition"
+    _needs_native()
+    res = pm.partition(16, grid_csr(16), seed=0, nseeds=20)
+    assert res.objective <= 123, \
+        f"polish regressed: {res.objective} (pre-polish hybrid was 126)"
