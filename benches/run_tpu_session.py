@@ -62,8 +62,10 @@ def bench(label="bench") -> bool:
     # (2026-07-31 03:53, two metrics kept of a full line)
     env = dict(os.environ, TEMPI_BENCH_FORCE="tpu")
     env.setdefault("TEMPI_BENCH_INACTIVITY_S", "900")
-    env.setdefault("TEMPI_BENCH_OVERALL_S", "2700")
-    return _run([sys.executable, "bench.py"], 3600, label, env=env)
+    # round-5 capture added sections (halo x512 + phase splits + ring
+    # attention + 4m incount): allow the extra cold compiles
+    env.setdefault("TEMPI_BENCH_OVERALL_S", "3300")
+    return _run([sys.executable, "bench.py"], 4200, label, env=env)
 
 
 def measure() -> bool:
