@@ -1,0 +1,90 @@
+"""Sequencing contract of the serialized TPU session driver.
+
+benches/run_tpu_session.py is what the recovery watcher executes against
+real hardware; a sequencing bug there wastes an unpredictable tunnel
+window. These tests pin the step machine without touching any device:
+ordinary failure and wedge-timeout both stop the session, the tune step
+is best-effort, and the default step order is the armed agenda.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benches"))
+
+import run_tpu_session as rts  # noqa: E402
+
+
+@pytest.fixture()
+def calls(monkeypatch):
+    seen = []
+
+    def mk(name, result=True):
+        def step():
+            seen.append(name)
+            return step.result
+
+        step.result = result
+        return step
+
+    steps = {n: mk(n) for n in rts.ORDER}
+    monkeypatch.setattr(rts, "STEPS", steps)
+    return seen, steps
+
+
+def _main(argv):
+    old = sys.argv
+    sys.argv = ["run_tpu_session.py"] + argv
+    try:
+        return rts.main()
+    finally:
+        sys.argv = old
+
+
+def test_default_runs_full_agenda_in_order(calls):
+    seen, _ = calls
+    assert _main([]) == 0
+    assert seen == rts.ORDER
+
+
+def test_probe_timeout_stops_everything(calls):
+    seen, steps = calls
+    steps["probe"].result = "timeout"
+    assert _main([]) == 1
+    assert seen == ["probe"], "a wedged probe must not start the bench"
+
+
+def test_bench_failure_stops_before_measure(calls):
+    seen, steps = calls
+    steps["bench"].result = False
+    assert _main([]) == 1
+    assert seen == ["probe", "bench"]
+
+
+def test_tune_is_best_effort(calls):
+    seen, steps = calls
+    steps["tune"].result = False
+    assert _main([]) == 0, "a tune failure must not forfeit bench2"
+    assert seen == rts.ORDER
+
+
+def test_tune_timeout_is_also_non_fatal(calls):
+    seen, steps = calls
+    steps["tune"].result = "timeout"
+    assert _main([]) == 0
+    assert seen == rts.ORDER
+
+
+def test_subset_of_steps_respected(calls):
+    seen, _ = calls
+    assert _main(["probe", "bench2"]) == 0
+    assert seen == ["probe", "bench2"]
+
+
+def test_unknown_step_names_ignored(calls):
+    seen, _ = calls
+    assert _main(["nonsense"]) == 0
+    assert seen == rts.ORDER  # falls back to the full agenda
