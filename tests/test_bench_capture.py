@@ -155,3 +155,43 @@ def test_hang_exposed_metrics_run_last(bench_mod, monkeypatch):
                     "pinned_host_landed", "alltoallv_sparse_s"):
         assert order.index(earlier) < pp, \
             f"{earlier} must run before the hang-exposed pingpong block"
+
+
+def test_pack_discipline_promotion(bench_mod, monkeypatch):
+    """The winning pack discipline becomes the headline: when the incount
+    form measures faster, pack_gbs_{4m,1m,1k} (and the top-level pack_gbs
+    + batch_k for 4m) are re-pointed at it, the unrolled figure is
+    preserved, and the discipline is labeled. When unroll wins, the
+    headline stays put."""
+    m = bench_mod
+
+    def fake_pack(jax, devices, quick, nblocks=8192, batch_k=8,
+                  incount=False):
+        # incount wins for 4m (nblocks 8192) and 1k (nblocks 2); unroll
+        # wins for 1m (nblocks 2048)
+        if nblocks == 2048:
+            return 100.0 if not incount else 80.0
+        return 50.0 if not incount else 200.0
+
+    monkeypatch.setattr(m, "bench_pack", fake_pack)
+    monkeypatch.setattr(m, "bench_pingpong_nd",
+                        lambda *a, **k: (1e-6, "self", None, {}))
+    monkeypatch.setattr(m, "bench_halo", lambda *a, **k: (1.0, "cfg", {}))
+    monkeypatch.setattr(m, "bench_alltoallv_sparse", lambda *a, **k: 0.1)
+    monkeypatch.setattr(m, "bench_ring_attention",
+                        lambda *a, **k: (1.0, 0.1, "cfg"))
+    monkeypatch.setattr(m, "_model_evidence", lambda: {})
+    monkeypatch.setattr(m, "_pinned_host_probe", lambda jax, dev: True)
+    monkeypatch.setattr(m, "_tuned_pack", lambda: {})
+    merged = {}
+    m._collect_device_metrics(None, [None], True, merged.update)
+    assert merged["pack_gbs_4m"] == 200.0  # promoted
+    assert merged["pack_gbs"] == 200.0     # judged headline follows
+    assert merged["pack_gbs_4m_unroll"] == 50.0
+    assert merged["pack_4m_discipline"] == "incount"
+    assert merged["batch_k"] == merged["pack_incount_k_4m"]
+    assert merged["pack_gbs_1k"] == 200.0
+    assert merged["pack_1k_discipline"] == "incount"
+    assert merged["pack_gbs_1m"] == 100.0  # unroll kept
+    assert merged["pack_1m_discipline"] == "unroll"
+    assert "pack_gbs_1m_unroll" not in merged
