@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Sequence-parallel ring attention benchmark (long-context flagship).
+
+No reference analog (the reference is an MPI interposer with no attention
+anywhere in its tree); this measures the framework's own long-context
+model: the fused shard_map+scan ring program (ppermute K/V rotation +
+online-softmax accumulation) and, optionally, the engine path rotating
+[K;V] through persistent p2p requests — the same fused-vs-engine A/B as
+the halo bench. Reports steps/s and achieved TFLOP/s (exact attention:
+2 matmuls x 2 FLOPs/MAC over the full S x S score matrix per head).
+
+Usage: python benches/bench_ring_attention.py [--cpu] [--quick]
+           [--seq 4096] [--heads 8] [--dim 128] [--block-k 1024]
+           [--causal] [--engine] [--iters 20]
+"""
+
+import sys
+import time
+
+from _common import base_parser, devices_or_die, emit_csv, setup_platform
+
+
+def main() -> int:
+    p = base_parser("sequence-parallel ring attention")
+    p.add_argument("--seq", type=int, default=4096,
+                   help="LOCAL sequence rows per rank")
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--block-k", type=int, default=1024,
+                   help="flash-style inner key tile (0 = untiled)")
+    p.add_argument("--causal", action="store_true")
+    p.add_argument("--engine", action="store_true",
+                   help="also run the persistent-p2p rotation path A/B")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    setup_platform(args)
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tempi_tpu import api
+    from tempi_tpu.models import ring_attention as ra
+    from tempi_tpu.parallel.communicator import AXIS
+
+    devices = devices_or_die()
+    comm = api.init(devices)
+    try:
+        size = comm.size
+        s_local = args.seq if not args.quick else min(args.seq, 256)
+        H, D = args.heads, args.dim
+        S = s_local * size
+        bk = args.block_k or None
+        if bk and s_local % bk:
+            bk = None
+        rng = np.random.default_rng(11)
+        sh = NamedSharding(comm.mesh, P(AXIS, None, None))
+        mk = lambda: jax.device_put(jnp.asarray(  # noqa: E731
+            rng.standard_normal((S, H, D)), jnp.bfloat16), sh)
+        q, k, v = mk(), mk(), mk()
+        ra.ring_attention(comm, q, k, v, causal=args.causal,
+                          block_k=bk).block_until_ready()
+        iters = args.iters if not args.quick else 3
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ra.ring_attention(comm, q, k, v, causal=args.causal,
+                              block_k=bk).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        med = times[len(times) // 2]
+        flops = 2 * 2 * (S ** 2) * H * D
+        if args.causal:
+            flops //= 2  # half the score matrix is masked
+        rows = [(S, size, H, D, bk or 0, int(args.causal), "fused",
+                 round(med * 1e3, 3), round(1.0 / med, 2),
+                 round(flops / med / 1e12, 3))]
+        if args.engine:
+            eng = ra.RingAttention(comm, s_local, H, D,
+                                   causal=args.causal)
+            q_rows = [np.asarray(q[r * s_local:(r + 1) * s_local],
+                                 np.float32) for r in range(size)]
+            k_rows = [np.asarray(k[r * s_local:(r + 1) * s_local],
+                                 np.float32) for r in range(size)]
+            v_rows = [np.asarray(v[r * s_local:(r + 1) * s_local],
+                                 np.float32) for r in range(size)]
+            t0 = time.perf_counter()
+            eng.run(q_rows, k_rows, v_rows)
+            et = time.perf_counter() - t0
+            rows.append((S, size, H, D, 0, int(args.causal), "engine",
+                         round(et * 1e3, 3), round(1.0 / et, 2),
+                         round(flops / et / 1e12, 3)))
+        emit_csv(("S", "ranks", "heads", "dim", "block_k", "causal",
+                  "path", "ms_per_step", "steps_per_s", "tflops"), rows)
+    finally:
+        api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
