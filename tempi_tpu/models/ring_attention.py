@@ -99,7 +99,13 @@ def ring_attention(comm: Communicator, q, k, v, causal: bool = False,
     that many rows (must divide the local length): scores materialize as
     [H, S/size, block_k] instead of [H, S/size, S/size] — the flash-style
     memory bound that makes truly long local sequences feasible. None
-    processes the whole local block at once (fastest for short blocks)."""
+    processes the whole local block at once (fastest for short blocks).
+
+    Sequence blocks follow LIBRARY (mesh-position) rank order: global
+    row r*S/size + i lives on mesh position r, and causal masking uses
+    those positions. On a placement-reordered communicator the app-rank
+    permutation does not apply here — attention has no per-rank identity
+    to translate, only sequence order."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
