@@ -389,3 +389,41 @@ def test_vcycle_polish_improves_bad_partition():
     res = pm.partition(16, grid_csr(16), seed=0, nseeds=20)
     assert res.objective <= 123, \
         f"polish regressed: {res.objective} (pre-polish hybrid was 126)"
+
+
+def test_process_mapping_fuzz_invariants():
+    """Randomized graphs and torus shapes: process_mapping always returns
+    a valid permutation whose objective never exceeds the identity
+    placement's (the never-worse-than-identity guarantee survives the
+    iterated-local-search kicks)."""
+    from tempi_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(123)
+    for trial in range(6):
+        shape = [(4, 2), (2, 2, 2), (8, 4)][trial % 3]
+        n = int(np.prod(shape))
+        coords = [tuple(map(int, np.unravel_index(i, shape)))
+                  for i in range(n)]
+        topo = Topology([0] * n, [list(range(n))], coords=coords,
+                        torus_dims=shape)
+        dist = topo.distance_matrix()
+        W = rng.integers(0, 500, (n, n))
+        W[rng.random((n, n)) > 0.4] = 0
+        W = W + W.T
+        np.fill_diagonal(W, 0)
+        xadj, adjncy, adjwgt = [0], [], []
+        for v in range(n):
+            nb = np.flatnonzero(W[v])
+            adjncy.extend(int(u) for u in nb)
+            adjwgt.extend(int(w) for w in W[v, nb])
+            xadj.append(len(adjncy))
+        csr = pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                     np.array(adjwgt, np.int64))
+        slot_of, obj = pm.process_mapping(csr, dist, seed=trial)
+        assert sorted(slot_of) == list(range(n)), (trial, slot_of)
+        Wd = pm._dense_weights(csr)
+        ident = int((Wd * dist).sum() // 2)
+        assert obj <= ident, f"trial {trial}: {obj} > identity {ident}"
+        # objective self-consistency
+        D = dist[np.ix_(slot_of, slot_of)]
+        assert obj == int((Wd * D).sum() // 2)
