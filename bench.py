@@ -1009,6 +1009,20 @@ def _tuned_pack() -> dict:
         return {}
 
 
+def _apply_tuned_split(environ) -> bool:
+    """Export the 4m tuning winner's DMA split into ``environ`` — must
+    run BEFORE any tempi_tpu.ops import (the split knob is read at
+    pack-module import). An explicit operator-set TEMPI_PACK_SPLIT wins.
+    Returns True when the tuned split was applied."""
+    tuned = _tuned_pack()
+    best = tuned.get("4m") or {}
+    split = best.get("split")
+    if split and "TEMPI_PACK_SPLIT" not in environ:
+        environ["TEMPI_PACK_SPLIT"] = str(int(split))
+        return True
+    return False
+
+
 def _device_bench_child() -> int:
     """Child mode: every accelerator-bound metric, streamed as one JSON
     line per completed metric. Run in a subprocess because a tunnel that
@@ -1017,12 +1031,7 @@ def _device_bench_child() -> int:
     evidence) instead of hanging and forfeiting the whole capture."""
     import os
 
-    # apply the tuned DMA split BEFORE any tempi_tpu.ops import (the
-    # split knob is read at pack-module import); explicit env wins
-    tuned = _tuned_pack()
-    split = tuned.get("4m", {}).get("split")
-    if split and "TEMPI_PACK_SPLIT" not in os.environ:
-        os.environ["TEMPI_PACK_SPLIT"] = str(split)
+    _apply_tuned_split(os.environ)
 
     import jax
 

@@ -195,3 +195,38 @@ def test_pack_discipline_promotion(bench_mod, monkeypatch):
     assert merged["pack_gbs_1m"] == 100.0  # unroll kept
     assert merged["pack_1m_discipline"] == "unroll"
     assert "pack_gbs_1m_unroll" not in merged
+
+
+def test_tuned_split_env_application(bench_mod, monkeypatch, tmp_path):
+    """The 4m tuning winner's DMA split is exported before pack-module
+    import; an operator-set TEMPI_PACK_SPLIT wins; non-TPU or malformed
+    winners never apply (they are filtered by _tuned_pack)."""
+    m = bench_mod
+    win = {"4m": {"shape": "4m", "mode": "unroll", "split": 16,
+                  "batch_k": 8, "gbs": 500.0, "platform": "tpu"}}
+    monkeypatch.setattr(m, "_tuned_pack", lambda: win)
+    env = {}
+    assert m._apply_tuned_split(env) is True
+    assert env["TEMPI_PACK_SPLIT"] == "16"
+    # operator override wins
+    env = {"TEMPI_PACK_SPLIT": "2"}
+    assert m._apply_tuned_split(env) is False
+    assert env["TEMPI_PACK_SPLIT"] == "2"
+    # no winner -> no export
+    monkeypatch.setattr(m, "_tuned_pack", lambda: {})
+    env = {}
+    assert m._apply_tuned_split(env) is False
+    assert env == {}
+    # the real file filter, driven through _tuned_pack itself: CPU-stamped
+    # winners and malformed entries are invisible; TPU winners pass
+    monkeypatch.undo()
+    import json as _json
+    (tmp_path / "TUNE_PACK.json").write_text(_json.dumps(
+        {"4m": {"split": 8, "platform": "cpu"},
+         "1m": ["garbage"],
+         "1k": {"split": 1, "batch_k": 4096, "mode": "incount",
+                "platform": "tpu"}}))
+    m.__file__ = str(tmp_path / "bench.py")  # _tuned_pack resolves by it
+    tuned = m._tuned_pack()
+    assert "4m" not in tuned and "1m" not in tuned
+    assert tuned["1k"]["batch_k"] == 4096
