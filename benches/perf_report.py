@@ -108,6 +108,24 @@ def main() -> int:
                        for (i, j), t in corners.items())
         print(f"{name}: {ni}x{nj}, {sent} sentinel  {cs}")
 
+    tune_path = os.path.join(REPO, "TUNE_PACK.json")
+    if os.path.exists(tune_path):
+        try:
+            with open(tune_path) as f:
+                tuned = json.load(f)
+            if isinstance(tuned, dict):
+                print("\npack tuning winners (TUNE_PACK.json; applied "
+                      "by the judged capture):")
+                for shape in sorted(tuned):
+                    b = tuned[shape]
+                    if isinstance(b, dict):
+                        print(f"  {shape}: {b.get('mode')} split="
+                              f"{b.get('split')} K={b.get('batch_k')} "
+                              f"-> {b.get('gbs')} GB/s "
+                              f"[{b.get('platform', '?')}]")
+        except Exception as e:
+            print(f"TUNE_PACK.json unreadable: {e!r}")
+
     msys.set_system(sp)
     # the winner columns mirror the chooser's arms exactly (p2p.py): a
     # STRIDED message's AUTO compares device vs oneshot pack paths; a
