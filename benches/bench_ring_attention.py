@@ -26,8 +26,9 @@ def main() -> int:
                    help="LOCAL sequence rows per rank")
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--dim", type=int, default=128)
-    p.add_argument("--block-k", type=int, default=1024,
-                   help="flash-style inner key tile (0 = untiled)")
+    p.add_argument("--block-k", type=int, default=None,
+                   help="flash-style inner key tile (0 = untiled; default "
+                        "auto: 1024 when it divides the local sequence)")
     p.add_argument("--causal", action="store_true")
     p.add_argument("--engine", action="store_true",
                    help="also run the persistent-p2p rotation path A/B")
@@ -52,9 +53,18 @@ def main() -> int:
         s_local = args.seq if not args.quick else min(args.seq, 256)
         H, D = args.heads, args.dim
         S = s_local * size
-        bk = args.block_k or None
-        if bk and s_local % bk:
-            bk = None
+        if args.block_k is None:
+            bk = 1024 if s_local % 1024 == 0 else None  # auto default
+        else:
+            bk = args.block_k or None
+            if bk and s_local % bk:
+                # an EXPLICIT tile silently coerced to untiled would
+                # report a config that did not run (the CSV row would
+                # claim --block-k while the untiled kernel executed) —
+                # refuse instead of misattributing the numbers
+                p.error(f"--block-k {bk} does not divide the local "
+                        f"sequence {s_local} (use 0 for untiled, or a "
+                        f"divisor of {s_local})")
         rng = np.random.default_rng(11)
         sh = NamedSharding(comm.mesh, P(AXIS, None, None))
         mk = lambda: jax.device_put(jnp.asarray(  # noqa: E731

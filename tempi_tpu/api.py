@@ -29,6 +29,10 @@ def init(devices=None) -> Communicator:
     if _world is not None:
         return _world
     envmod.read_environment()
+    from .runtime import faults
+    faults.configure()  # arm TEMPI_FAULTS after the env parse; a bad
+    # spec fails init loudly (a chaos run that silently tests nothing
+    # is worse than no chaos run)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -247,6 +251,8 @@ isend = p2p.isend
 irecv = p2p.irecv
 wait = p2p.wait
 waitall = p2p.waitall
+cancel = p2p.cancel
+WaitTimeout = p2p.WaitTimeout
 test = p2p.test
 testall = p2p.testall
 Request = p2p.Request

@@ -15,6 +15,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..runtime import faults
+from ..utils import compat
 from ..utils import logging as log
 from . import system as msys
 from .benchmark import benchmark
@@ -63,29 +65,6 @@ _INC = None
 _HOST_READ_BROKEN = [False]
 
 
-def _call_with_timeout(fn, timeout_s: float):
-    """Run ``fn()`` on a daemon thread; "timeout" if it does not finish in
-    ``timeout_s`` (the thread is abandoned — it is blocked in C where no
-    Python timeout can reach), the exception if it raised, else True."""
-    import threading
-
-    done = threading.Event()
-    err = []
-
-    def run():
-        try:
-            fn()
-        except Exception as e:  # noqa: BLE001 — report, don't crash
-            err.append(e)
-        finally:
-            done.set()
-
-    threading.Thread(target=run, daemon=True).start()
-    if not done.wait(timeout_s):
-        return "timeout"
-    return err[0] if err else True
-
-
 def _probe_host_reads(fn, what: str, timeout_s: float = 120.0,
                       fatal: bool = True) -> bool:
     """One guarded ``fn()`` before handing a device-to-host read to the
@@ -95,7 +74,7 @@ def _probe_host_reads(fn, what: str, timeout_s: float = 120.0,
     through a curve — return False so the caller keeps the partial curve
     instead of freezing the sweep. Callers must warm any compiles first —
     the timeout must cover only the read."""
-    res = _call_with_timeout(fn, timeout_s)
+    res = faults.call_with_timeout(fn, timeout_s)
     if res == "timeout":
         _HOST_READ_BROKEN[0] = True
         if fatal:
@@ -108,6 +87,42 @@ def _probe_host_reads(fn, what: str, timeout_s: float = 120.0,
         return False
     if isinstance(res, Exception):
         raise res
+    return True
+
+
+def _capture_section(sp, name: str, fn, ckpt=None) -> bool:
+    """Run one sweep section capture under the ``sweep.section`` fault
+    site with graceful degradation: on ANY failure (injected or real) the
+    section's prior curves are RESTORED — a half-captured curve must not
+    replace a healthy sheet's — the section is recorded in
+    ``measured_conditions["unmeasured_sections"]``, and the sweep
+    continues with the remaining sections instead of forfeiting them.
+    ``ckpt`` re-persists the restored sheet so a mid-section cell
+    checkpoint cannot strand a partial grid on disk. A later sweep sees
+    the section still empty/dirty and simply retries it (the list entry
+    is cleared on a clean capture). Returns True on a clean capture."""
+    import copy
+
+    prior = copy.deepcopy(getattr(sp, name))
+    try:
+        if faults.ENABLED:
+            faults.check("sweep.section")
+        fn()
+    except Exception as e:
+        setattr(sp, name, prior)
+        unm = sp.measured_conditions.setdefault("unmeasured_sections", [])
+        if name not in unm:
+            unm.append(name)
+        log.warn(f"sweep section {name!r} faulted mid-capture; prior "
+                 f"curves kept, section marked unmeasured: {e!r}")
+        if ckpt is not None:
+            ckpt()
+        return False
+    unm = sp.measured_conditions.get("unmeasured_sections")
+    if unm and name in unm:
+        unm.remove(name)
+        if not unm:
+            del sp.measured_conditions["unmeasured_sections"]
     return True
 
 
@@ -183,8 +198,21 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     # session's curves must not overwrite their provenance with its own
     # (worse) RTT, or the next healthy session would see a degraded stamp
     # and needlessly wipe already-healthy curves.
-    stamping = (not sp.measured_conditions.get("dispatch_rtt_us")
-                or any(not getattr(sp, k) for k in _RTT_SENSITIVE))
+    # Sections UNMEASURABLE in this session don't count: a single-process
+    # run (no cross-process pair) can only capture the staged stand-in
+    # for inter_node_pingpong, so an empty real-DCN section must not let
+    # a degraded single-process resume restamp a healthy sheet.
+    pair = _cross_process_pair(jax.devices())
+    measurable = [k for k in _RTT_SENSITIVE
+                  if k != "inter_node_pingpong" or pair is not None]
+    # snapshot for the all-captures-faulted case at the end of the sweep:
+    # if every RTT-sensitive section this run set out to measure faults
+    # mid-capture (their prior curves are restored), the sheet's curves
+    # are still the prior session's and must keep the prior stamp
+    prior_stamp = {k: sp.measured_conditions.get(k)
+                   for k in ("dispatch_rtt_us", "notes", "captured_at")}
+    missing_before = [k for k in measurable if not getattr(sp, k)]
+    stamping = bool(not prior_stamp["dispatch_rtt_us"] or missing_before)
     if stamping:
         sp.measured_conditions.update(
             dispatch_rtt_us=round(rtt * 1e6, 1),
@@ -215,45 +243,55 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
     host_alloc = allocators.host_allocator()
 
     if not sp.d2h:
-        # read a fresh array per call (see _fresh): a repeated
-        # np.asarray(buf) times jax's cached host copy, not the transfer
-        for nb in _transfer_sizes(quick):
-            scratch = dev_alloc.allocate(nb)
-            buf = jax.device_put(scratch, device)
-            _fresh(buf).block_until_ready()  # warm compile device-side
-            # probe EVERY size (not just the first): a size-dependent
-            # D2H hang at MiB scale would otherwise freeze benchmark()
-            # with no watchdog; a mid-curve hang keeps the partial curve
-            if not _probe_host_reads(lambda: np.asarray(_fresh(buf)),
-                                     f"d2h {nb}B", fatal=not sp.d2h):
+        def _sec_d2h():
+            # read a fresh array per call (see _fresh): a repeated
+            # np.asarray(buf) times jax's cached host copy, not the transfer
+            for nb in _transfer_sizes(quick):
+                scratch = dev_alloc.allocate(nb)
+                buf = jax.device_put(scratch, device)
+                _fresh(buf).block_until_ready()  # warm compile device-side
+                # probe EVERY size (not just the first): a size-dependent
+                # D2H hang at MiB scale would otherwise freeze benchmark()
+                # with no watchdog; a mid-curve hang keeps the partial curve
+                if not _probe_host_reads(lambda: np.asarray(_fresh(buf)),
+                                         f"d2h {nb}B", fatal=not sp.d2h):
+                    dev_alloc.release(scratch)
+                    break
+                r = benchmark(lambda: np.asarray(_fresh(buf)), **kw)
+                sp.d2h.append((nb, r.trimean))
                 dev_alloc.release(scratch)
-                break
-            r = benchmark(lambda: np.asarray(_fresh(buf)), **kw)
-            sp.d2h.append((nb, r.trimean))
-            dev_alloc.release(scratch)
+
+        _capture_section(sp, "d2h", _sec_d2h, ckpt=_ckpt)
         _ckpt()
         log.debug(f"d2h: {len(sp.d2h)} points")
 
     if not sp.h2d:
-        for nb in _transfer_sizes(quick):
-            host = dev_alloc.allocate(nb)
-            r = benchmark(
-                lambda: jax.device_put(host, device).block_until_ready(),
-                **kw)
-            sp.h2d.append((nb, r.trimean))
-            dev_alloc.release(host)
+        def _sec_h2d():
+            for nb in _transfer_sizes(quick):
+                host = dev_alloc.allocate(nb)
+                r = benchmark(
+                    lambda: jax.device_put(host, device).block_until_ready(),
+                    **kw)
+                sp.h2d.append((nb, r.trimean))
+                dev_alloc.release(host)
+
+        _capture_section(sp, "h2d", _sec_h2d, ckpt=_ckpt)
         _ckpt()
         log.debug(f"h2d: {len(sp.h2d)} points")
 
     if not sp.host_pingpong:
-        for nb in _transfer_sizes(quick):
-            a = host_alloc.allocate(nb)
-            b = host_alloc.allocate(nb)
-            # host->host round trip (reference intra-node CPU pingpong)
-            r = benchmark(lambda: (np.copyto(b, a), np.copyto(a, b)), **kw)
-            sp.host_pingpong.append((nb, r.trimean))
-            host_alloc.release(a)
-            host_alloc.release(b)
+        def _sec_host_pp():
+            for nb in _transfer_sizes(quick):
+                a = host_alloc.allocate(nb)
+                b = host_alloc.allocate(nb)
+                # host->host round trip (reference intra-node CPU pingpong)
+                r = benchmark(lambda: (np.copyto(b, a), np.copyto(a, b)),
+                              **kw)
+                sp.host_pingpong.append((nb, r.trimean))
+                host_alloc.release(a)
+                host_alloc.release(b)
+
+        _capture_section(sp, "host_pingpong", _sec_host_pp, ckpt=_ckpt)
         _ckpt()
 
     if not sp.intra_node_pingpong:
@@ -262,8 +300,12 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
         # would record dispatch-only garbage
         devs = jax.local_devices()
         if len(devs) >= 2:
-            sp.intra_node_pingpong = _pingpong_curve(devs, quick, kw)
-            sp.measured_conditions["intra_node_mode"] = "2dev-mesh"
+            def _sec_intra():
+                sp.intra_node_pingpong = _pingpong_curve(devs, quick, kw)
+                sp.measured_conditions["intra_node_mode"] = "2dev-mesh"
+
+            _capture_section(sp, "intra_node_pingpong", _sec_intra,
+                             ckpt=_ckpt)
         else:
             # single local device (the judged 1-chip box): without a curve
             # model_direct_1d is infinite and the contiguous AUTO path
@@ -277,13 +319,19 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             # an on-chip copy and the stand-in is the honest local cost.
             log.debug("single local device: measuring self-ppermute "
                       "stand-in for the intra-node pingpong curve")
-            sp.intra_node_pingpong = _self_pingpong_curve(devs[0], quick, kw)
-            # understates true ICI latency (no inter-chip hop) — a sheet
-            # reader must be able to tell this curve is a 1-chip proxy
-            sp.measured_conditions["intra_node_mode"] = "self-ppermute-proxy"
+
+            def _sec_intra_self():
+                sp.intra_node_pingpong = _self_pingpong_curve(devs[0],
+                                                              quick, kw)
+                # understates true ICI latency (no inter-chip hop) — a
+                # sheet reader must be able to tell it's a 1-chip proxy
+                sp.measured_conditions["intra_node_mode"] = \
+                    "self-ppermute-proxy"
+
+            _capture_section(sp, "intra_node_pingpong", _sec_intra_self,
+                             ckpt=_ckpt)
         _ckpt()
 
-    pair = _cross_process_pair(jax.devices())
     if pair is not None:
         # a REAL process (DCN) boundary exists: measure the collective over
         # it — the analog of the reference's inter-node GPU-GPU pingpong
@@ -299,18 +347,27 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
 
         needs = np.asarray([0 if sp.inter_node_pingpong else 1])
         if int(mhu.process_allgather(needs).max()):
-            curve = _pingpong_curve(pair, quick, kw, lockstep=True)
-            arr = np.asarray(curve, dtype=np.float64)
-            src = getattr(pair[0], "process_index", 0)
-            arr = np.asarray(mhu.broadcast_one_to_all(
-                arr, is_source=jax.process_index() == src))
-            sp.inter_node_pingpong = [(int(b), float(t)) for b, t in arr]
+            def _sec_inter():
+                curve = _pingpong_curve(pair, quick, kw, lockstep=True)
+                arr = np.asarray(curve, dtype=np.float64)
+                src = getattr(pair[0], "process_index", 0)
+                arr = np.asarray(mhu.broadcast_one_to_all(
+                    arr, is_source=jax.process_index() == src))
+                sp.inter_node_pingpong = [(int(b), float(t))
+                                          for b, t in arr]
+
+            _capture_section(sp, "inter_node_pingpong", _sec_inter,
+                             ckpt=_ckpt)
             _ckpt()
     elif not sp.inter_node_pingpong:
-        # single-process: the staged D2H->host->H2D path stands in
-        # (measuring same-host ICI would overestimate DCN badly)
-        sp.inter_node_pingpong = _staged_pingpong_curve(
-            jax.devices(), quick, kw)
+        def _sec_inter_staged():
+            # single-process: the staged D2H->host->H2D path stands in
+            # (measuring same-host ICI would overestimate DCN badly)
+            sp.inter_node_pingpong = _staged_pingpong_curve(
+                jax.devices(), quick, kw)
+
+        _capture_section(sp, "inter_node_pingpong", _sec_inter_staged,
+                         ckpt=_ckpt)
         _ckpt()
     if sp.inter_node_pingpong:
         log.debug(f"inter_node_pingpong: {len(sp.inter_node_pingpong)} points")
@@ -345,19 +402,37 @@ def measure_all(sp: Optional[SystemPerformance] = None, quick: bool = False,
             setattr(sp, _name, partial)
             _ckpt()
 
-        setattr(sp, name,
-                _pack_grid(device, is_unpack, to_host, quick, kw,
-                           prior=prior if prior and len(prior) == ni
-                           else None,
-                           on_cell=_cell_ckpt if checkpoint else None))
+        def _sec_grid(name=name, is_unpack=is_unpack, to_host=to_host,
+                      prior=prior, _cell_ckpt=_cell_ckpt):
+            setattr(sp, name,
+                    _pack_grid(device, is_unpack, to_host, quick, kw,
+                               prior=prior if prior and len(prior) == ni
+                               else None,
+                               on_cell=_cell_ckpt if checkpoint else None))
+
+        _capture_section(sp, name, _sec_grid, ckpt=_ckpt)
         _ckpt()
         log.debug(f"{name}: grid measured")
 
     if stamping:
-        # per the SystemPerformance docstring: the time the LAST section
-        # was measured, not the sweep's start
-        sp.measured_conditions["captured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%S%z")
+        if (prior_stamp["dispatch_rtt_us"]
+                and not any(getattr(sp, k) for k in missing_before)):
+            # every RTT-sensitive capture this run attempted faulted and
+            # was rolled back: the sheet's curves are still the prior
+            # session's, so restore its stamp — this session's (possibly
+            # degraded) RTT must not become their provenance
+            for k, v in prior_stamp.items():
+                if v is None:
+                    sp.measured_conditions.pop(k, None)
+                else:
+                    sp.measured_conditions[k] = v
+            log.warn("all RTT-sensitive captures faulted this session; "
+                     "keeping the prior sheet's RTT stamp")
+        else:
+            # per the SystemPerformance docstring: the time the LAST
+            # section was measured, not the sweep's start
+            sp.measured_conditions["captured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z")
         _ckpt()
     msys.set_system(sp)
     return sp
@@ -463,7 +538,7 @@ def _pingpong_curve(devs, quick, kw, lockstep: bool = False):
         y = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])
         return jax.lax.ppermute(y, "p", [(0, 1), (1, 0)])
 
-    fn = jax.jit(jax.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
+    fn = jax.jit(compat.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
                                out_specs=P("p", None), check_vma=False))
     iters = kw.get("max_samples") or (10 if quick else 30)
     for nb in _transfer_sizes(quick):
@@ -499,7 +574,7 @@ def _self_pingpong_curve(device, quick, kw):
         y = jax.lax.ppermute(x, "p", [(0, 0)])
         return jax.lax.ppermute(y, "p", [(0, 0)])
 
-    fn = jax.jit(jax.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
+    fn = jax.jit(compat.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
                                out_specs=P("p", None), check_vma=False))
     curve = []
     for nb in _transfer_sizes(quick):
@@ -634,7 +709,7 @@ def _pack_grid(device, is_unpack, to_host, quick, kw, prior=None,
                     # probe ONE call under a timeout before handing the
                     # cell to the benchmark loop: a hung device-to-host
                     # read blocks in C forever and would freeze the sweep
-                    probe = _call_with_timeout(fn, 120.0)
+                    probe = faults.call_with_timeout(fn, 120.0)
                     if probe == "timeout":
                         log.warn("host-read probe hung >120s; sentineling "
                                  "this and all remaining host-grid cells")

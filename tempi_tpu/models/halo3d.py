@@ -21,6 +21,7 @@ from ..ops import dtypes as dt
 from ..parallel import p2p
 from ..parallel.communicator import AXIS, Communicator, DistBuffer
 from ..parallel.dist_graph import dist_graph_create_adjacent
+from ..utils import compat
 from ..utils import logging as log
 
 Box = Tuple[Tuple[int, int, int], Tuple[int, int, int]]  # (lo, hi) exclusive
@@ -326,7 +327,7 @@ class HaloExchange:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        sm = jax.shard_map(self._stencil_body(), mesh=self.comm.mesh,
+        sm = compat.shard_map(self._stencil_body(), mesh=self.comm.mesh,
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
         from ..parallel.plan import donation_argnums
@@ -406,7 +407,7 @@ class HaloExchange:
             (out,) = plan._step_body(plan.rounds, (data,))
             return body(out) if body is not None else out
 
-        sm = jax.shard_map(step, mesh=self.comm.mesh,
+        sm = compat.shard_map(step, mesh=self.comm.mesh,
                            in_specs=P(AXIS, None), out_specs=P(AXIS, None),
                            check_vma=False)
         fn = jax.jit(sm, donate_argnums=donation_argnums(1))

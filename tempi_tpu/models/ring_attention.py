@@ -33,6 +33,7 @@ from typing import Optional
 import numpy as np
 
 from ..parallel.communicator import AXIS, Communicator
+from ..utils import compat
 from ..utils import logging as log
 
 __all__ = ["ring_attention", "ring_attention_reference", "RingAttention"]
@@ -198,7 +199,7 @@ def _fused_ring_fn(comm: Communicator, size: int, lq: int, H: int, D: int,
         out = o / jnp.where(l == 0.0, 1.0, l)[:, :, None]
         return out.astype(dtype)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         local, mesh=comm.mesh,
         in_specs=(P(AXIS, None, None),) * 3,
         out_specs=P(AXIS, None, None), check_vma=False)

@@ -33,6 +33,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import dtypes, type_cache
 from ..ops.dtypes import Datatype
+from ..runtime import faults
+from ..utils import compat
 from ..utils import env as envmod
 from ..utils import logging as log
 from ..utils.env import AlltoallvMethod
@@ -198,7 +200,7 @@ def _device_fused_full(comm, sendbuf, sc, sd, recvbuf, rd) -> None:
     fn = cache_get(comm, ("a2av", M, sendbuf.nbytes, recvbuf.nbytes))
     if fn is None:
         rep = P(None, None)
-        sm = jax.shard_map(step, mesh=comm.mesh,
+        sm = compat.shard_map(step, mesh=comm.mesh,
                            in_specs=(P(AXIS, None), P(AXIS, None),
                                      rep, rep, rep),
                            out_specs=P(AXIS, None), check_vma=False)
@@ -301,7 +303,7 @@ def _device_ragged(comm, sendbuf, sc, sd, recvbuf, rd) -> bool:
         want = np.array(recvbuf.data, copy=True)
         try:
             from .plan import donation_argnums
-            sm = jax.shard_map(step, mesh=comm.mesh,
+            sm = compat.shard_map(step, mesh=comm.mesh,
                                in_specs=(P(AXIS, None), P(AXIS, None)),
                                out_specs=P(AXIS, None), check_vma=False)
             # recv buffer (arg 1) donated like the fused path: callers
@@ -423,6 +425,12 @@ def _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order: str):
     # _device_fused (no per-length type-cache growth)
     packer = type_cache.get_or_commit(dtypes.BYTE).best_packer()
     for a, p in pairs:
+        if faults.ENABLED:
+            # per-peer injection site of the isend/irecv lowering: a raise
+            # here aborts the exchange BEFORE any buffer moves (the plan
+            # dispatches only after every pair is built), so a faulted
+            # alltoallv is clean-failed, never half-applied
+            faults.check("alltoallv.pair")
         n = int(sc[a, p])
         msgs.append(Message(
             src=comm.library_rank(a), dst=comm.library_rank(p), tag=0,

@@ -28,11 +28,43 @@ boundary. A hardware multi-host launch only needs the coordinator address.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Tuple
 
+from ..runtime import faults
+from ..utils import env as envmod
 from ..utils import logging as log
 
 _initialized = False
+
+
+def _initialize_with_retry(do_init) -> None:
+    """Bounded exponential-backoff retry around one ``do_init()`` attempt
+    (``jax.distributed.initialize``). The coordinator being slower to bind
+    its port than its workers are to dial it is the NORMAL startup race in
+    a multi-host launch — jax fails that hard (round-5 verdict), so the
+    workers retry: TEMPI_INIT_RETRIES extra attempts (default 3), first
+    delay TEMPI_INIT_BACKOFF_S (default 0.5 s), doubling per attempt. The
+    last failure is re-raised — a coordinator that never comes up must
+    stay fatal (N independent single-host worlds silently mismatching
+    ranks is the worse outcome)."""
+    attempts = 1 + envmod.env.init_retries
+    delay = envmod.env.init_backoff_s
+    for attempt in range(1, attempts + 1):
+        try:
+            if faults.ENABLED:
+                # coordinator-not-up simulation: the injected raise is
+                # retried exactly like a real connect failure
+                faults.check("multihost.init")
+            do_init()
+            return
+        except Exception as e:
+            if attempt >= attempts:
+                raise
+            log.warn(f"jax.distributed.initialize attempt {attempt}/"
+                     f"{attempts} failed ({e!r}); retrying in {delay:.2g}s")
+            time.sleep(delay)
+            delay *= 2
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -56,13 +88,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
             v = os.environ.get(name)
             return int(v) if v else None
 
-        jax.distributed.initialize(
+        _initialize_with_retry(lambda: jax.distributed.initialize(
             coordinator_address=addr,
             num_processes=(num_processes
                            if num_processes is not None
                            else _int_env("TEMPI_NUM_PROCESSES")),
             process_id=(process_id if process_id is not None
-                        else _int_env("TEMPI_PROCESS_ID")))
+                        else _int_env("TEMPI_PROCESS_ID"))))
         _initialized = True
         log.debug(f"joined multi-host world at {addr}: "
                   f"process {jax.process_index()}/{jax.process_count()}")

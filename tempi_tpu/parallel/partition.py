@@ -114,6 +114,25 @@ def _grow_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
     return part
 
 
+# swap-pass gate: at or below this many vertices the pairwise pass runs
+# exactly (small rank graphs, where native-refine parity matters); above
+# it, candidates are restricted to boundary vertices so the numpy
+# fallback stays usable on large graphs (see the swap-pass comment)
+_SWAP_EXACT_N = 256
+
+
+def _boundary_vertices(csr: Csr, part: np.ndarray) -> np.ndarray:
+    """Vertices with at least one cross-part edge (ascending). Vectorized
+    — the gate exists to keep large graphs usable, so the boundary scan
+    itself must not be an O(n·degree) Python loop."""
+    if len(csr.adjncy) == 0:
+        return np.empty(0, dtype=np.int64)
+    deg = np.diff(csr.xadj)
+    src = np.repeat(np.arange(csr.n, dtype=np.int64), deg)
+    cross = part[csr.adjncy] != part[src]
+    return np.flatnonzero(np.bincount(src[cross], minlength=csr.n))
+
+
 def _refine_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
                part: np.ndarray, passes: int = 4) -> None:
     """Greedy single moves within the weight cap (native refine analog,
@@ -165,11 +184,29 @@ def _refine_py(nparts: int, csr: Csr, vwgt: np.ndarray, cap_w: int,
         return g
 
     # equal-weight pairwise swap pass (native refine parity): catches the
-    # relabelings exact balance forbids single moves from reaching
+    # relabelings exact balance forbids single moves from reaching.
+    # The all-pairs form is O(n^2 * degree) per pass — fine for rank
+    # graphs (n = ranks), quadratic pain on large graphs. Above the gate
+    # the candidate set is restricted to BOUNDARY vertices: a swap's gain
+    # is positive only if at least one endpoint has a cross-part edge, so
+    # interior-interior pairs can never profit and pruning interior-*
+    # pairs keeps the pass near-exact while bounding it by the boundary
+    # size (a deliberate heuristic: the rare boundary-interior win whose
+    # interior endpoint compensates a negative gain is forgone).
     for _ in range(passes):
+        if n > _SWAP_EXACT_N:
+            boundary = _boundary_vertices(csr, part)
+            if not len(boundary):
+                break
+            vs = boundary
+        else:
+            vs = range(n)
         improved = False
-        for v in range(n):
-            for u in range(v + 1, n):
+        for i, v in enumerate(vs):
+            # vs is ascending in both branches, so positional slicing
+            # yields exactly the u > v pairs without a per-v mask
+            us = range(v + 1, n) if n <= _SWAP_EXACT_N else vs[i + 1:]
+            for u in us:
                 if part[u] == part[v] or vwgt[u] != vwgt[v]:
                     continue
                 gain = _gain(v, part[u]) + _gain(u, part[v])

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..runtime import faults
+from ..utils import compat
 from ..utils import counters as ctr
 from ..utils import logging as log
 from .communicator import AXIS, Communicator, DistBuffer
@@ -261,7 +263,7 @@ class ExchangePlan:
                 return self._step_body(rounds, datas)
 
         n = len(self.bufs)
-        sm = jax.shard_map(step, mesh=comm.mesh,
+        sm = compat.shard_map(step, mesh=comm.mesh,
                            in_specs=(P(AXIS, None),) * n,
                            out_specs=(P(AXIS, None),) * n,
                            check_vma=False)
@@ -424,10 +426,10 @@ class ExchangePlan:
                     return tuple(l.reshape(1, -1) for l in locs)
 
                 n = len(self.bufs)
-                pf = jax.shard_map(pack_step, mesh=comm.mesh,
+                pf = compat.shard_map(pack_step, mesh=comm.mesh,
                                    in_specs=(P(AXIS, None),) * n,
                                    out_specs=P(AXIS, None), check_vma=False)
-                uf = jax.shard_map(unpack_step, mesh=comm.mesh,
+                uf = compat.shard_map(unpack_step, mesh=comm.mesh,
                                    in_specs=(P(AXIS, None),) * (n + 1),
                                    out_specs=(P(AXIS, None),) * n,
                                    check_vma=False)
@@ -481,6 +483,11 @@ class ExchangePlan:
 
         fns = self._round_fns[host_kind]
         for ri in range(start_ri, len(fns)):
+            if faults.ENABLED:
+                # staged-copy injection site: fires BEFORE the round's
+                # pack, so a raise leaves buffers exactly as the previous
+                # round left them (rebind() has already restored datas)
+                faults.check("p2p.staged_copy")
             pf, uf = fns[ri]
             if host_kind is not None:
                 try:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..utils import compat
 from ..utils import counters as ctr
 from .communicator import AXIS, Communicator, DistBuffer
 
@@ -59,7 +60,7 @@ def _build(comm: Communicator, nbytes: int, dtype, op: str,
             out = jnp.where(me == root, out, loc)
         return out.reshape(1, -1)
 
-    sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
+    sm = compat.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
                        out_specs=P(AXIS, None), check_vma=False)
     return jax.jit(sm)
 
@@ -134,7 +135,7 @@ def barrier(comm: Communicator) -> None:
             def step(x):
                 return (x + jax.lax.psum(x, AXIS) * 0).reshape(1, 1)
 
-            sm = jax.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
+            sm = compat.shard_map(step, mesh=comm.mesh, in_specs=P(AXIS, None),
                                out_specs=P(AXIS, None), check_vma=False)
             import numpy as np
 

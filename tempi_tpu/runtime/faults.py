@@ -1,0 +1,347 @@
+"""Deterministic fault injection and the shared deadline/watchdog helpers.
+
+No reference analog: the reference TEMPI stack (arXiv:2012.14363) trusts a
+healthy MPI underneath it. This build's substrate is a tunneled TPU backend
+whose observed failure modes — a wedged device tunnel that blocks D2H reads
+in C for hours, a coordinator that is not up yet at ``jax.distributed``
+init, a progress thread that never returns — are exactly the ones a test
+suite cannot reproduce on demand. This module makes them reproducible:
+named injection sites threaded through the hot layers, driven by a
+``TEMPI_FAULTS`` spec, with every firing a pure function of its seed.
+
+Spec grammar (comma-separated entries)::
+
+    TEMPI_FAULTS = site:kind:rate:seed[,site:kind:rate:seed...]
+
+  site — a registered name from ``SITES`` (typos fail loudly: a chaos run
+         that silently tests nothing is worse than no chaos run)
+  kind — ``raise`` | ``delay`` | ``wedge``
+  rate — firing probability per pass through the site, 0 < rate <= 1
+  seed — seeds this entry's private RNG; the draw sequence is a pure
+         function of (seed, pass number), so a failure observed at pass N
+         reproduces from the same spec in the same program
+
+Hot-path contract (acceptance criterion): sites guard themselves with the
+module-level ``ENABLED`` flag —
+
+    if faults.ENABLED:
+        faults.check("p2p.progress")
+
+— so with ``TEMPI_FAULTS`` unset every site costs one module-attribute
+truth test: no dict lookup, no call, no per-op allocation.
+
+Kind semantics:
+
+  raise — raises :class:`InjectedFault` at the site (carrying site, pass
+          number, and seed, so the failure names its own reproduction).
+  delay — sleeps ``TEMPI_FAULT_DELAY_S`` (default 0.05 s) at the site:
+          the slow-but-alive peer.
+  wedge — STICKY: once the draw fires the site stays wedged until
+          ``release()``/``configure()``. Two behaviors, chosen by the
+          call site:
+            * ``check(site)`` (default ``wedge="block"``) blocks the
+              calling thread on an internal event — the wedged-thread
+              simulation for thread-loop sites (``progress.pump_step``),
+              where the blocked thread IS the failure being modeled;
+            * ``check(site, wedge="stall")`` returns True without
+              blocking — the dead-peer simulation for engine sites
+              (``p2p.progress``): the engine stops completing work while
+              the WAITER's thread survives to reach its
+              ``TEMPI_WAIT_TIMEOUT_S`` deadline and raise ``WaitTimeout``
+              instead of hanging.
+          Only the engine/pump sites accept the kind at all
+          (``_WEDGE_SITES``): elsewhere a blocked thread is a harness
+          hang no deadline can bound — sites under the progress lock
+          would deadlock every waiter before any deadline check runs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils import env as envmod
+from ..utils import logging as log
+
+#: Registered injection sites. Adding a site = adding its name here and an
+#: ``if faults.ENABLED: faults.check(...)`` guard at the code location.
+SITES = (
+    "p2p.post",           # send/recv launch (parallel/p2p._post)
+    "p2p.progress",       # each engine progress step (p2p.try_progress)
+    "p2p.staged_copy",    # host-staged copy (parallel/plan.run_staged)
+    "progress.pump_step",  # each background pump iteration (runtime/progress)
+    "multihost.init",     # each jax.distributed.initialize attempt
+    "alltoallv.pair",     # each per-peer message of an isend/irecv lowering
+    "sweep.section",      # each measurement section capture (measure/sweep)
+)
+
+KINDS = ("raise", "delay", "wedge")
+
+#: The only sites where ``wedge`` is meaningful — the engine/thread sites
+#: whose call sites opt into the right blocking behavior (progress.pump_step
+#: blocks the pump thread it models; p2p.progress stalls the engine without
+#: blocking the caller). Everywhere else the kind is refused at configure
+#: time: several sites can run under the progress lock (p2p.staged_copy,
+#: alltoallv.pair, p2p.post via startall's eager path), where a blocked
+#: thread deadlocks every bounded waiter BEFORE any deadline check can run,
+#: and the rest (multihost.init, sweep.section) would just park the calling
+#: thread forever with no deadline layer able to bound it — a harness hang,
+#: not a chaos test. (The deadline layer by design cannot bound a hang
+#: inside the lock; the real wedged-copy mitigation is the watchdog-bounded
+#: completion sync.)
+_WEDGE_SITES = ("p2p.progress", "progress.pump_step")
+
+#: Module-level fast-path flag: True iff at least one site is armed. Hot
+#: sites test this before calling into the module (see module docstring).
+ENABLED = False
+
+
+class InjectedFault(RuntimeError):
+    """The error a ``raise``-kind fault throws. Carries ``site``, ``seq``
+    (the 1-based pass through the site that fired), and ``seed`` — the
+    coordinates needed to reproduce the exact failure."""
+
+    def __init__(self, site: str, seq: int, seed: int):
+        super().__init__(
+            f"injected fault at {site} (pass {seq}, seed {seed})")
+        self.site = site
+        self.seq = seq
+        self.seed = seed
+
+
+class FaultSpecError(ValueError):
+    """A malformed/unknown TEMPI_FAULTS entry (fails loudly at configure
+    time — a typo'd site name must not silently disable the chaos run)."""
+
+
+@dataclass
+class _Entry:
+    site: str
+    kind: str
+    rate: float
+    seed: int
+    rng: random.Random
+    passes: int = 0        # total passes through the site
+    fired: int = 0         # how many passes fired the fault
+    wedged: bool = False   # sticky wedge state
+    fired_passes: List[int] = field(default_factory=list)  # for test introspection
+
+
+_table: Dict[str, List[_Entry]] = {}
+# wedge-kind faults block on this event; release()/configure() replaces it
+_release_event = threading.Event()
+# guards every _Entry mutation (passes, rng draws, wedged, counters): a
+# site exercised concurrently — the background pump and an application
+# waiter both pass p2p.progress — must not lose increments or interleave
+# rng draws, or the (seed, pass number) determinism contract breaks
+_state_lock = threading.Lock()
+
+
+def configure(spec: Optional[str] = None) -> None:
+    """(Re)arm the fault table. ``spec=None`` reads the parsed env's
+    ``TEMPI_FAULTS`` (so call after ``read_environment``); an explicit
+    spec string overrides (test convenience). Any previously wedged
+    threads are released before the table is swapped."""
+    global ENABLED, _table, _release_event
+    if spec is None:
+        spec = getattr(envmod.env, "faults", "")
+    # parse and validate FIRST: a malformed spec must raise with the
+    # previous table (and its wedges) fully intact — releasing before
+    # validating would leave the old spec armed but its wedges silently
+    # non-blocking, the exact quiet-chaos outcome this module rejects
+    table: Dict[str, List[_Entry]] = {}
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        fields = part.split(":")
+        if len(fields) != 4:
+            raise FaultSpecError(
+                f"bad TEMPI_FAULTS entry {part!r}: want site:kind:rate:seed")
+        site, kind, rate_s, seed_s = fields
+        if site not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r}; known sites: {SITES}")
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r}; known kinds: {KINDS}")
+        if kind == "wedge" and site not in _WEDGE_SITES:
+            raise FaultSpecError(
+                f"kind 'wedge' not supported at site {site!r} (supported "
+                f"sites: {_WEDGE_SITES}): a wedge outside the engine/pump "
+                "sites blocks a thread no deadline can bound — and under "
+                "the progress lock it would deadlock every waiter; use "
+                "raise or delay")
+        try:
+            rate = float(rate_s)
+            seed = int(seed_s)
+        except ValueError as e:
+            raise FaultSpecError(
+                f"bad rate/seed in TEMPI_FAULTS entry {part!r}: {e}") from e
+        if not 0.0 < rate <= 1.0:
+            raise FaultSpecError(
+                f"fault rate {rate} out of (0, 1] in entry {part!r}")
+        table.setdefault(site, []).append(
+            _Entry(site, kind, rate, seed, random.Random(seed)))
+    release()  # free threads wedged under the OLD table before the swap
+    with _state_lock:
+        _release_event = threading.Event()
+        _table = table
+        ENABLED = bool(table)
+    if table:
+        log.warn(f"fault injection ARMED: "
+                 + ", ".join(f"{s}:{e.kind}@{e.rate}(seed {e.seed})"
+                             for s, es in table.items() for e in es))
+
+
+def active() -> bool:
+    return ENABLED
+
+
+def release() -> None:
+    """Unblock every thread wedged by a ``wedge``-kind fault (they resume
+    where they blocked). Armed wedges stay sticky — reconfigure to clear
+    them; this only frees the threads, e.g. so a test's teardown can let a
+    deliberately wedged pump exit."""
+    _release_event.set()
+
+
+def reset() -> None:
+    """Disarm everything and release wedged threads."""
+    configure("")
+
+
+def stats() -> Dict[str, List[dict]]:
+    """Per-entry counters for assertions/diagnostics:
+    {site: [{kind, rate, seed, passes, fired, wedged, fired_passes}]}."""
+    with _state_lock:
+        return {site: [dict(kind=e.kind, rate=e.rate, seed=e.seed,
+                            passes=e.passes, fired=e.fired, wedged=e.wedged,
+                            fired_passes=list(e.fired_passes))
+                       for e in entries]
+                for site, entries in _table.items()}
+
+
+def check(site: str, wedge: str = "block") -> bool:
+    """One pass through injection site ``site``: every armed entry draws
+    (or re-fires if sticky-wedged). Returns True when a wedge-kind fault
+    is (now) wedged — meaningful only with ``wedge="stall"``, where the
+    caller is expected to stop making progress; ``wedge="block"`` parks
+    the calling thread on the release event instead. ``raise``-kind
+    entries raise :class:`InjectedFault`; ``delay``-kind sleep
+    ``TEMPI_FAULT_DELAY_S``. Callers guard with ``faults.ENABLED``."""
+    hit = False
+    delays = 0
+    exc: Optional[InjectedFault] = None
+    # draws and counter updates happen under the state lock (concurrent
+    # passes through a site serialize, keeping pass numbers and the rng
+    # sequence deterministic); the slow actions — sleeping, blocking on
+    # the release event, raising — happen AFTER it is dropped, so a
+    # wedged or delayed thread never stalls other sites' draws, and a
+    # raise-kind firing cannot skip co-armed entries' bookkeeping (or a
+    # co-armed delay's sleep) for the pass: stats never claim an
+    # injection that did not happen
+    with _state_lock:
+        release_event = _release_event
+        for e in _table.get(site, ()):
+            e.passes += 1
+            # sticky wedges skip the draw: once dead, stays dead (and the
+            # draw sequence up to the first firing stays seed-reproducible)
+            if not (e.wedged or e.rng.random() < e.rate):
+                continue
+            e.fired += 1
+            if len(e.fired_passes) < 1000:
+                e.fired_passes.append(e.passes)
+            if e.kind == "raise":
+                if exc is None:
+                    exc = InjectedFault(site, e.passes, e.seed)
+                continue
+            if e.kind == "delay":
+                delays += 1
+                continue
+            # wedge
+            if not e.wedged:
+                log.warn(f"injected wedge armed at {site} "
+                         f"(pass {e.passes}, seed {e.seed})")
+            e.wedged = True
+            hit = True
+    if delays:
+        time.sleep(delays * getattr(envmod.env, "fault_delay_s", 0.05))
+    if exc is not None:
+        raise exc  # slow-then-fail: after co-armed delays, before a block
+    if hit and wedge == "block":
+        release_event.wait()
+    return hit
+
+
+class _Watchdog:
+    """One reusable daemon thread serving bounded calls off a queue, so
+    the HEALTHY bounded-wait path (TEMPI_WAIT_TIMEOUT_S armed, nothing
+    wedged — the intended production configuration) does not pay a thread
+    spawn per completion sync."""
+
+    def __init__(self):
+        import queue
+        self.jobs: "queue.Queue" = queue.Queue()
+        self.busy = False
+        threading.Thread(target=self._run, daemon=True,
+                         name="tempi-watchdog").start()
+
+    def _run(self) -> None:
+        while True:
+            fn, done, err = self.jobs.get()
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                err.append(e)
+            finally:
+                done.set()
+
+
+_watchdog: Optional[_Watchdog] = None
+_watchdog_lock = threading.Lock()
+
+
+def call_with_timeout(fn, timeout_s: float):
+    """Run ``fn()`` under the watchdog thread; returns ``"timeout"`` if it
+    does not finish in ``timeout_s`` (the watchdog is ABANDONED and
+    replaced on the next call — the stuck ``fn`` is typically blocked in C
+    where no Python timeout can reach it, so the caller must not free
+    resources the call may still touch), the raised exception if it
+    raised, else True. Shared by the measurement sweep's hung-D2H probes
+    and the p2p deadline layer's bounded buffer syncs. A busy watchdog
+    (overlapping bounded calls from two threads) falls back to a one-shot
+    thread for the overlapping call rather than queueing behind a job
+    that could consume its whole budget."""
+    global _watchdog
+    done = threading.Event()
+    err: List[BaseException] = []
+    with _watchdog_lock:
+        w = _watchdog
+        if w is None:
+            w = _watchdog = _Watchdog()
+        if w.busy:
+            w = None  # overlap: dedicated one-shot thread below
+        else:
+            w.busy = True
+    if w is not None:
+        w.jobs.put((fn, done, err))
+    else:
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                err.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+    if not done.wait(timeout_s):
+        with _watchdog_lock:
+            if w is not None and _watchdog is w:
+                _watchdog = None  # never reuse a possibly-stuck thread
+        return "timeout"
+    if w is not None:
+        with _watchdog_lock:
+            w.busy = False
+    return err[0] if err else True

@@ -40,11 +40,22 @@ class ProgressPump:
 
     def _run(self) -> None:
         from ..parallel import p2p
+        from . import faults
         while True:
             try:
                 comm = self._queue.pop()
             except ShutDown:
                 return
+            if faults.ENABLED:
+                # pump-iteration injection site: a wedge-kind fault BLOCKS
+                # this thread (the wedged-pump simulation) — stop() must
+                # then time out its join and report False so finalize
+                # leaks the pools instead of freeing memory under us
+                try:
+                    faults.check("progress.pump_step")
+                except faults.InjectedFault as e:
+                    log.error(f"background progress failed: {e}")
+                    continue
             try:
                 if not comm.freed and comm._pending:
                     p2p.try_progress(comm)

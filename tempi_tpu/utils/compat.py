@@ -1,0 +1,27 @@
+"""JAX version compatibility seams.
+
+The framework targets the modern ``jax.shard_map`` entry point
+(``check_vma`` keyword); older installs (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` with the keyword spelled
+``check_rep``. Every shard_map call in the tree goes through this shim so
+one site encodes the difference — a runtime that survives injected faults
+but falls over on the installed JAX version is not robust.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` where available, else the ``jax.experimental``
+    spelling with ``check_vma`` translated to its old name ``check_rep``
+    (same semantics: disable the replication/varying-manual-axes check)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -10,6 +10,18 @@ Extra knobs with no reference analog (documented where used):
   TEMPI_RANKS_PER_NODE                        (simulated node size on a CPU mesh)
   TEMPI_TORUS         = e.g. 4x2 or 4x4x4     (simulated ICI torus shape on a
                                                CPU mesh; real TPU coords win)
+
+Fault injection & resilience knobs (ISSUE 1; see runtime/faults.py and the
+README "Fault injection & resilience knobs" section):
+  TEMPI_FAULTS         = site:kind:rate:seed[,...]  deterministic fault
+                         injection spec (kinds: raise | delay | wedge)
+  TEMPI_FAULT_DELAY_S  seconds a delay-kind fault sleeps (default 0.05)
+  TEMPI_WAIT_TIMEOUT_S deadline for wait/waitall/waitall_persistent; on
+                         expiry WaitTimeout names the stuck requests
+                         (default 0 = wait forever, plain MPI semantics)
+  TEMPI_INIT_RETRIES   extra attempts for jax.distributed.initialize when
+                         the coordinator is not up yet (default 3)
+  TEMPI_INIT_BACKOFF_S first retry delay, doubling per attempt (default 0.5)
 """
 
 from __future__ import annotations
@@ -105,6 +117,13 @@ class Environment:
     # into this directory (the actionable analog of the reference's NVTX
     # ranges: named scopes land in the Perfetto timeline)
     trace_dir: str = ""
+    # fault injection & resilience (no reference analog; ISSUE 1) — the
+    # raw TEMPI_FAULTS spec is parsed by runtime/faults.configure()
+    faults: str = ""
+    fault_delay_s: float = 0.05    # sleep of a delay-kind injected fault
+    wait_timeout_s: float = 0.0    # 0 = wait forever (plain MPI semantics)
+    init_retries: int = 3          # extra jax.distributed.initialize tries
+    init_backoff_s: float = 0.5    # first retry delay; doubles per attempt
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -171,6 +190,46 @@ class Environment:
 
         e.progress_thread = getenv("TEMPI_PROGRESS_THREAD") is not None
 
+        e.faults = getenv("TEMPI_FAULTS") or ""
+
+        # resilience knobs parse LOUDLY, unlike the perf knobs above: a
+        # typo'd TEMPI_WAIT_TIMEOUT_S silently falling back to 0 would
+        # revert the deployment to the exact hang-forever behavior the
+        # knob exists to prevent (same philosophy as a bad TEMPI_FAULTS
+        # spec failing init instead of quietly testing nothing)
+        def _float_env(name: str, default: float) -> float:
+            v = getenv(name)
+            try:
+                f = float(v) if v else default
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative number "
+                    "(seconds)") from exc
+            if f < 0:
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative number (seconds)")
+            return f
+
+        def _pos_int_env(name: str, default: int) -> int:
+            v = getenv(name)
+            try:
+                i = int(v) if v else default
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative integer") from exc
+            if i < 0:
+                # no silent clamp: TEMPI_INIT_RETRIES=-3 quietly becoming
+                # 0 would revert to the die-on-coordinator-race behavior
+                # the knob exists to prevent
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative integer")
+            return i
+
+        e.fault_delay_s = _float_env("TEMPI_FAULT_DELAY_S", 0.05)
+        e.wait_timeout_s = _float_env("TEMPI_WAIT_TIMEOUT_S", 0.0)
+        e.init_retries = _pos_int_env("TEMPI_INIT_RETRIES", 3)
+        e.init_backoff_s = _float_env("TEMPI_INIT_BACKOFF_S", 0.5)
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -186,6 +245,9 @@ class Environment:
             e.datatype = DatatypeMethod.DEVICE
             e.contiguous = ContiguousMethod.NONE
             e.progress_thread = False
+            # the bail-out also disarms our own chaos layer: "underlying
+            # library" behavior means no framework-injected failures
+            e.faults = ""
         return e
 
 

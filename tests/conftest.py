@@ -16,11 +16,26 @@ if os.environ.get("TEMPI_TEST_TPU") != "1":
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: seeded chaos tests for the fault-injection subsystem "
+        "(the tier-1-compatible smoke is `pytest -m faults`)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 verify run (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_globals():
-    """Each test sees freshly-parsed env knobs and zeroed counters."""
+    """Each test sees freshly-parsed env knobs, zeroed counters, and a
+    disarmed fault table (a chaos test's wedges/specs must never leak
+    into the next test — release() also frees any still-blocked
+    wedged thread so it can exit)."""
+    from tempi_tpu.runtime import faults
     from tempi_tpu.utils import counters, env
 
     env.read_environment()
+    faults.configure()
     counters.init()
     yield
+    faults.reset()

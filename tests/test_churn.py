@@ -88,3 +88,55 @@ def test_churn_random_rounds(world):
                         f"persistent={persistent} wild={use_wild} "
                         f"{s_}->{t_}")
         assert not world._pending
+
+
+@pytest.mark.faults
+def test_churn_random_rounds_under_faults(world):
+    """Fault-enabled churn variant (ISSUE 1): random types/strategies with
+    a seeded raise fault armed on the post site. A faulted round withdraws
+    its posted prefix and RETRIES with the fault table still armed (the
+    draw sequence advances, so a retry eventually passes) — and the retry's
+    payloads must still verify against the typemap oracle: a fault must not
+    poison a cache (plan, packer memo, type record) a later trace reuses."""
+    from tempi_tpu.runtime import faults
+
+    size = world.size
+    rng = np.random.default_rng(0xFA017)
+    faults.configure("p2p.post:raise:0.15:606")
+    faulted = 0
+    for rnd in range(12):
+        ty = TYPES[int(rng.integers(len(TYPES)))]()
+        strategy = [None, "device", "staged", "oneshot"][
+            int(rng.integers(4))]
+        rows = [rng.integers(0, 256, ty.extent, np.uint8)
+                for _ in range(size)]
+        sbuf = world.buffer_from_host(rows)
+        rbuf = world.alloc(ty.extent)
+        tag = int(rng.integers(0, 100))
+
+        for attempt in range(50):
+            reqs = []
+            try:
+                for r in range(size):
+                    reqs.append(p2p.isend(world, r, sbuf, (r + 1) % size,
+                                          ty, tag=tag))
+                    reqs.append(p2p.irecv(world, (r + 1) % size, rbuf, r,
+                                          ty, tag=tag))
+                p2p.waitall(reqs, strategy)
+                break
+            except faults.InjectedFault:
+                faulted += 1
+                p2p.cancel(reqs)  # abandon-and-repost needs the withdrawal
+        else:
+            pytest.fail(f"round {rnd} never completed in 50 attempts")
+
+        packed = {r: st.oracle_pack(rows[r], ty, 1) for r in range(size)}
+        for r in range(size):
+            want = st.oracle_unpack(np.zeros(ty.extent, np.uint8),
+                                    packed[r], ty, 1)
+            np.testing.assert_array_equal(
+                np.asarray(rbuf.get_rank((r + 1) % size)), want,
+                err_msg=f"round={rnd} ty={ty} strat={strategy} post-retry")
+        assert not world._pending
+    faults.reset()
+    assert faulted, "seed 606 must actually fire within 12 rounds"

@@ -5,6 +5,8 @@ test/isend.cu self-messaging, test/sender.cpp contiguous sweep) against our
 SPMD exchange engine.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -688,9 +690,18 @@ def test_mpi_test_polls_without_blocking(world):
     for _ in range(1000):
         if api.test(r_recv):
             break
+        time.sleep(0.001)
     else:
         raise AssertionError("test() never completed a matched exchange")
-    assert api.test(r_send) is True
+    # the recv completing proves the pair executed, but the send side's
+    # completion-event query is its own async probe — poll it like any
+    # MPI_Test, don't assert single-shot readiness
+    for _ in range(1000):
+        if api.test(r_send):
+            break
+        time.sleep(0.001)
+    else:
+        raise AssertionError("test() never completed the matched send")
     api.wait(r_recv)  # completed request: no-op, must not raise
     np.testing.assert_array_equal(rbuf.get_rank(1), rows[0])
 
@@ -719,10 +730,19 @@ def test_mpi_test_bounded_query_does_not_progress(world):
     for _ in range(1000):
         if api.test(r_recv):
             break
+        time.sleep(0.001)
     else:
         raise AssertionError("progressing test() never completed the pair")
-    # after dispatch, the bounded query CAN observe completion
-    assert api.test(r_send, progress=False) is True
+    # after dispatch, the bounded query CAN observe completion — but the
+    # send side's completion-event query is its own async probe (see
+    # test_mpi_test_polls_without_blocking): poll the pure query, don't
+    # assert single-shot readiness
+    for _ in range(1000):
+        if api.test(r_send, progress=False):
+            break
+        time.sleep(0.001)
+    else:
+        raise AssertionError("pure query never observed the completed send")
     np.testing.assert_array_equal(rbuf.get_rank(1), rows[0])
 
 
@@ -739,6 +759,7 @@ def test_mpi_testall_completes_only_together(world):
     for _ in range(1000):
         if api.testall([r1, r2]):
             break
+        time.sleep(0.001)
     else:
         raise AssertionError("testall() never completed the matched pair")
     np.testing.assert_array_equal(rbuf.get_rank(3), rows[2])
@@ -764,6 +785,7 @@ def test_mpi_test_persistent(world):
         for _ in range(1000):
             if ps.test() and pr.test():
                 break
+            time.sleep(0.001)
         else:
             raise AssertionError("persistent test() never completed")
         assert ps.active is None and pr.active is None  # startable again
@@ -788,6 +810,9 @@ def test_mpi_test_wait_churn(world):
             for _ in range(1000):
                 if api.testall([rs, rr]):
                     break
+                # completion events land asynchronously: a tight spin can
+                # burn all 1000 polls before the event flips under load
+                time.sleep(0.001)
             else:
                 raise AssertionError("churn testall never completed")
         else:
@@ -816,6 +841,7 @@ def test_mpi_testall_spans_communicators(world):
     for _ in range(1000):
         if api.testall(reqs):
             break
+        time.sleep(0.001)
     else:
         raise AssertionError("cross-comm testall never completed")
     np.testing.assert_array_equal(r1.get_rank(1), rows1[0])

@@ -372,6 +372,35 @@ def test_partition_fuzz_invariants():
             assert resp.objective == pm._edge_cut(csr, resp.part)
 
 
+def test_refine_py_boundary_gate_on_large_graph():
+    """ISSUE 1 satellite: above _SWAP_EXACT_N the numpy fallback's
+    pairwise swap pass restricts its candidates to boundary vertices
+    (interior-interior swaps can never profit), bounding the otherwise
+    O(n^2 * degree) pass so _refine_py stays usable on large rank graphs.
+    The gated pass must keep the refine contract: never worsen the cut,
+    never break the weight cap."""
+    side = 20  # n = 400 > _SWAP_EXACT_N -> gated path
+    csr = grid_csr(side)
+    n = side * side
+    assert n > pm._SWAP_EXACT_N
+    k = 4
+    vwgt = np.ones(n, np.int64)
+    cap_w = -(-n // k)
+    rng = np.random.default_rng(3)
+    part = rng.permutation(np.repeat(np.arange(k), n // k)).astype(np.int32)
+    before = pm._edge_cut(csr, part)
+    pm._refine_py(k, csr, vwgt, cap_w, part, passes=2)
+    after = pm._edge_cut(csr, part)
+    assert after <= before
+    assert np.bincount(part, weights=vwgt, minlength=k).max() <= cap_w
+    # the boundary set itself: exactly the vertices with a cross-part edge
+    bd = set(pm._boundary_vertices(csr, part).tolist())
+    for v in range(n):
+        sl = slice(csr.xadj[v], csr.xadj[v + 1])
+        has_cross = any(part[u] != part[v] for u in csr.adjncy[sl])
+        assert (v in bd) == has_cross
+
+
 def test_vcycle_polish_improves_bad_partition():
     """The iterated V-cycle polish (restricted-matching re-coarsen +
     coarse-level refine) must strictly improve a deliberately interleaved

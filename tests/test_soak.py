@@ -71,6 +71,53 @@ def test_soak_mixed_traffic(world):
     assert ctr.counters.send.num_persistent_replays >= 39
 
 
+@pytest.mark.faults
+def test_soak_mixed_traffic_under_faults(world, monkeypatch):
+    """Fault-enabled soak variant (ISSUE 1): the mixed eager loop under
+    seeded low-rate raise faults at the post site plus delay faults at the
+    progress step. Every iteration either completes with a verified
+    payload or fails with a clean InjectedFault whose posted prefix is
+    withdrawn — and the leak checks still hold afterward (a faulted
+    iteration must not poison the engine for the next one)."""
+    from tempi_tpu.runtime import events, faults
+
+    monkeypatch.setenv("TEMPI_FAULT_DELAY_S", "0.001")
+    from tempi_tpu.utils import env as envmod
+
+    envmod.read_environment()
+
+    size = world.size
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf = world.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(size)])
+    rbuf = world.alloc(64)
+    faults.configure(
+        "p2p.post:raise:0.1:404,p2p.progress:delay:0.3:405")
+    failed = []
+    for it in range(25):
+        reqs = []
+        try:
+            for r in range(size):
+                reqs.append(p2p.isend(world, r, sbuf, (r + 1) % size, ty,
+                                      tag=6))
+                reqs.append(p2p.irecv(world, (r + 1) % size, rbuf, r, ty,
+                                      tag=6))
+            p2p.waitall(reqs)
+        except faults.InjectedFault:
+            failed.append(it)
+            p2p.cancel(reqs)
+            continue
+        for r in range(size):
+            assert (rbuf.get_rank((r + 1) % size) == r + 1).all()
+    st = faults.stats()
+    faults.reset()
+    assert failed, "seed 404 must actually fire within 25 iterations"
+    assert st["p2p.progress"][0]["fired"] > 0
+    # the same leak checks the healthy soak enforces
+    assert not world._pending
+    assert events._pool is None or events._pool._outstanding == 0
+
+
 def test_soak_new_surfaces(world):
     """Round-3 surfaces under sustained mixed load: fused halo iterations
     interleaved with eager ops (forcing fused<->engine transitions),
