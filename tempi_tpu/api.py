@@ -153,6 +153,8 @@ def finalize() -> None:
             log.error("finalize: progress thread wedged; leaking slab pools")
         counters.finalize()
         type_cache.clear()
+        from .runtime import health
+        health.reset()  # breaker history is per-session, like counters
         _world = None
 
 
@@ -160,6 +162,20 @@ def comm_world() -> Communicator:
     if _world is None:
         raise RuntimeError("tempi_tpu.api.init() has not been called")
     return _world
+
+
+def health_snapshot() -> dict:
+    """Diagnostic snapshot of the self-healing runtime (ISSUE 2): every
+    circuit breaker's state and counters (``breakers``), the demotion
+    audit trail (``demotions``/``demoted``), and the background-pump
+    supervision counters (``pump``: replacements, quarantined
+    communicators, abandoned wedged threads). Pure data — safe to
+    serialize into logs or a monitoring endpoint. Callable before init
+    and after finalize (everything simply reads empty)."""
+    from .runtime import health, progress
+    snap = health.snapshot()
+    snap["pump"] = progress.supervision_stats()
+    return snap
 
 
 def initialized() -> bool:

@@ -252,9 +252,35 @@ def load_cached() -> Optional[SystemPerformance]:
             set_system(sp)
             log.debug(f"loaded system performance cache from {path}")
             return sp
+        except OSError as e:
+            # transient I/O (flaky mount, permissions hiccup): the sheet
+            # itself may be perfectly healthy — never quarantine on this
+            log.warn(f"failed to read {path}: {e}")
         except Exception as e:
             log.warn(f"failed to load {path}: {e}")
+            if path == cache_path():
+                _quarantine_corrupt_sheet(path)
     return None
+
+
+def _quarantine_corrupt_sheet(path: str) -> None:
+    """Rename a cache-dir perf.json that failed to PARSE/validate to
+    perf.json.corrupt so the next init falls through to the shipped
+    PERF_TPU.json cleanly instead of re-parsing and re-warning the same
+    bad sheet forever. Only the cache-dir sheet is quarantined — the
+    shipped artifact is a committed file this process must never rename —
+    and only on content errors, never transient I/O (see the caller's
+    OSError split). The sidecar keeps the evidence (a sheet truncated by
+    a mid-save kill is worth a post-mortem) and a later measure_all
+    simply writes a fresh perf.json."""
+    corrupt = path + ".corrupt"
+    try:
+        os.replace(path, corrupt)  # clobbers an older .corrupt: newest wins
+        log.warn(f"quarantined corrupt perf sheet to {corrupt}; the shipped "
+                 "curves (if platform-compatible) apply until the next "
+                 "measure_all")
+    except OSError as e:
+        log.warn(f"could not quarantine corrupt perf sheet {path}: {e}")
 
 
 # -- interpolation ------------------------------------------------------------

@@ -60,6 +60,11 @@ class Communicator:
         # and the background progress pump
         self._progress_lock = threading.RLock()
         self.freed = False
+        # set by the pump supervisor (runtime/progress.py) when a wedged
+        # pump thread was abandoned mid-serve on this communicator: the
+        # thread may hold this comm's progress lock forever, so background
+        # service skips it — waiters still drive its progress synchronously
+        self.quarantined = False
         _all_comms.add(self)
 
     # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------

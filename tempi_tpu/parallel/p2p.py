@@ -25,7 +25,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
-from ..runtime import faults
+from ..runtime import faults, health
 from ..ops import type_cache
 from ..ops.dtypes import Datatype
 from ..ops.packer import Packer1D
@@ -133,6 +133,11 @@ class Request:
     tag: int = 0
     nbytes: int = 0
     posted_at: float = 0.0
+    # the concrete strategy the exchange dispatched under (stamped by
+    # _execute_matched): names the right breaker key when a dispatched
+    # exchange later fails (or succeeds) at completion time, and upgrades
+    # the WaitTimeout diagnostics from "auto" to the real transport
+    strategy: str = ""
 
     def wait(self) -> None:
         wait(self)
@@ -309,20 +314,49 @@ def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str
     return choice
 
 
-def choose_strategy_message(comm: Communicator, m: Message) -> str:
-    """Per-MESSAGE strategy: DEVICE/ONESHOT forced by env; AUTO asks the
-    measured model, with the decision cached per {colocated, bytes,
-    blockLength} like SendRecvND's model-choice cache (sender.cpp:259-277,
-    sender.hpp:104-122). The reference decides per message, not per batch
-    (sender.cpp:251-328) — a 64 B and a 4 MiB message in one exchange may
-    ride different transports."""
+#: Demotion preference when a chosen strategy's breaker is open: toward the
+#: conservative host-staged path first (ISSUE 2 "demote toward STAGED"),
+#: then whatever else is still healthy.
+_DEMOTION_ORDER = ("staged", "oneshot", "device")
+
+
+def _healthy_choice(comm: Communicator, m: Message, choice: str) -> str:
+    """AUTO decisions consult the circuit breakers (runtime/health.py):
+    a strategy whose breaker for this link is open is skipped — demoted
+    toward the host-staged path — until its cooldown probe closes it
+    again. Callers guard with ``health.TRIPPED`` so the healthy hot path
+    pays one module-flag truth test; env-forced strategies (DEVICE /
+    ONESHOT / STAGED knobs) are never overridden — the breaker layer only
+    steers decisions the model was free to make."""
+    lk = health.link(m.src, m.dst)
+    if health.allowed(lk, choice):
+        return choice
+    for alt in _DEMOTION_ORDER:
+        if alt != choice and health.allowed(lk, alt):
+            health.note_demotion(lk, choice, alt)
+            log.info(f"strategy {choice!r} quarantined for link {lk}; "
+                     f"demoted to {alt!r}")
+            return alt
+    # every strategy's breaker open: stay on the conservative path (its
+    # half-open probes are what will eventually close a breaker again)
+    return "staged"
+
+
+def _model_choice_message(comm: Communicator, m: Message):
+    """Model/env-driven strategy for one message WITHOUT the breaker
+    overlay: returns ``(strategy, forced)`` where forced=True means an
+    env knob dictated the choice (the breaker layer must never override
+    explicit configuration). Side-effect-free on the health registry, so
+    failure attribution (:func:`_strategy_for_req`) can ask "what would
+    AUTO ride here" without consuming half-open probes or logging
+    spurious demotions."""
     # contiguous (1-D) messages honor TEMPI_CONTIGUOUS_* first, like the
     # reference instantiating SendRecv1DStaged/SendRecv1D at type commit
     # (type_commit.cpp:52-73)
     if isinstance(m.spacker, Packer1D):
         cm = envmod.env.contiguous
         if cm is ContiguousMethod.STAGED:
-            return "staged"
+            return "staged", True
         if cm is ContiguousMethod.AUTO:
             try:
                 colocated = comm.is_colocated(m.src, m.dst)
@@ -332,18 +366,18 @@ def choose_strategy_message(comm: Communicator, m: Message) -> str:
                                                             colocated),
                      "staged": lambda: msys.model_staged_1d(m.nbytes)})
                 if choice is not None:
-                    return choice
+                    return choice, False
                 # unmeasured: fall through to the TEMPI_DATATYPE logic
             except Exception as e:
                 ctr.counters.send.num_fallback += 1
                 log.warn(f"contiguous model failed for {m.nbytes}B; "
                          f"defaulting to device: {e!r}")
-                return "device"
+                return "device", False
     method = envmod.env.datatype
     if method is DatatypeMethod.DEVICE:
-        return "device"
+        return "device", True
     if method is DatatypeMethod.ONESHOT:
-        return "oneshot"
+        return "oneshot", True
     # AUTO
     try:
         colocated = comm.is_colocated(m.src, m.dst)
@@ -353,14 +387,29 @@ def choose_strategy_message(comm: Communicator, m: Message) -> str:
             {"device": lambda: msys.model_device(m.nbytes, block, colocated),
              "oneshot": lambda: msys.model_oneshot(m.nbytes, block,
                                                    colocated)})
-        return choice if choice is not None else "device"
+        return (choice if choice is not None else "device"), False
     except Exception as e:
         # a broken model/cache must be visible, not indistinguishable from
         # a decision (round-1 review finding)
         ctr.counters.send.num_fallback += 1
         log.warn(f"strategy model failed for {m.nbytes}B "
                  f"{m.src}->{m.dst}; defaulting to device: {e!r}")
-        return "device"
+        return "device", False
+
+
+def choose_strategy_message(comm: Communicator, m: Message) -> str:
+    """Per-MESSAGE strategy: DEVICE/ONESHOT forced by env; AUTO asks the
+    measured model, with the decision cached per {colocated, bytes,
+    blockLength} like SendRecvND's model-choice cache (sender.cpp:259-277,
+    sender.hpp:104-122). The reference decides per message, not per batch
+    (sender.cpp:251-328) — a 64 B and a 4 MiB message in one exchange may
+    ride different transports. Model-free (AUTO-derived) choices are then
+    filtered through the circuit breakers (ISSUE 2): a quarantined
+    strategy demotes toward staged until its cooldown probe clears."""
+    choice, forced = _model_choice_message(comm, m)
+    if forced or not health.TRIPPED:
+        return choice
+    return _healthy_choice(comm, m, choice)
 
 
 def choose_strategy(comm: Communicator, messages) -> str:
@@ -506,6 +555,9 @@ def _execute_matched(comm: Communicator, messages, consumed,
         batch = [messages[i] for i in idxs]
         ops = [op for i in idxs for op in (consumed[2 * i],
                                            consumed[2 * i + 1])]
+        for op in ops:
+            op.request.strategy = strat  # names the breaker key at
+            # completion time (and the real transport in diagnostics)
         try:
             plan = get_plan(comm, batch)
             plan.run(strat)
@@ -513,12 +565,24 @@ def _execute_matched(comm: Communicator, messages, consumed,
                 plans_out.append((plan, strat,
                                   (plan.bufs, plan.messages, plan.rounds)))
         except Exception as e:
+            # feed the health registry BEFORE unwinding: a strategy whose
+            # compiled plan keeps faulting on this link must eventually
+            # trip its breaker and be skipped in AUTO decisions. ONE
+            # failure per link per event — a multi-message batch failing
+            # once must not burn the whole consecutive-failure threshold
+            for lk in {health.link(m.src, m.dst) for m in batch}:
+                health.record_failure(lk, strat, error=repr(e))
             abandoned = [op for _, rest in order[gi + 1:]
                          for i in rest
                          for op in (consumed[2 * i], consumed[2 * i + 1])]
             for op in ops + abandoned:
                 op.request.error = e
             raise
+        # NOTE: success is deliberately NOT recorded here. Dispatch is not
+        # completion — a strategy whose exchanges dispatch fine but wedge
+        # in the completion drain (the wedged-tunnel signature) must
+        # accumulate failures, not reset its own counter on every
+        # dispatch. _record_success_reqs runs at drain time instead.
         for op in ops:
             op.request.done = True
 
@@ -529,7 +593,7 @@ def _diag(req: Request, strategy: Optional[str]) -> dict:
         pending = any(op.request is req for op in req.comm._pending)
     return dict(kind=req.kind or "?", rank=req.rank, peer=req.peer,
                 tag=req.tag, nbytes=req.nbytes,
-                strategy=strategy or "auto",
+                strategy=strategy or req.strategy or "auto",
                 age_s=(time.monotonic() - req.posted_at)
                 if req.posted_at else 0.0,
                 state="pending-unmatched" if pending
@@ -543,6 +607,35 @@ def _deadline() -> Optional[float]:
     return time.monotonic() + t if t > 0 else None
 
 
+def _record_success_reqs(reqs) -> None:
+    """Success is recorded at COMPLETION (after the buffer drain observed
+    the exchanged data ready), not at dispatch: only a fully-delivered
+    exchange may reset a breaker's consecutive-failure counter or close a
+    half-open probe. ACTIVE-guarded — free until something has failed;
+    requests that never dispatched (no stamped strategy) are skipped."""
+    if not health.ACTIVE:
+        return
+    for r in reqs:
+        if r.strategy:
+            health.record_success(health.link(r.rank, r.peer), r.strategy)
+
+
+def _drive(comm: Communicator, strategy: Optional[str], absorb: bool,
+           errbox: List) -> None:
+    """One progress drive inside a bounded wait. With ``absorb`` (a
+    retry-armed caller under a deadline), engine exceptions do not escape
+    the attempt: the last one is stashed (it becomes the WaitTimeout's
+    ``__cause__``) and the deadline keeps counting — a transient engine
+    error becomes a timeout the retry layer can recover from, instead of
+    an instant abort the application must re-drive itself."""
+    try:
+        try_progress(comm, strategy)
+    except Exception as e:
+        if not absorb:
+            raise
+        errbox[0] = e
+
+
 def wait(req: Request, strategy: Optional[str] = None) -> None:
     """MPI_Wait analog: drive progress until this request completes
     (async_operation.cpp:448-463).
@@ -551,17 +644,29 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     concluding "peer never posted" on the first fruitless progress attempt
     (a diagnosis a background pump or another posting thread can falsify),
     the call keeps driving progress until the deadline and then raises
-    WaitTimeout naming the stuck request."""
+    WaitTimeout naming the stuck request — after exhausting the
+    TEMPI_RETRY_ATTEMPTS cancel-and-repost recovery attempts, if any are
+    configured (see :func:`_with_retry`)."""
+    _with_retry(lambda absorb: _wait_attempt(req, strategy, absorb),
+                lambda e: _note_stuck(e, [req], strategy),
+                lambda: _repost([req]))
+
+
+def _wait_attempt(req: Request, strategy: Optional[str] = None,
+                  absorb: bool = False) -> None:
+    """One bounded (or unbounded) wait attempt; see wait()."""
     deadline = _deadline()
+    absorb = absorb and deadline is not None
+    errbox: List = [None]
     if not req.done:
-        try_progress(req.comm, strategy)
+        _drive(req.comm, strategy, absorb, errbox)
     if deadline is not None:
         while not req.done and req.error is None:
             if time.monotonic() >= deadline:
                 raise WaitTimeout(envmod.env.wait_timeout_s,
-                                  [_diag(req, strategy)])
+                                  [_diag(req, strategy)]) from errbox[0]
             time.sleep(_WAIT_POLL_S)
-            try_progress(req.comm, strategy)
+            _drive(req.comm, strategy, absorb, errbox)
     if not req.done:
         if req.error is not None:
             raise RuntimeError(
@@ -580,6 +685,7 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
         _sync_bufs([buf], deadline=deadline,
                    stuck_fn=lambda b: [dict(_diag(req, strategy),
                                             state="completion-sync")])
+        _record_success_reqs([req])
 
 
 # test()/testall() progress opt-in for the pre-bounding behavior: compile
@@ -645,6 +751,7 @@ def test(req: Request, strategy: Optional[str] = None,
         if not _buf_ready(req.buf):
             return False
         req.buf = None  # completion observed; wait() becomes a no-op
+        _record_success_reqs([req])
     return True
 
 
@@ -686,10 +793,15 @@ def testall(reqs, strategy: Optional[str] = None,
                     "this request was matched into") from r.error
         if not all(r.done for r in reqs):
             return False
-    if not all(_buf_ready(b) for b in _distinct_bufs(reqs)):
+    bufs = _distinct_bufs(reqs)
+    if not all(_buf_ready(b) for b in bufs):
         return False
+    # success only for requests whose completion THIS call observed — a
+    # request drained earlier must not re-close a later half-open breaker
+    drained = [r for r in reqs if r.buf is not None]
     for r in reqs:
         r.buf = None
+    _record_success_reqs(drained)
     return True
 
 
@@ -704,25 +816,39 @@ def waitall(reqs, strategy: Optional[str] = None) -> None:
     communicators until every request completes or the deadline expires,
     and the WaitTimeout names EVERY still-incomplete request — the
     diagnostic a deadlocked multi-edge exchange needs is the full set of
-    stuck edges, not the first one."""
+    stuck edges, not the first one. TEMPI_RETRY_ATTEMPTS adds the
+    cancel-and-repost recovery attempts on top (see :func:`_with_retry`);
+    each attempt gets a fresh deadline."""
+    _with_retry(lambda absorb: _waitall_attempt(reqs, strategy, absorb),
+                lambda e: _note_stuck(e, reqs, strategy),
+                lambda: _repost([r for r in reqs
+                                 if not r.done and r.error is None]))
+
+
+def _waitall_attempt(reqs, strategy: Optional[str] = None,
+                     absorb: bool = False) -> None:
+    """One bounded (or unbounded) waitall attempt; see waitall()."""
     deadline = _deadline()
+    absorb = absorb and deadline is not None
+    errbox: List = [None]
     for r in reqs:
         if not r.done:
-            try_progress(r.comm, strategy)
+            _drive(r.comm, strategy, absorb, errbox)
     if deadline is not None:
         while True:
             undone = [r for r in reqs if not r.done and r.error is None]
             if not undone:
                 break
             if time.monotonic() >= deadline:
-                raise WaitTimeout(envmod.env.wait_timeout_s,
-                                  [_diag(r, strategy) for r in undone])
+                raise WaitTimeout(
+                    envmod.env.wait_timeout_s,
+                    [_diag(r, strategy) for r in undone]) from errbox[0]
             time.sleep(_WAIT_POLL_S)
             for c in _distinct_comms(undone):
-                try_progress(c, strategy)
+                _drive(c, strategy, absorb, errbox)
     for r in reqs:
         if not r.done:
-            wait(r, strategy)  # raise with the right diagnosis
+            _wait_attempt(r, strategy)  # raise with the right diagnosis
     bufs = _distinct_bufs(reqs)
     if deadline is not None:
         # buffer -> its requests, captured before buf is cleared: a
@@ -736,9 +862,13 @@ def waitall(reqs, strategy: Optional[str] = None) -> None:
                               for r in by_buf[id(b)]]
     else:
         stuck_fn = None
+    # success only for requests whose completion THIS call drains — a
+    # request drained earlier must not re-close a later half-open breaker
+    drained = [r for r in reqs if r.buf is not None]
     for r in reqs:
         r.buf = None
     _sync_bufs(bufs, deadline=deadline, stuck_fn=stuck_fn)
+    _record_success_reqs(drained)
 
 
 def _distinct_comms(reqs) -> List[Communicator]:
@@ -796,11 +926,21 @@ def _sync_bufs(bufs: Sequence[DistBuffer], deadline: Optional[float] = None,
             remaining = 0.05
         res = faults.call_with_timeout(lambda b=b: drain(b), remaining)
         if res == "timeout":
-            raise WaitTimeout(envmod.env.wait_timeout_s,
-                              stuck_fn(b) if stuck_fn is not None else
-                              [dict(kind="?", rank=-1, peer=-1, tag=0,
-                                    nbytes=0, strategy="auto", age_s=0.0,
-                                    state="completion-sync")])
+            stuck = (stuck_fn(b) if stuck_fn is not None else
+                     [dict(kind="?", rank=-1, peer=-1, tag=0,
+                           nbytes=0, strategy="auto", age_s=0.0,
+                           state="completion-sync")])
+            # the wedged-tunnel signature feeds the breakers even with
+            # retries unarmed: a strategy whose exchanges dispatch fine
+            # but wedge in the completion drain must eventually be
+            # quarantined in AUTO decisions. One failure per (link,
+            # strategy) key per event; only concrete strategies key a
+            # breaker the chooser consults.
+            for lk, strat in {(health.link(d["rank"], d["peer"]),
+                               d["strategy"]) for d in stuck}:
+                if strat in _DEMOTION_ORDER:
+                    health.record_failure(lk, strat, error="completion-sync")
+            raise WaitTimeout(envmod.env.wait_timeout_s, stuck)
         if isinstance(res, BaseException):
             raise res
 
@@ -1081,6 +1221,155 @@ def cancel(reqs: Sequence[Request]) -> None:
             _withdraw_pending(c, [r for r in reqs if r.comm is c])
 
 
+# -- retry-with-demotion (ISSUE 2) --------------------------------------------
+#
+# ISSUE 1's bounded waits turned a hang into "name the stuck request and
+# raise"; this layer turns it into "recover, demote, and only then raise":
+# a timed-out exchange is cancelled and reposted (bounded attempts with
+# exponential backoff), every failure feeds the circuit-breaker health
+# registry (runtime/health.py), and once a breaker opens the retry demotes
+# the exchange toward the conservative host-staged strategy.
+
+
+def _with_retry(attempt, note, repost, retryable=None) -> None:
+    """Bounded retry for timed-out exchanges — the one policy loop both
+    the eager and persistent wait paths share. ``attempt(absorb)`` runs
+    one wait attempt (a fresh deadline each time); ``note(e)`` records
+    the timeout's failures in the health registry and returns True if a
+    breaker just opened; ``repost()`` re-arms the exchange for the next
+    attempt (atomic cancel+repost for eager requests, startall for a
+    persistent batch).
+
+    Engaged only when BOTH a wait deadline (TEMPI_WAIT_TIMEOUT_S) and
+    retries (TEMPI_RETRY_ATTEMPTS > 0) are armed — the default is ISSUE
+    1's raise-on-first-timeout. Only a fully-unmatched timeout (every
+    stuck state "pending-unmatched") is retryable: matched-in-flight and
+    completion-sync requests' ops are already consumed, and a hung
+    completion drain's abandoned thread may still touch the buffers a
+    repost would reuse — those surface immediately after recording.
+    ``retryable(e)``, when given, adds a path-specific veto on top.
+    Demotion toward STAGED happens in the strategy CHOOSER once the
+    recorded failures open a breaker (see _healthy_choice) — never by
+    overriding an explicitly-requested or env-forced strategy here."""
+    retries = envmod.env.retry_attempts
+    if retries <= 0 or envmod.env.wait_timeout_s <= 0:
+        return attempt(False)
+    attempt_no = 0
+    while True:
+        try:
+            return attempt(True)
+        except WaitTimeout as e:
+            opened = note(e)
+            if (attempt_no >= retries
+                    or any(d["state"] != "pending-unmatched"
+                           for d in e.stuck)
+                    or (retryable is not None and not retryable(e))):
+                raise
+            if faults.ENABLED:
+                faults.check("p2p.repost")  # chaos on the recovery path
+            repost()
+            delay = envmod.env.retry_backoff_s * (2 ** attempt_no)
+            if delay > 0:
+                time.sleep(delay)
+            if opened:
+                log.warn("circuit breaker opened for a timed-out exchange; "
+                         "AUTO decisions now demote it toward staged")
+            attempt_no += 1
+            log.info(f"reposted timed-out exchange; "
+                     f"retry {attempt_no}/{retries}")
+
+
+def _note_stuck_diags(e: WaitTimeout, strategy: Optional[str],
+                      resolve) -> bool:
+    """Record the timed-out exchange's failures against the breaker keys
+    the strategy chooser consults; returns True if any breaker
+    transitioned to open (the edge the demotion log reports). ONE
+    failure per (link, strategy) key per timeout event — a multi-edge
+    timeout must not burn the whole consecutive-failure threshold at
+    once. Completion-sync diagnostics are skipped: the drain site
+    already recorded them (and does so even with retries unarmed). A
+    diagnostic that names its dispatched strategy is recorded under it;
+    otherwise ``resolve(diag)`` maps it back to what AUTO would ride
+    (the eager and persistent paths resolve differently)."""
+    keys = set()
+    for d in e.stuck:
+        if d["state"] == "completion-sync":
+            continue
+        strat = strategy
+        if strat is None and d["strategy"] in _DEMOTION_ORDER:
+            strat = d["strategy"]
+        if strat is None:
+            strat = resolve(d)
+        keys.add((health.link(d["rank"], d["peer"]), strat))
+    opened = False
+    for lk, strat in keys:
+        opened |= health.record_failure(lk, strat, error=str(e))
+    return opened
+
+
+def _note_stuck(e: WaitTimeout, reqs, strategy: Optional[str]) -> bool:
+    """Eager-path failure attribution: a stuck diagnostic maps back to
+    its request by envelope, and the request's still-pending op names
+    the shape AUTO would ride."""
+    undone = [r for r in reqs if not r.done and r.error is None]
+
+    def resolve(d):
+        r = next((r for r in undone
+                  if r.kind == d["kind"] and r.rank == d["rank"]
+                  and r.peer == d["peer"] and r.tag == d["tag"]), None)
+        return _strategy_for_req(r) if r is not None else "device"
+
+    return _note_stuck_diags(e, strategy, resolve)
+
+
+def _strategy_for_req(req: Request) -> str:
+    """The strategy AUTO would currently ride for a stuck request's shape
+    — the key its failure is recorded under so the breaker matches what
+    the chooser consults. Uses the breaker-free model choice: attribution
+    is a bookkeeping query and must not consume half-open probes or log
+    demotions. The op is still pending (only unmatched requests are
+    retried), so its packer/shape are available; anything unattributable
+    (wildcard source, op already gone) falls back to "device", the
+    unmeasured chooser's default."""
+    try:
+        with req.comm._progress_lock:
+            op = next((o for o in req.comm._pending if o.request is req),
+                      None)
+        if op is None or op.peer < 0 or op.rank < 0:
+            return "device"
+        src, dst = ((op.rank, op.peer) if op.kind == "send"
+                    else (op.peer, op.rank))
+        m = Message(src=src, dst=dst, tag=op.tag, nbytes=op.nbytes,
+                    sbuf=op.buf, spacker=op.packer, scount=op.count,
+                    soffset=op.offset, rbuf=op.buf, rpacker=op.packer,
+                    rcount=op.count, roffset=op.offset)
+        return _model_choice_message(req.comm, m)[0]
+    except Exception:
+        return "device"
+
+
+def _repost(reqs: Sequence[Request]) -> None:
+    """cancel()+repost in one atomic region per communicator: withdraw the
+    stuck requests' still-pending ops and re-append those same ops at the
+    tail with a fresh posted_at — the retry is a brand-new exchange as far
+    as FIFO matching and age diagnostics are concerned, and no concurrent
+    matcher (the background pump) can observe the half-cancelled state."""
+    from ..runtime import progress
+    comms = _distinct_comms(reqs)
+    for c in comms:
+        ours = {id(r) for r in reqs if r.comm is c}
+        with c._progress_lock:
+            stale = [op for op in c._pending if id(op.request) in ours]
+            c._pending = [op for op in c._pending
+                          if id(op.request) not in ours]
+            now = time.monotonic()
+            for op in stale:
+                op.request.posted_at = now
+                c._pending.append(op)
+    for c in comms:
+        progress.notify(c)
+
+
 def waitall_persistent(preqs: Sequence[PersistentRequest],
                        strategy: Optional[str] = None) -> None:
     """Complete the active instances; the requests become inactive and can
@@ -1095,8 +1384,73 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
     request, which would stall N×timeout under a wedged engine before
     the first error surfaced). On expiry the still-incomplete instances
     are withdrawn and every request returns to the inactive, restartable
-    state before WaitTimeout names the full set of stuck edges."""
+    state before WaitTimeout names the full set of stuck edges.
+
+    TEMPI_RETRY_ATTEMPTS layers recovery on top: the restartable contract
+    is exactly what makes a persistent batch retryable — the timed-out
+    attempt already withdrew its instances, so the retry is simply
+    startall + wait again (with backoff, failures recorded in the health
+    registry, and AUTO decisions demoting once a breaker opens)."""
+    _with_retry(
+        lambda absorb: _waitall_persistent_attempt(preqs, strategy, absorb),
+        lambda e: _note_stuck_preqs(preqs, strategy, e),
+        # the timed-out attempt restored restartability; startall reposts
+        lambda: startall(preqs, strategy),
+        # the repost restarts the WHOLE batch, so retry only when the
+        # whole batch was stuck: restarting a partially-completed batch
+        # would double-post instances whose data already delivered
+        retryable=lambda e: len(e.stuck) == len(preqs))
+
+
+def _note_stuck_preqs(preqs: Sequence[PersistentRequest],
+                      strategy: Optional[str], e: WaitTimeout) -> bool:
+    """Persistent variant of _note_stuck: the timed-out attempt already
+    withdrew the instances, so a stuck diagnostic resolves back to the
+    originating persistent request by its FULL envelope (kind, tag, and
+    both endpoints — same-tag requests to different peers must not
+    cross-attribute)."""
+
+    def resolve(d):
+        p = next((p for p in preqs
+                  if p.kind == d["kind"] and p.tag == d["tag"]
+                  and p.comm.library_rank(p.app_rank) == d["rank"]
+                  and p.peer != ANY_SOURCE
+                  and p.comm.library_rank(p.peer) == d["peer"]),
+                 None)
+        return _strategy_for_preq(p) if p is not None else "device"
+
+    return _note_stuck_diags(e, strategy, resolve)
+
+
+def _strategy_for_preq(p: PersistentRequest) -> str:
+    """The strategy AUTO would currently ride for a persistent request's
+    shape (see _strategy_for_req: breaker-free resolution, same
+    unattributable fallback)."""
+    try:
+        if p.peer == ANY_SOURCE:
+            return "device"
+        packer, _ = _packer_for(p.datatype)
+        rank = p.comm.library_rank(p.app_rank)
+        peer = p.comm.library_rank(p.peer)
+        src, dst = (rank, peer) if p.kind == "send" else (peer, rank)
+        m = Message(src=src, dst=dst, tag=p.tag,
+                    nbytes=p.count * p.datatype.size, sbuf=p.buf,
+                    spacker=packer, scount=p.count, soffset=p.offset,
+                    rbuf=p.buf, rpacker=packer, rcount=p.count,
+                    roffset=p.offset)
+        return _model_choice_message(p.comm, m)[0]
+    except Exception:
+        return "device"
+
+
+def _waitall_persistent_attempt(preqs: Sequence[PersistentRequest],
+                                strategy: Optional[str] = None,
+                                absorb: bool = False) -> None:
+    """One bounded (or unbounded) persistent-batch wait attempt; see
+    waitall_persistent()."""
     deadline = _deadline()
+    absorb = absorb and deadline is not None
+    errbox: List = [None]
     actives: List[Request] = []
     for p in preqs:
         act = p.active
@@ -1118,7 +1472,7 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
         for act in actives:
             if not act.done:
                 act.buf = None  # the batch-level sync below covers it
-                try_progress(act.comm, strategy)
+                _drive(act.comm, strategy, absorb, errbox)
         if deadline is not None:
             while True:
                 undone = [a for a in actives
@@ -1131,10 +1485,11 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
                     # the restartable contract, raise once for the batch
                     stuck = [_diag(a, strategy) for a in undone]
                     _restore_restartable()
-                    raise WaitTimeout(envmod.env.wait_timeout_s, stuck)
+                    raise WaitTimeout(envmod.env.wait_timeout_s,
+                                      stuck) from errbox[0]
                 time.sleep(_WAIT_POLL_S)
                 for c in _distinct_comms(undone):
-                    try_progress(c, strategy)
+                    _drive(c, strategy, absorb, errbox)
     except WaitTimeout:
         raise  # the timeout path above already restored the contract
     except BaseException:
@@ -1148,7 +1503,7 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
     for p, act in zip(preqs, actives):
         if not act.done:
             try:
-                wait(act, strategy)  # raise with the right diagnosis
+                _wait_attempt(act, strategy)  # raise the right diagnosis
             except BaseException as e:
                 with p.comm._progress_lock:
                     _withdraw_pending(p.comm, [act])
@@ -1156,6 +1511,7 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
         p.active = None
     if err is not None:
         raise err
+    acts = {id(p): a for p, a in zip(preqs, actives)}
     _sync_bufs(_distinct_bufs(preqs), deadline=deadline,
                stuck_fn=lambda b: [
                    dict(kind=p.kind,
@@ -1166,9 +1522,14 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
                               else p.comm.library_rank(p.peer)),
                         tag=p.tag,
                         nbytes=p.count * p.datatype.size,
-                        strategy=strategy or "auto", age_s=0.0,
-                        state="completion-sync")
+                        # the stamped dispatch strategy, so a wedged
+                        # drain feeds the right breaker (replay actives
+                        # carry no stamp and stay "auto")
+                        strategy=(strategy or acts[id(p)].strategy
+                                  or "auto"),
+                        age_s=0.0, state="completion-sync")
                    for p in preqs if p.buf is b])
+    _record_success_reqs(actives)
 
 
 def finalize_check(comm: Communicator) -> None:

@@ -1,1 +1,3 @@
-"""Runtime services: allocators, events, progress queue (SURVEY.md L6)."""
+"""Runtime services: allocators, events, progress queue (SURVEY.md L6),
+fault injection (faults.py) and the self-healing layer — circuit-breaker
+health registry (health.py) and supervised progress pump (progress.py)."""

@@ -42,7 +42,14 @@ Kind semantics:
             * ``check(site)`` (default ``wedge="block"``) blocks the
               calling thread on an internal event — the wedged-thread
               simulation for thread-loop sites (``progress.pump_step``),
-              where the blocked thread IS the failure being modeled;
+              where the blocked thread IS the failure being modeled.
+              Each entry wedges exactly ONE thread: the one whose pass
+              fired the draw. Later passes (a supervisor-spawned
+              replacement pump) observe the wedged state without
+              blocking — the failure is a wedged thread, not a cursed
+              code path, so recovery machinery can be exercised under
+              the very wedge it recovers from (arm several entries with
+              different seeds to wedge several threads);
             * ``check(site, wedge="stall")`` returns True without
               blocking — the dead-peer simulation for engine sites
               (``p2p.progress``): the engine stops completing work while
@@ -72,6 +79,7 @@ SITES = (
     "p2p.post",           # send/recv launch (parallel/p2p._post)
     "p2p.progress",       # each engine progress step (p2p.try_progress)
     "p2p.staged_copy",    # host-staged copy (parallel/plan.run_staged)
+    "p2p.repost",         # each retry-with-demotion repost (p2p._with_retry)
     "progress.pump_step",  # each background pump iteration (runtime/progress)
     "multihost.init",     # each jax.distributed.initialize attempt
     "alltoallv.pair",     # each per-peer message of an isend/irecv lowering
@@ -227,10 +235,14 @@ def check(site: str, wedge: str = "block") -> bool:
     (or re-fires if sticky-wedged). Returns True when a wedge-kind fault
     is (now) wedged — meaningful only with ``wedge="stall"``, where the
     caller is expected to stop making progress; ``wedge="block"`` parks
-    the calling thread on the release event instead. ``raise``-kind
-    entries raise :class:`InjectedFault`; ``delay``-kind sleep
+    the calling thread on the release event instead, and only on the pass
+    whose draw FIRED the wedge — one wedged thread per entry, so a
+    replacement thread spawned by the recovery layer passes through while
+    the sticky state stays observable in stats(). ``raise``-kind entries
+    raise :class:`InjectedFault`; ``delay``-kind sleep
     ``TEMPI_FAULT_DELAY_S``. Callers guard with ``faults.ENABLED``."""
     hit = False
+    newly_wedged = False
     delays = 0
     exc: Optional[InjectedFault] = None
     # draws and counter updates happen under the state lock (concurrent
@@ -263,13 +275,14 @@ def check(site: str, wedge: str = "block") -> bool:
             if not e.wedged:
                 log.warn(f"injected wedge armed at {site} "
                          f"(pass {e.passes}, seed {e.seed})")
+                newly_wedged = True  # this thread is the entry's victim
             e.wedged = True
             hit = True
     if delays:
         time.sleep(delays * getattr(envmod.env, "fault_delay_s", 0.05))
     if exc is not None:
         raise exc  # slow-then-fail: after co-armed delays, before a block
-    if hit and wedge == "block":
+    if newly_wedged and wedge == "block":
         release_event.wait()
     return hit
 

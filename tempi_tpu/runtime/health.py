@@ -1,0 +1,216 @@
+"""Circuit-breaker health registry: per-(link, strategy) failure tracking.
+
+No reference analog: the reference TEMPI stack trusts a healthy MPI and
+re-chooses the model's winning strategy forever, even when that strategy's
+compiled plan keeps faulting on this substrate (a wedged tunnel, a staging
+path that raises). ISSUE 1 made those failures *diagnosable*; this module
+makes them *recoverable*: every failure/success of a concrete transport
+strategy on a concrete link feeds a circuit breaker, and the strategy
+chooser (``parallel/p2p.choose_strategy_message``) consults the breakers so
+a quarantined strategy is skipped in AUTO decisions — demoted toward the
+conservative host-staged path — and probed again after a cooldown.
+
+Breaker state machine (the classic three states):
+
+  closed     — healthy; failures increment a consecutive counter, a success
+               resets it. ``TEMPI_BREAKER_THRESHOLD`` consecutive failures
+               (default 3; 0 disables opening entirely) trip the breaker.
+  open       — quarantined: ``allowed()`` is False, so AUTO decisions skip
+               the strategy and the retry layer demotes toward STAGED.
+               After ``TEMPI_BREAKER_COOLDOWN_S`` (default 30 s) the next
+               ``allowed()`` query transitions to half-open.
+  half-open  — probing: traffic is allowed again; the first success closes
+               the breaker, the first failure re-opens it (fresh cooldown).
+
+Keys are ``(link, strategy)`` where ``link`` is the order-normalized pair
+of library ranks (:func:`link`) — transport health is a property of the
+pair of endpoints, not of the direction.
+
+Hot-path contract (mirrors ``faults.ENABLED``): the module-level flags cost
+one attribute truth test when everything is healthy —
+
+  ``TRIPPED``  — True iff at least one breaker is open or half-open; the
+                 strategy chooser only consults the registry when set.
+  ``ACTIVE``   — True iff the registry has any entry (any failure ever
+                 recorded); success recording on the execute hot path is
+                 skipped entirely until then.
+
+Transitions are a pure function of the recorded failure/success sequence
+plus the cooldown clock — under a seeded fault schedule (runtime/faults.py)
+the whole registry history is deterministic, which is what
+tests/test_recovery.py asserts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import env as envmod
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+#: True iff any breaker is open/half-open. Hot paths guard on this before
+#: calling into the registry (one module-attribute truth test when healthy).
+TRIPPED = False
+
+#: True iff any failure was ever recorded (registry non-empty). Success
+#: recording in the execute path is skipped until a failure exists to clear.
+ACTIVE = False
+
+
+@dataclass
+class _Breaker:
+    consecutive: int = 0       # consecutive failures since the last success
+    failures: int = 0          # total failures recorded
+    successes: int = 0         # total successes recorded
+    state: str = CLOSED
+    opened_at: float = 0.0     # monotonic stamp of the last open transition
+    times_opened: int = 0
+    last_error: str = ""
+    probes: int = 0            # half-open passes granted
+
+
+_lock = threading.Lock()
+_table: Dict[Tuple[tuple, str], _Breaker] = {}
+# demotion audit trail for the api snapshot (bounded; diagnostics, not logs)
+_demotions: List[dict] = []
+_demotion_count = 0
+
+
+def link(a: int, b: int) -> tuple:
+    """Order-normalized (library-rank, library-rank) key: strategy health is
+    a property of the endpoint pair, not the direction of one message."""
+    return (a, b) if a <= b else (b, a)
+
+
+def _recompute_flags_locked() -> None:
+    global TRIPPED, ACTIVE
+    ACTIVE = bool(_table)
+    TRIPPED = any(b.state != CLOSED for b in _table.values())
+
+
+def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
+                   ) -> bool:
+    """One failure of ``strategy`` on ``peer`` (a :func:`link` key). Returns
+    True when this failure OPENED the breaker (closed/half-open -> open) —
+    the retry layer uses that edge to demote the exchange toward STAGED.
+    Negative ranks (ANY_SOURCE envelopes) are not a link; ignored."""
+    if not isinstance(peer, tuple) or any(r < 0 for r in peer):
+        return False
+    threshold = getattr(envmod.env, "breaker_threshold", 3)
+    with _lock:
+        b = _table.setdefault((peer, strategy), _Breaker())
+        b.failures += 1
+        b.consecutive += 1
+        if error:
+            b.last_error = str(error)[:200]
+        opened = False
+        if b.state == HALF_OPEN or (b.state == CLOSED and threshold > 0
+                                    and b.consecutive >= threshold):
+            # a half-open probe failing re-opens immediately (no fresh
+            # threshold budget: the strategy already proved unhealthy)
+            opened = b.state != OPEN
+            b.state = OPEN
+            b.opened_at = time.monotonic()
+            if opened:
+                b.times_opened += 1
+        _recompute_flags_locked()
+        return opened
+
+
+def record_success(peer: tuple, strategy: str) -> None:
+    """One successful exchange of ``strategy`` on ``peer``: resets the
+    consecutive-failure counter and closes a half-open breaker. Callers
+    guard with ``health.ACTIVE`` — a registry with no failures recorded
+    has nothing to clear."""
+    if not isinstance(peer, tuple) or any(r < 0 for r in peer):
+        return
+    with _lock:
+        b = _table.get((peer, strategy))
+        if b is None:
+            return
+        b.successes += 1
+        b.consecutive = 0
+        if b.state == HALF_OPEN:
+            b.state = CLOSED
+            _recompute_flags_locked()
+
+
+def allowed(peer: tuple, strategy: str) -> bool:
+    """May ``strategy`` be used on ``peer`` right now? Closed/half-open ->
+    True. Open -> False until ``TEMPI_BREAKER_COOLDOWN_S`` has elapsed,
+    then the breaker transitions to half-open and the call returns True
+    (the cooldown probe). Unknown keys are healthy."""
+    if not isinstance(peer, tuple) or any(r < 0 for r in peer):
+        return True
+    with _lock:
+        b = _table.get((peer, strategy))
+        if b is None or b.state == CLOSED:
+            return True
+        if b.state == HALF_OPEN:
+            b.probes += 1
+            return True
+        cooldown = getattr(envmod.env, "breaker_cooldown_s", 30.0)
+        if time.monotonic() - b.opened_at >= cooldown:
+            b.state = HALF_OPEN
+            b.probes += 1
+            _recompute_flags_locked()
+            return True
+        return False
+
+
+def state(peer: tuple, strategy: str) -> str:
+    """Current breaker state for assertions/diagnostics (closed when the
+    key was never recorded)."""
+    with _lock:
+        b = _table.get((peer, strategy))
+        return b.state if b is not None else CLOSED
+
+
+def note_demotion(peer: tuple, from_strategy: str, to_strategy: str) -> None:
+    """Record that an exchange was demoted off a quarantined strategy (the
+    audit trail the api snapshot exposes; bounded so a long-lived run with
+    a flapping link cannot grow it without bound)."""
+    global _demotion_count
+    with _lock:
+        _demotion_count += 1
+        if len(_demotions) < 100:
+            _demotions.append(dict(peer=list(peer), **{"from": from_strategy},
+                                   to=to_strategy))
+
+
+def snapshot() -> dict:
+    """Diagnostic snapshot (exported via ``api.health_snapshot``): every
+    breaker's state/counters plus the demotion audit trail. Pure data —
+    safe to serialize."""
+    now = time.monotonic()
+    cooldown = getattr(envmod.env, "breaker_cooldown_s", 30.0)
+    with _lock:
+        breakers = []
+        for (peer, strategy), b in _table.items():
+            breakers.append(dict(
+                peer=list(peer), strategy=strategy, state=b.state,
+                consecutive_failures=b.consecutive, failures=b.failures,
+                successes=b.successes, times_opened=b.times_opened,
+                probes=b.probes, last_error=b.last_error,
+                cooldown_remaining_s=(
+                    max(0.0, cooldown - (now - b.opened_at))
+                    if b.state == OPEN else 0.0)))
+        return dict(breakers=breakers, demotions=_demotion_count,
+                    demoted=[dict(d) for d in _demotions])
+
+
+def reset() -> None:
+    """Forget everything (session teardown / test isolation)."""
+    global TRIPPED, ACTIVE, _demotion_count
+    with _lock:
+        _table.clear()
+        _demotions.clear()
+        _demotion_count = 0
+        TRIPPED = False
+        ACTIVE = False

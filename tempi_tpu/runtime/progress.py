@@ -1,4 +1,4 @@
-"""Background progress pump for nonblocking operations.
+"""Background progress pump for nonblocking operations, with supervision.
 
 The reference's async engine progresses operations ONLY inside other TEMPI
 calls (async_operation.cpp:501-513 try_progress, pumped from isend/irecv
@@ -10,13 +10,38 @@ Queue of communicators with freshly posted ops and drives
 application's next framework call. The in-call progress guarantee is
 unchanged — wait()/recv() still pump synchronously — the thread only makes
 progress *earlier*, never the sole provider.
+
+Self-healing (ISSUE 2): ISSUE 1 made a wedged pump *detectable* (stop()
+times out and finalize leaks the pools rather than freeing memory under a
+live thread) but the pump stayed dead for the rest of the session. Now the
+pump stamps a heartbeat around every iteration and a supervisor thread
+(armed by ``TEMPI_PUMP_HEARTBEAT_S``; 0 disables) watches it:
+
+  * a pump stuck serving one communicator past the heartbeat budget — a
+    wedged device tunnel blocking a D2H read in C, an injected wedge at
+    ``progress.pump_step`` — is declared wedged: the communicator it was
+    serving is QUARANTINED from background service (its lock may be held
+    by the stuck thread forever; a replacement pump that touched it would
+    just wedge too — waiters still drive its progress synchronously), the
+    thread is abandoned, and a fresh pump takes over the remaining queue;
+  * a pump thread that DIED (an escaped low-level error) is replaced the
+    same way, with nothing quarantined.
+
+The stop()/finalize-leak contract is preserved for truly unstoppable
+threads: module stop() reports False while the current pump OR any
+abandoned predecessor is still alive within ``TEMPI_PUMP_STOP_TIMEOUT_S``,
+so finalize still leaks the slab pools rather than freeing memory under a
+wedged thread.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+import weakref
+from typing import List, Optional
 
+from ..utils import env as envmod
 from ..utils import logging as log
 from .queue import Queue, ShutDown
 
@@ -24,6 +49,11 @@ from .queue import Queue, ShutDown
 class ProgressPump:
     def __init__(self):
         self._queue: Queue = Queue()
+        # supervision state: heartbeat is stamped around every iteration;
+        # _serving names the communicator a stuck iteration was driving
+        # (None while idle on pop — an idle pump is never "wedged")
+        self._heartbeat: float = time.monotonic()
+        self._serving = None
         self._thread = threading.Thread(target=self._run,
                                         name="tempi-progress", daemon=True)
         self._thread.start()
@@ -42,14 +72,20 @@ class ProgressPump:
         from ..parallel import p2p
         from . import faults
         while True:
+            self._serving = None
             try:
                 comm = self._queue.pop()
             except ShutDown:
                 return
+            # heartbeat BEFORE naming the comm: the supervisor must never
+            # read a fresh _serving against a stale stamp
+            self._heartbeat = time.monotonic()
+            self._serving = comm
             if faults.ENABLED:
                 # pump-iteration injection site: a wedge-kind fault BLOCKS
-                # this thread (the wedged-pump simulation) — stop() must
-                # then time out its join and report False so finalize
+                # this thread (the wedged-pump simulation) — the supervisor
+                # quarantines the comm and replaces the pump; stop() must
+                # still time out its join and report False so finalize
                 # leaks the pools instead of freeing memory under us
                 try:
                     faults.check("progress.pump_step")
@@ -57,7 +93,7 @@ class ProgressPump:
                     log.error(f"background progress failed: {e}")
                     continue
             try:
-                if not comm.freed and comm._pending:
+                if not comm.freed and comm._pending and not comm.quarantined:
                     p2p.try_progress(comm)
             except Exception as e:
                 # try_progress attaches the error to every request in the
@@ -67,29 +103,50 @@ class ProgressPump:
                 # try_progress call reproduces them directly
                 log.error(f"background progress failed: {e}")
 
-    def stop(self) -> bool:
+    def stop(self, deadline: Optional[float] = None) -> bool:
         """Returns False if the thread failed to stop — the caller must then
-        NOT free memory the thread may still reference."""
+        NOT free memory the thread may still reference. ``deadline`` is the
+        absolute join budget (default: TEMPI_PUMP_STOP_TIMEOUT_S from now)."""
         self._queue.close()
-        self._thread.join(timeout=5.0)
+        if deadline is None:
+            deadline = time.monotonic() + envmod.env.pump_stop_timeout_s
+        self._thread.join(timeout=max(0.0, deadline - time.monotonic()))
         if self._thread.is_alive():
-            log.error("progress thread did not stop within 5s")
+            log.error("progress thread did not stop within "
+                      f"{envmod.env.pump_stop_timeout_s}s "
+                      "(TEMPI_PUMP_STOP_TIMEOUT_S)")
             return False
         return True
 
 
 _pump: Optional[ProgressPump] = None
+# (thread, quarantined_comm_or_None) pairs replaced by the supervisor but
+# possibly still alive: the finalize-leak contract must account for them,
+# not just the current pump — and a thread later observed DEAD proves its
+# comm was never permanently stuck, so its quarantine is lifted
+_abandoned: List[tuple] = []
+# communicators quarantined from background service (their lock may be held
+# forever by a wedged thread); WeakSet so a freed comm drops out naturally
+_quarantined: "weakref.WeakSet" = weakref.WeakSet()
+_replacements = 0  # total supervisor-driven pump replacements
+_supervisor: Optional[threading.Thread] = None
+_supervisor_stop = threading.Event()
+_lock = threading.Lock()
 
 
 def start() -> ProgressPump:
     global _pump
-    if _pump is None:
-        _pump = ProgressPump()
-    return _pump
+    with _lock:
+        if _pump is None:
+            _pump = ProgressPump()
+        _start_supervisor_locked()
+        return _pump
 
 
 def notify(comm) -> None:
-    if _pump is not None:
+    # quarantined comms get no background service (waiters still drive
+    # their progress synchronously — the in-call guarantee is untouched)
+    if _pump is not None and not comm.quarantined:
         _pump.notify(comm)
 
 
@@ -97,12 +154,143 @@ def running() -> bool:
     return _pump is not None
 
 
+def quarantined() -> List:
+    """The communicators currently barred from background service."""
+    return list(_quarantined)
+
+
+def supervision_stats() -> dict:
+    """Pump-supervision counters for the api health snapshot."""
+    with _lock:
+        return dict(
+            running=_pump is not None,
+            supervised=_supervisor is not None,
+            replacements=_replacements,
+            quarantined_comms=len(_quarantined),
+            abandoned_threads=sum(1 for t, _ in _abandoned
+                                  if t.is_alive()))
+
+
+def _start_supervisor_locked() -> None:
+    global _supervisor
+    if _supervisor is not None or envmod.env.pump_heartbeat_s <= 0:
+        return
+    _supervisor_stop.clear()
+    _supervisor = threading.Thread(target=_supervise,
+                                   name="tempi-pump-supervisor", daemon=True)
+    _supervisor.start()
+
+
+def _supervise() -> None:
+    """Watch the pump's heartbeat; replace a wedged/dead pump. Runs until
+    stop() signals — re-reads the knob each lap so a re-parsed env applies
+    without restarting the supervisor."""
+    while not _supervisor_stop.wait(
+            min(max(envmod.env.pump_heartbeat_s / 4.0, 0.02), 1.0)):
+        budget = envmod.env.pump_heartbeat_s
+        if budget <= 0:
+            continue
+        with _lock:
+            _lift_dead_quarantines_locked()
+            pump = _pump
+            if pump is None:
+                continue
+            serving = pump._serving
+            wedged = (serving is not None
+                      and time.monotonic() - pump._heartbeat > budget)
+            died = not pump._thread.is_alive()
+            if not (wedged or died):
+                continue
+            _replace_pump_locked(pump, serving if wedged else None,
+                                 "wedged" if wedged else "died")
+
+
+def _lift_dead_quarantines_locked() -> None:
+    """An abandoned thread that EXITED proves its communicator was never
+    permanently stuck (a false-positive wedge verdict — e.g. a long
+    legitimate compile — or a wedge that cleared): lift the quarantine
+    so the comm regains background service, and drop the dead thread
+    from the finalize-leak books. Caller holds the module lock."""
+    global _abandoned
+    dead = [(t, c) for t, c in _abandoned if not t.is_alive()]
+    if not dead:
+        return
+    _abandoned = [(t, c) for t, c in _abandoned if t.is_alive()]
+    for _, comm in dead:
+        if comm is None or not comm.quarantined:
+            continue
+        comm.quarantined = False
+        _quarantined.discard(comm)
+        log.warn("abandoned pump thread exited; lifting its "
+                 "communicator's background-service quarantine")
+        if _pump is not None and not comm.freed and comm._pending:
+            _pump.notify(comm)
+
+
+def _replace_pump_locked(pump: ProgressPump, stuck_comm, reason: str) -> None:
+    """Quarantine the communicator a wedged pump was serving, abandon the
+    pump, and hand its remaining queue to a fresh one (caller holds the
+    module lock)."""
+    global _pump, _replacements
+    _replacements += 1
+    if stuck_comm is not None:
+        stuck_comm.quarantined = True
+        _quarantined.add(stuck_comm)
+    _abandoned.append((pump._thread, stuck_comm))
+    # close the old queue so the old thread exits if it ever revives, then
+    # drain its backlog into the replacement (minus the quarantined comm)
+    pump._queue.close()
+    backlog = []
+    while True:
+        try:
+            backlog.append(pump._queue.pop(timeout=0.001))
+        except (ShutDown, TimeoutError):
+            break
+    _pump = ProgressPump()
+    for comm in backlog:
+        if not comm.quarantined:
+            _pump.notify(comm)
+    log.error(
+        f"progress pump {reason}"
+        + (f" while serving a communicator (now quarantined from "
+           f"background service)" if stuck_comm is not None else "")
+        + f"; replacement pump spawned (replacement #{_replacements})")
+
+
 def stop() -> bool:
-    """Returns False if a pump thread is wedged and may still hold references
-    into pooled memory (finalize must then leak pools, not free them)."""
-    global _pump
+    """Returns False if a pump thread (current or abandoned by the
+    supervisor) is wedged and may still hold references into pooled memory
+    (finalize must then leak pools, not free them). One
+    TEMPI_PUMP_STOP_TIMEOUT_S budget bounds the whole teardown — not one
+    per thread, which would stall finalize N×timeout under several
+    wedges."""
+    global _pump, _supervisor, _abandoned, _replacements
+    with _lock:
+        sup = _supervisor
+        _supervisor = None
+    if sup is not None:
+        _supervisor_stop.set()
+        sup.join(timeout=5.0)
+    deadline = time.monotonic() + envmod.env.pump_stop_timeout_s
     clean = True
-    if _pump is not None:
-        clean = _pump.stop()
-    _pump = None
+    with _lock:
+        pump = _pump
+        _pump = None
+        abandoned, _abandoned = _abandoned, []
+    if pump is not None:
+        clean = pump.stop(deadline)
+    for t, _ in abandoned:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            log.error("abandoned (wedged) pump thread still alive at stop")
+            clean = False
+    with _lock:
+        # keep still-alive threads on the books: a later stop() (or a
+        # restarted session's finalize) must keep reporting them. The
+        # rest of the supervision history is per-session, like counters:
+        # quarantine travels with the (now torn down) communicators via
+        # their own .quarantined flag, so the set need not outlive them
+        _abandoned.extend((t, c) for t, c in abandoned if t.is_alive())
+        _quarantined.clear()
+        _replacements = 0
     return clean

@@ -22,6 +22,36 @@ README "Fault injection & resilience knobs" section):
   TEMPI_INIT_RETRIES   extra attempts for jax.distributed.initialize when
                          the coordinator is not up yet (default 3)
   TEMPI_INIT_BACKOFF_S first retry delay, doubling per attempt (default 0.5)
+
+Self-healing recovery knobs (ISSUE 2; see runtime/health.py,
+runtime/progress.py and the README "Recovery & degradation" section):
+  TEMPI_RETRY_ATTEMPTS   extra wait/waitall/waitall_persistent attempts
+                         after a WaitTimeout: the stuck requests are
+                         cancelled, the failure recorded in the health
+                         registry, and the exchange reposted (default 0 =
+                         raise on the first timeout, ISSUE 1 behavior)
+  TEMPI_RETRY_BACKOFF_S  first repost delay, doubling per attempt
+                         (default 0.05)
+  TEMPI_BREAKER_THRESHOLD  consecutive failures of one (link, strategy)
+                         that open its circuit breaker — AUTO decisions
+                         then skip the strategy and retries demote toward
+                         STAGED (default 3; 0 = breakers never open)
+  TEMPI_BREAKER_COOLDOWN_S seconds an open breaker quarantines its
+                         strategy before the half-open probe (default 30)
+  TEMPI_PUMP_HEARTBEAT_S   background-pump supervision: a pump thread
+                         stuck serving one communicator for longer than
+                         this is declared wedged — the communicator is
+                         quarantined from background service and a
+                         replacement pump is spawned (default 30;
+                         0 = supervision off). Keep it above the longest
+                         legitimate plan compile on the pump thread.
+  TEMPI_PUMP_STOP_TIMEOUT_S seconds stop()/finalize waits for pump
+                         threads to exit before declaring them wedged and
+                         leaking the slab pools instead of freeing memory
+                         under a live thread (default 5)
+
+All resilience knobs parse LOUDLY (a typo raises at init rather than
+silently reverting to the hang/die behavior the knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -124,6 +154,15 @@ class Environment:
     wait_timeout_s: float = 0.0    # 0 = wait forever (plain MPI semantics)
     init_retries: int = 3          # extra jax.distributed.initialize tries
     init_backoff_s: float = 0.5    # first retry delay; doubles per attempt
+    # self-healing recovery (no reference analog; ISSUE 2) — see
+    # runtime/health.py (breakers), runtime/progress.py (pump supervision)
+    # and parallel/p2p.py (retry-with-demotion)
+    retry_attempts: int = 0        # extra wait attempts after a WaitTimeout
+    retry_backoff_s: float = 0.05  # first repost delay; doubles per attempt
+    breaker_threshold: int = 3     # consecutive failures that open a breaker
+    breaker_cooldown_s: float = 30.0  # open -> half-open probe delay
+    pump_heartbeat_s: float = 30.0    # pump wedge detection (0 = off)
+    pump_stop_timeout_s: float = 5.0  # stop()/finalize join budget
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -229,6 +268,12 @@ class Environment:
         e.wait_timeout_s = _float_env("TEMPI_WAIT_TIMEOUT_S", 0.0)
         e.init_retries = _pos_int_env("TEMPI_INIT_RETRIES", 3)
         e.init_backoff_s = _float_env("TEMPI_INIT_BACKOFF_S", 0.5)
+        e.retry_attempts = _pos_int_env("TEMPI_RETRY_ATTEMPTS", 0)
+        e.retry_backoff_s = _float_env("TEMPI_RETRY_BACKOFF_S", 0.05)
+        e.breaker_threshold = _pos_int_env("TEMPI_BREAKER_THRESHOLD", 3)
+        e.breaker_cooldown_s = _float_env("TEMPI_BREAKER_COOLDOWN_S", 30.0)
+        e.pump_heartbeat_s = _float_env("TEMPI_PUMP_HEARTBEAT_S", 30.0)
+        e.pump_stop_timeout_s = _float_env("TEMPI_PUMP_STOP_TIMEOUT_S", 5.0)
 
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every

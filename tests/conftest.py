@@ -31,11 +31,15 @@ def _reset_globals():
     disarmed fault table (a chaos test's wedges/specs must never leak
     into the next test — release() also frees any still-blocked
     wedged thread so it can exit)."""
-    from tempi_tpu.runtime import faults
+    from tempi_tpu.runtime import faults, health
     from tempi_tpu.utils import counters, env
 
     env.read_environment()
     faults.configure()
     counters.init()
+    health.reset()
     yield
     faults.reset()
+    # breaker state and quarantine history must not leak across tests any
+    # more than an armed fault spec may
+    health.reset()

@@ -1,0 +1,583 @@
+"""Recovery suite for the self-healing runtime (ISSUE 2).
+
+ISSUE 1's chaos suite (test_faults.py) proves failures are *detected*:
+bounded waits raise WaitTimeout, a wedged pump fails stop(), a faulted
+sweep degrades. This suite proves they are *recovered from*: a wedged
+pump is replaced by its supervisor (background progress survives), a
+timed-out exchange completes via cancel + repost with the failure fed to
+the circuit-breaker health registry and the strategy demoted toward
+STAGED, and the breaker state machine is a pure function of the seeded
+fault schedule. Plus the registry-drift guard: every registered fault
+site must have a real ``faults.check`` call site."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.parallel import p2p
+from tempi_tpu.parallel.communicator import Communicator
+from tempi_tpu.runtime import faults, health, progress
+from tempi_tpu.utils import env as envmod
+
+from test_faults import TY, _post_pair, _wait_for_wedge
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+# -- circuit-breaker state machine --------------------------------------------
+
+
+def test_breaker_closed_open_halfopen_cycle(monkeypatch):
+    """The classic three-state cycle, driven directly: threshold
+    consecutive failures open; the cooldown probe half-opens; a half-open
+    failure re-opens immediately; a half-open success closes."""
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "3600")
+    envmod.read_environment()
+    lk = health.link(1, 0)
+    assert lk == (0, 1)  # order-normalized: link health has no direction
+    health.record_failure(lk, "device")
+    health.record_failure(lk, "device")
+    assert health.state(lk, "device") == health.CLOSED
+    assert not health.TRIPPED
+    assert health.record_failure(lk, "device") is True  # the opening edge
+    assert health.state(lk, "device") == health.OPEN
+    assert health.TRIPPED
+    assert health.allowed(lk, "device") is False       # cooldown not up
+    assert health.allowed(lk, "staged") is True        # other keys healthy
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0")
+    envmod.read_environment()
+    assert health.allowed(lk, "device") is True        # the half-open probe
+    assert health.state(lk, "device") == health.HALF_OPEN
+    # a failing probe re-opens at once (no fresh threshold budget)
+    assert health.record_failure(lk, "device") is True
+    assert health.state(lk, "device") == health.OPEN
+    assert health.allowed(lk, "device") is True        # cooldown 0: probe
+    health.record_success(lk, "device")                # healthy probe
+    assert health.state(lk, "device") == health.CLOSED
+    assert not health.TRIPPED
+    snap = api.health_snapshot()
+    (b,) = snap["breakers"]
+    assert b["peer"] == [0, 1] and b["strategy"] == "device"
+    assert b["times_opened"] == 2
+    assert b["failures"] == 4 and b["successes"] == 1
+
+
+def test_breaker_success_resets_consecutive_count(monkeypatch):
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "3")
+    envmod.read_environment()
+    lk = health.link(2, 5)
+    for _ in range(2):
+        health.record_failure(lk, "oneshot")
+    health.record_success(lk, "oneshot")
+    for _ in range(2):
+        health.record_failure(lk, "oneshot")
+    # never 3 CONSECUTIVE failures: still closed
+    assert health.state(lk, "oneshot") == health.CLOSED
+    assert not health.TRIPPED
+
+
+def test_breaker_threshold_zero_never_opens(monkeypatch):
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "0")
+    envmod.read_environment()
+    lk = health.link(0, 1)
+    for _ in range(10):
+        assert health.record_failure(lk, "device") is False
+    assert health.state(lk, "device") == health.CLOSED
+
+
+def test_breaker_transitions_pure_function_of_fault_schedule(monkeypatch):
+    """Satellite: feed the registry from a seeded fault schedule — the
+    full transition history must be identical across two runs of the same
+    spec (the breaker layer adds no nondeterminism of its own)."""
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0")
+    envmod.read_environment()
+
+    def run():
+        health.reset()
+        faults.configure("p2p.post:raise:0.4:1789")
+        lk = health.link(0, 1)
+        history = []
+        for _ in range(60):
+            if health.state(lk, "device") == health.OPEN:
+                health.allowed(lk, "device")  # cooldown 0: half-open probe
+                history.append(health.state(lk, "device"))
+            try:
+                faults.check("p2p.post")
+            except faults.InjectedFault:
+                health.record_failure(lk, "device")
+            else:
+                health.record_success(lk, "device")
+            history.append(health.state(lk, "device"))
+        return history
+
+    a, b = run(), run()
+    assert a == b
+    # the schedule must actually exercise every state
+    assert set(a) == {health.CLOSED, health.OPEN, health.HALF_OPEN}
+
+
+# -- AUTO strategy choice consults the breakers --------------------------------
+
+
+def test_auto_choice_demotes_quarantined_strategy(world, monkeypatch):
+    """An open breaker for (link, device) makes the AUTO chooser skip
+    device on THAT link only, demoting toward staged; the demotion lands
+    in the snapshot's audit trail; closing the breaker restores device."""
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel.plan import Message
+
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "3600")
+    envmod.read_environment()
+    packer, _ = p2p._packer_for(dt.contiguous(64, dt.BYTE))
+
+    def msg(src, dst):
+        return Message(src=src, dst=dst, tag=0, nbytes=64, sbuf=None,
+                       spacker=packer, scount=1, soffset=0, rbuf=None,
+                       rpacker=packer, rcount=1, roffset=0)
+
+    # unmeasured CPU system: AUTO's default is device
+    assert p2p.choose_strategy_message(world, msg(0, 1)) == "device"
+    health.record_failure(health.link(0, 1), "device")
+    health.record_failure(health.link(0, 1), "device")  # opens
+    assert health.TRIPPED
+    assert p2p.choose_strategy_message(world, msg(0, 1)) == "staged"
+    assert p2p.choose_strategy_message(world, msg(1, 0)) == "staged"
+    # an unrelated link is untouched
+    assert p2p.choose_strategy_message(world, msg(2, 3)) == "device"
+    snap = api.health_snapshot()
+    assert snap["demotions"] >= 1
+    assert snap["demoted"][0] == {"peer": [0, 1], "from": "device",
+                                  "to": "staged"}
+    # half-open probe + success close the breaker: device comes back
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0")
+    envmod.read_environment()
+    assert p2p.choose_strategy_message(world, msg(0, 1)) == "device"
+    health.record_success(health.link(0, 1), "device")
+    assert not health.TRIPPED
+    assert p2p.choose_strategy_message(world, msg(0, 1)) == "device"
+
+
+def test_env_forced_strategy_never_demoted(world, monkeypatch):
+    """An explicitly-forced strategy (TEMPI_DATATYPE_DEVICE) is operator
+    configuration: an open breaker must not override it — the breaker
+    layer only steers decisions the model was free to make."""
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel.plan import Message
+
+    monkeypatch.setenv("TEMPI_DATATYPE_DEVICE", "1")
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "1")
+    envmod.read_environment()
+    health.record_failure(health.link(0, 1), "device")  # opens at 1
+    assert health.TRIPPED
+    packer, _ = p2p._packer_for(dt.contiguous(64, dt.BYTE))
+    m = Message(src=0, dst=1, tag=0, nbytes=64, sbuf=None, spacker=packer,
+                scount=1, soffset=0, rbuf=None, rpacker=packer, rcount=1,
+                roffset=0)
+    assert p2p.choose_strategy_message(world, m) == "device"
+    assert api.health_snapshot()["demotions"] == 0
+
+
+# -- retry-with-demotion: WaitTimeout -> cancel -> repost ----------------------
+
+
+def _arm_recovery(monkeypatch, timeout=0.3, retries=3, backoff=0.2,
+                  threshold=2):
+    monkeypatch.setenv("TEMPI_WAIT_TIMEOUT_S", str(timeout))
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", str(retries))
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", str(backoff))
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", str(threshold))
+    envmod.read_environment()
+
+
+def test_retry_completes_after_transient_engine_fault(world, monkeypatch):
+    """Acceptance: a raise-kind fault at the progress step fails every
+    drive of the first bounded attempt (absorbed into the deadline, not
+    surfaced); the WaitTimeout is recovered by cancel + repost, the
+    failures open the (link, device) breaker, the retry demotes to
+    staged, and the exchange completes — with the whole story visible in
+    the api health snapshot. Threshold 1: the one deduped failure the
+    first timeout records (one per (link, strategy) per event) opens the
+    breaker immediately."""
+    _arm_recovery(monkeypatch, threshold=1)
+    faults.configure("p2p.progress:raise:1.0:97")
+    # the transient: the fault clears while the retry layer is backing off
+    # after the first (deterministically timed-out) attempt
+    clearer = threading.Timer(0.45, lambda: faults.configure(""))
+    clearer.start()
+    try:
+        reqs, rbuf, row, dst = _post_pair(world, tag=6)
+        t0 = time.monotonic()
+        p2p.waitall(reqs)  # recovers; must NOT raise
+        assert time.monotonic() - t0 >= 0.3  # at least one full deadline
+        np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    finally:
+        clearer.cancel()
+    assert all(r.done for r in reqs)
+    assert not world._pending
+    snap = api.health_snapshot()
+    dev = [b for b in snap["breakers"]
+           if b["peer"] == [0, 1] and b["strategy"] == "device"]
+    assert dev and dev[0]["state"] == health.OPEN
+    assert dev[0]["failures"] >= 1
+    assert snap["demotions"] >= 1  # the retry demoted toward staged
+
+
+def test_retry_exhausts_and_raises_with_failures_recorded(world, monkeypatch):
+    """A fault that never clears: every attempt times out, the WaitTimeout
+    finally surfaces (with the absorbed engine error as its cause), and
+    the registry carries ONE failure per (link, strategy) key per
+    attempt: the pair's two stuck requests share one link, and a stalled
+    engine never dispatches a strategy, so attribution stays on the
+    breaker-free model choice (device) — 3 deduped failures, one per
+    attempt, never 6."""
+    _arm_recovery(monkeypatch, timeout=0.1, retries=2, backoff=0.01)
+    faults.configure("p2p.progress:wedge:1.0:31")
+    reqs, rbuf, row, dst = _post_pair(world, tag=7)
+    with pytest.raises(p2p.WaitTimeout):
+        p2p.waitall(reqs)
+    snap = api.health_snapshot()
+    assert {(b["strategy"], b["failures"]) for b in snap["breakers"]} \
+        == {("device", 3)}
+    # recovery after the fact still works: the requests were reposted by
+    # the last retry and stay posted (the ISSUE 1 contract)
+    faults.reset()
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+
+
+def test_retry_persistent_batch_restarts_and_completes(world, monkeypatch):
+    """The persistent path: the timed-out attempt restores restartability,
+    so the retry is startall + wait again — and it completes once the
+    transient clears."""
+    _arm_recovery(monkeypatch)
+    size = world.size
+    sbuf = world.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(size)])
+    rbuf = world.alloc(64)
+    preqs = []
+    for r in range(size):
+        preqs.append(p2p.send_init(world, r, sbuf, (r + 1) % size, TY()))
+        preqs.append(p2p.recv_init(world, (r + 1) % size, rbuf, r, TY()))
+    faults.configure("p2p.progress:wedge:1.0:55")  # stalled engine
+    clearer = threading.Timer(0.45, faults.reset)
+    clearer.start()
+    try:
+        p2p.startall(preqs)
+        p2p.waitall_persistent(preqs)  # recovers; must NOT raise
+    finally:
+        clearer.cancel()
+    for r in range(size):
+        assert (rbuf.get_rank((r + 1) % size) == r + 1).all()
+    assert all(p.active is None for p in preqs)  # restartable again
+    assert api.health_snapshot()["breakers"]  # the stall was recorded
+
+
+def test_retry_disabled_keeps_issue1_semantics(world, monkeypatch):
+    """TEMPI_RETRY_ATTEMPTS=0 (the default): first timeout raises, and an
+    engine error during a bounded wait surfaces immediately instead of
+    being absorbed into the deadline."""
+    monkeypatch.setenv("TEMPI_WAIT_TIMEOUT_S", "5.0")
+    envmod.read_environment()
+    faults.configure("p2p.progress:raise:1.0:12")
+    reqs, *_ = _post_pair(world, tag=5)
+    t0 = time.monotonic()
+    with pytest.raises(faults.InjectedFault):
+        p2p.waitall(reqs)
+    assert time.monotonic() - t0 < 4.0  # raised at once, not at deadline
+    faults.reset()
+    p2p.cancel(reqs)
+
+
+def test_completion_sync_timeout_feeds_breaker(world, monkeypatch):
+    """The wedged-tunnel signature (a completion drain that never returns)
+    must feed the breaker even though its requests are already done and
+    its timeout is not retryable — recorded at the drain site, under the
+    concrete strategy the exchange dispatched with."""
+    monkeypatch.setenv("TEMPI_WAIT_TIMEOUT_S", "0.2")
+    envmod.read_environment()
+    monkeypatch.setattr(p2p.faults, "call_with_timeout",
+                        lambda fn, t: "timeout")  # every drain "hangs"
+    buf = world.alloc(64)
+    stuck = [dict(kind="send", rank=0, peer=1, tag=0, nbytes=64,
+                  strategy="device", age_s=0.1, state="completion-sync"),
+             dict(kind="recv", rank=1, peer=0, tag=0, nbytes=64,
+                  strategy="device", age_s=0.1, state="completion-sync")]
+    with pytest.raises(p2p.WaitTimeout):
+        p2p._sync_bufs([buf], deadline=time.monotonic() + 0.2,
+                       stuck_fn=lambda b: stuck)
+    (b,) = api.health_snapshot()["breakers"]
+    assert b["peer"] == [0, 1] and b["strategy"] == "device"
+    assert b["failures"] == 1  # deduped: one event, one failure
+    assert b["last_error"] == "completion-sync"
+
+
+def test_success_recorded_at_completion_not_dispatch(world):
+    """A completed (drained) exchange resets the consecutive-failure
+    counter for the strategy it rode — recorded at completion, so a
+    dispatch that later wedges in its drain could never self-absolve."""
+    lk = health.link(0, 1)
+    health.record_failure(lk, "device")  # registry ACTIVE with one strike
+    reqs, rbuf, row, dst = _post_pair(world, tag=12)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    by_strat = {b["strategy"]: b for b in api.health_snapshot()["breakers"]
+                if b["peer"] == [0, 1]}
+    assert by_strat["device"]["consecutive_failures"] == 0
+    assert by_strat["device"]["successes"] >= 1
+
+
+# -- pump supervision ----------------------------------------------------------
+
+
+def _start_supervised_world(monkeypatch, heartbeat="0.2"):
+    monkeypatch.setenv("TEMPI_PROGRESS_THREAD", "1")
+    monkeypatch.setenv("TEMPI_PUMP_HEARTBEAT_S", heartbeat)
+    envmod.read_environment()
+    return api.init()
+
+
+def _wait_until(pred, timeout=10.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"{what} not reached within {timeout}s")
+
+
+def test_wedged_pump_replaced_and_background_progress_survives(monkeypatch):
+    """Acceptance: a sticky wedge at progress.pump_step no longer
+    permanently disables background progress — the supervisor quarantines
+    the communicator the wedged pump was serving, spawns a replacement,
+    and a FRESH communicator's exchange completes via the replacement
+    pump with no application-driven progress at all. The finalize-leak
+    contract survives: stop() reports False while the abandoned wedged
+    thread lives."""
+    world = _start_supervised_world(monkeypatch)
+    th0 = progress._pump._thread
+    try:
+        faults.configure("progress.pump_step:wedge:1.0:3")
+        reqs, rbuf, row, dst = _post_pair(world)  # pump pops world, wedges
+        assert _wait_for_wedge("progress.pump_step")
+        _wait_until(
+            lambda: progress.supervision_stats()["replacements"] >= 1,
+            what="pump replacement")
+        assert world.quarantined is True
+        assert world in progress.quarantined()
+        snap = api.health_snapshot()["pump"]
+        assert snap["replacements"] == 1
+        assert snap["quarantined_comms"] == 1
+        assert snap["abandoned_threads"] == 1
+        # the engine itself is healthy: waiters still complete the
+        # quarantined communicator's exchanges synchronously
+        p2p.waitall(reqs)
+        np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+        # background progress survives the (still-armed, sticky) wedge:
+        # a fresh communicator's pair completes with NO wait() driving it
+        comm2 = Communicator(world.devices)
+        reqs2, rbuf2, row2, dst2 = _post_pair(comm2)
+        _wait_until(lambda: all(r.done for r in reqs2), timeout=30.0,
+                    what="replacement-pump completion")
+        p2p.waitall(reqs2)  # no-op sync
+        np.testing.assert_array_equal(rbuf2.get_rank(dst2), row2)
+        # stop() must keep reporting the wedged abandoned thread
+        monkeypatch.setenv("TEMPI_PUMP_STOP_TIMEOUT_S", "0.5")
+        envmod.read_environment()
+        assert progress.stop() is False
+        assert th0.is_alive()
+    finally:
+        faults.reset()  # releases the wedged thread
+        th0.join(timeout=5.0)
+        assert not th0.is_alive()
+        api.finalize()
+
+
+def test_quarantine_lifted_when_abandoned_thread_exits(monkeypatch):
+    """A quarantine is a verdict about a THREAD, not a life sentence for
+    the communicator: when the abandoned thread later exits (a wedge that
+    cleared, or a false-positive verdict on a long legitimate compile),
+    the supervisor lifts the quarantine and background service resumes."""
+    world = _start_supervised_world(monkeypatch)
+    try:
+        faults.configure("progress.pump_step:wedge:1.0:3")
+        reqs, rbuf, row, dst = _post_pair(world)
+        _wait_until(
+            lambda: progress.supervision_stats()["replacements"] >= 1,
+            what="pump replacement")
+        assert world.quarantined is True
+        p2p.waitall(reqs)  # complete the original pair synchronously
+        faults.release()   # the wedged thread finishes and exits
+        _wait_until(lambda: world.quarantined is False,
+                    what="quarantine lift")
+        assert progress.supervision_stats()["quarantined_comms"] == 0
+        assert progress.supervision_stats()["abandoned_threads"] == 0
+        # background service is BACK for the once-quarantined comm
+        reqs2, rbuf2, row2, dst2 = _post_pair(world, it=1)
+        _wait_until(lambda: all(r.done for r in reqs2), timeout=30.0,
+                    what="resumed background completion")
+        np.testing.assert_array_equal(rbuf2.get_rank(dst2), row2)
+    finally:
+        faults.reset()
+        api.finalize()
+
+
+def test_dead_pump_replaced_without_quarantine(monkeypatch):
+    """A pump thread that DIES (not wedges) is replaced too — and since it
+    was not stuck serving anyone, nothing is quarantined."""
+    world = _start_supervised_world(monkeypatch)
+    try:
+        # simulate death: make the thread exit by closing its queue only
+        # (stop() not involved, so the supervisor sees a dead thread under
+        # a live pump registration)
+        progress._pump._queue.close()
+        _wait_until(
+            lambda: progress.supervision_stats()["replacements"] >= 1,
+            what="dead-pump replacement")
+        stats = progress.supervision_stats()
+        assert stats["quarantined_comms"] == 0
+        assert stats["abandoned_threads"] == 0  # it died; nothing leaks
+        # the replacement serves traffic end to end
+        reqs, rbuf, row, dst = _post_pair(world)
+        _wait_until(lambda: all(r.done for r in reqs), timeout=30.0,
+                    what="replacement-pump completion")
+        np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    finally:
+        api.finalize()
+
+
+def test_pump_stop_timeout_knob(monkeypatch):
+    """Satellite: the hardcoded 5 s stop() join is now
+    TEMPI_PUMP_STOP_TIMEOUT_S (supervision off here — the ISSUE 1 wedge
+    contract, just faster)."""
+    monkeypatch.setenv("TEMPI_PROGRESS_THREAD", "1")
+    monkeypatch.setenv("TEMPI_PUMP_HEARTBEAT_S", "0")  # supervision off
+    monkeypatch.setenv("TEMPI_PUMP_STOP_TIMEOUT_S", "0.3")
+    envmod.read_environment()
+    world = _start_supervised_world(monkeypatch, heartbeat="0")
+    try:
+        faults.configure("progress.pump_step:wedge:1.0:9")
+        reqs, rbuf, row, dst = _post_pair(world)
+        assert _wait_for_wedge("progress.pump_step")
+        assert progress.supervision_stats()["supervised"] is False
+        p2p.waitall(reqs)
+        th = progress._pump._thread
+        t0 = time.monotonic()
+        assert progress.stop() is False
+        assert 0.25 <= time.monotonic() - t0 < 4.0  # the knob, not 5 s
+        faults.release()
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+    finally:
+        faults.reset()
+        api.finalize()
+
+
+def test_block_wedge_captures_only_the_firing_thread():
+    """The recovery-enabling faults.py semantics: a block-mode wedge
+    parks exactly the thread whose pass fired it; a later pass (the
+    supervisor's replacement pump) observes the sticky wedged state
+    without blocking."""
+    faults.configure("progress.pump_step:wedge:1.0:5")
+    blocked = threading.Event()
+    released = threading.Event()
+
+    def victim():
+        blocked.set()
+        faults.check("progress.pump_step")
+        released.set()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    assert blocked.wait(5.0)
+    assert _wait_for_wedge("progress.pump_step")
+    assert not released.is_set()
+    t0 = time.monotonic()
+    assert faults.check("progress.pump_step") is True  # wedged, observable
+    assert time.monotonic() - t0 < 1.0                 # ...but no block
+    assert not released.is_set()
+    faults.release()
+    t.join(timeout=5.0)
+    assert released.is_set()
+
+
+# -- perf-sheet quarantine (satellite) -----------------------------------------
+
+
+def test_corrupt_perf_sheet_quarantined_once(monkeypatch, tmp_path):
+    """A corrupt cache-dir perf.json is renamed to perf.json.corrupt on
+    the first failed load (keeping the evidence), so every later init
+    falls through to the shipped sheet without re-parsing it."""
+    from tempi_tpu.measure import system as msys
+
+    monkeypatch.setenv("TEMPI_CACHE_DIR", str(tmp_path))
+    envmod.read_environment()
+    bad = tmp_path / "perf.json"
+    bad.write_text("{definitely not json")
+    msys.load_cached()
+    assert not bad.exists()
+    assert (tmp_path / "perf.json.corrupt").read_text() \
+        == "{definitely not json"
+    # a second bad sheet replaces the quarantined evidence (newest wins)
+    bad.write_text("[]")
+    msys.load_cached()
+    assert not bad.exists()
+    assert (tmp_path / "perf.json.corrupt").read_text() == "[]"
+    # and with the slot empty, load just falls through (no rename, no
+    # crash, nothing re-warned)
+    msys.load_cached()
+    assert not bad.exists()
+
+
+# -- registry drift (satellite) ------------------------------------------------
+
+
+def test_every_fault_site_has_a_check_call_site():
+    """SITES and their callers must not silently diverge: every registered
+    name appears in at least one ``faults.check("<site>")`` call in the
+    package source (faults.py itself excluded — docstrings don't count)."""
+    import pathlib
+
+    import tempi_tpu
+
+    root = pathlib.Path(tempi_tpu.__file__).parent
+    blob = "\n".join(p.read_text() for p in sorted(root.rglob("*.py"))
+                     if p.name != "faults.py")
+    for site in faults.SITES:
+        assert f'check("{site}"' in blob, \
+            f"fault site {site!r} registered in faults.SITES has no " \
+            f"faults.check call site in the package"
+
+
+# -- knob parsing --------------------------------------------------------------
+
+
+def test_recovery_knobs_reject_negative_values(monkeypatch):
+    """The new knobs parse as loudly as the ISSUE 1 resilience knobs."""
+    for name in ("TEMPI_RETRY_ATTEMPTS", "TEMPI_BREAKER_THRESHOLD"):
+        monkeypatch.setenv(name, "-2")
+        with pytest.raises(ValueError, match="non-negative"):
+            envmod.read_environment()
+        monkeypatch.delenv(name)
+    for name in ("TEMPI_RETRY_BACKOFF_S", "TEMPI_BREAKER_COOLDOWN_S",
+                 "TEMPI_PUMP_HEARTBEAT_S", "TEMPI_PUMP_STOP_TIMEOUT_S"):
+        monkeypatch.setenv(name, "-0.5")
+        with pytest.raises(ValueError, match="non-negative"):
+            envmod.read_environment()
+        monkeypatch.delenv(name)
+    envmod.read_environment()
+    assert envmod.env.retry_attempts == 0       # defaults documented in env
+    assert envmod.env.breaker_threshold == 3
+    assert envmod.env.pump_stop_timeout_s == 5.0
