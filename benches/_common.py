@@ -79,8 +79,27 @@ def bench_kwargs(quick: bool, throughput: bool = False) -> dict:
     return {}
 
 
+def report_counters(file=None, reset: bool = False) -> None:
+    """Per-run counter report (ISSUE 3 satellite): every nonzero framework
+    counter via the public ``api.counters_snapshot()`` — previously these
+    only surfaced in the DEBUG-gated dump at finalize. Cumulative since
+    the process's last reset (a bench process is one run; a caller
+    reporting several runs passes ``reset=True`` for per-run deltas).
+    Written to stderr so pipelines consuming a bench's CSV stdout are
+    unaffected."""
+    from tempi_tpu import api
+
+    out = file if file is not None else sys.stderr
+    nz = [f"{g}.{k}={v:.6g}" if isinstance(v, float) else f"{g}.{k}={v}"
+          for g, vals in api.counters_snapshot(reset=reset).items()
+          for k, v in vals.items() if v]
+    if nz:
+        print("counters: " + "  ".join(nz), file=out)
+
+
 def emit_csv(header, rows) -> None:
     print(",".join(str(h) for h in header))
     for r in rows:
         print(",".join(f"{v:.6e}" if isinstance(v, float) else str(v)
                        for v in r))
+    report_counters()

@@ -9,6 +9,12 @@ why. Reference analog: the measured-curve dumps of bin/measure-system
 
 Usage: python benches/perf_report.py [path-to-sheet.json]
        (default: the active TEMPI_CACHE_DIR/perf.json)
+
+       python benches/perf_report.py --trace <dump.json>
+       (ISSUE 3: summarize a flight-recorder dump — per-(span, strategy)
+       latency stats from the Chrome trace JSON written by
+       api.trace_dump() / TEMPI_TRACE=full at finalize / the automatic
+       WaitTimeout & breaker-open snapshots)
 """
 
 import json
@@ -31,7 +37,43 @@ def _fmt_t(t: float) -> str:
     return f"{t * 1e6:.1f}us"
 
 
+def trace_report(path: str) -> int:
+    """Per-(span, strategy) latency summary of a flight-recorder dump."""
+    from tempi_tpu.obs import export
+
+    with open(path) as f:
+        doc = json.load(f)
+    rows = export.summarize(doc)
+    instants = sum(1 for ev in doc.get("traceEvents", [])
+                   if ev.get("ph") == "i")
+    meta = doc.get("otherData", {})
+    print(f"trace: {path}")
+    if meta.get("reason"):
+        print(f"captured: {meta['reason']}"
+              + (f" — {meta['detail']}" if meta.get("detail") else ""))
+    if not rows:
+        print(f"no span events ({instants} instant events)")
+        return 1
+    print(f"{'span':>18} {'strategy':>10} {'count':>7} {'mean':>10} "
+          f"{'p50':>10} {'max':>10} {'total':>10}")
+    for r in rows:
+        print(f"{r['name']:>18} {r['strategy']:>10} {r['count']:>7} "
+              f"{_fmt_t(r['mean_us'] / 1e6):>10} "
+              f"{_fmt_t(r['p50_us'] / 1e6):>10} "
+              f"{_fmt_t(r['max_us'] / 1e6):>10} "
+              f"{_fmt_t(r['total_us'] / 1e6):>10}")
+    print(f"(+ {instants} instant events; open the file in "
+          "https://ui.perfetto.dev for the timeline)")
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--trace":
+        if len(sys.argv) < 3:
+            print("usage: perf_report.py --trace <dump.json>",
+                  file=sys.stderr)
+            return 2
+        return trace_report(sys.argv[2])
     from tempi_tpu.measure import system as msys
 
     # purely a FILE reader: this tool must never call jax (current_platform

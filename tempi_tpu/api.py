@@ -33,6 +33,9 @@ def init(devices=None) -> Communicator:
     faults.configure()  # arm TEMPI_FAULTS after the env parse; a bad
     # spec fails init loudly (a chaos run that silently tests nothing
     # is worse than no chaos run)
+    from .obs import trace as obstrace
+    obstrace.configure()  # arm TEMPI_TRACE the same way: a typo'd mode
+    # must fail init, not silently record nothing
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -152,6 +155,12 @@ def finalize() -> None:
             # deliberately leak the pools rather than free memory under it
             log.error("finalize: progress thread wedged; leaking slab pools")
         counters.finalize()
+        # AFTER events.finalize (leak trace events must land in the dump),
+        # BEFORE health.reset: full mode writes the merged multi-rank
+        # trace here, then the recorder resets — per-session, like
+        # counters
+        from .obs import trace as obstrace
+        obstrace.finalize()
         type_cache.clear()
         from .runtime import health
         health.reset()  # breaker history is per-session, like counters
@@ -176,6 +185,32 @@ def health_snapshot() -> dict:
     snap = health.snapshot()
     snap["pump"] = progress.supervision_stats()
     return snap
+
+
+def counters_snapshot(reset: bool = False) -> dict:
+    """Public, resettable access to the performance counters (ISSUE 3
+    satellite): the grouped counters as a nested dict — previously only
+    visible via the DEBUG-gated dump at finalize. ``reset=True`` zeroes
+    them after reading (per-interval scraping). Callable any time."""
+    return counters.snapshot(reset=reset)
+
+
+def trace_snapshot() -> list:
+    """Current flight-recorder contents (ISSUE 3): the merged, time-sorted
+    event list from every thread's ring — empty unless ``TEMPI_TRACE`` is
+    ``flight``/``full``. Pure data — safe to serialize. See
+    :func:`trace_dump` for the Perfetto-openable form."""
+    from .obs import trace as obstrace
+    return obstrace.snapshot()
+
+
+def trace_dump(path: Optional[str] = None) -> str:
+    """Write the flight recorder as Chrome trace-event JSON (opens in
+    https://ui.perfetto.dev or chrome://tracing) and return the path.
+    ``path=None`` resolves ``TEMPI_TRACE_PATH``, falling back to
+    ``./tempi-trace.json``."""
+    from .obs import trace as obstrace
+    return obstrace.dump(path)
 
 
 def initialized() -> bool:

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs import trace as obstrace
 from ..runtime import faults
 from ..utils import compat
 from ..utils import logging as log
@@ -104,6 +105,7 @@ def _capture_section(sp, name: str, fn, ckpt=None) -> bool:
     import copy
 
     prior = copy.deepcopy(getattr(sp, name))
+    t0 = time.monotonic() if obstrace.ENABLED else 0.0
     try:
         if faults.ENABLED:
             faults.check("sweep.section")
@@ -113,11 +115,16 @@ def _capture_section(sp, name: str, fn, ckpt=None) -> bool:
         unm = sp.measured_conditions.setdefault("unmeasured_sections", [])
         if name not in unm:
             unm.append(name)
+        if obstrace.ENABLED:
+            obstrace.emit_span("sweep.section", t0, section=name,
+                               outcome="faulted", error=repr(e)[:200])
         log.warn(f"sweep section {name!r} faulted mid-capture; prior "
                  f"curves kept, section marked unmeasured: {e!r}")
         if ckpt is not None:
             ckpt()
         return False
+    if obstrace.ENABLED:
+        obstrace.emit_span("sweep.section", t0, section=name, outcome="ok")
     unm = sp.measured_conditions.get("unmeasured_sections")
     if unm and name in unm:
         unm.remove(name)
@@ -541,8 +548,16 @@ def _pingpong_curve(devs, quick, kw, lockstep: bool = False):
     fn = jax.jit(compat.shard_map(roundtrip, mesh=mesh, in_specs=P("p", None),
                                out_specs=P("p", None), check_vma=False))
     iters = kw.get("max_samples") or (10 if quick else 30)
+
+    # NOT the one-call device_put: on a multi-process mesh jax's hidden
+    # assert_equal collective can cross a still-draining 1 MiB ppermute on
+    # the same Gloo TCP pair and abort both processes with a
+    # preamble-length mismatch (observed: "op.preamble.length <=
+    # op.nbytes. 1048576 vs 12"); see put_global
+    from ..parallel.communicator import put_global
+
     for nb in _transfer_sizes(quick):
-        x = jax.device_put(np.zeros((2, nb), np.uint8), sh)
+        x = put_global(np.zeros((2, nb), np.uint8), sh)
         fn(x).block_until_ready()
         if lockstep:
             times = []

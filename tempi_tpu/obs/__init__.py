@@ -1,0 +1,23 @@
+"""Observability subsystem: request-lifecycle tracing and export (ISSUE 3).
+
+The reference TEMPI stack's only runtime introspection is NVTX ranges and
+the per-rank counter dump at finalize (include/counters.hpp,
+src/internal/streams.cpp nvtx naming) — enough to profile a healthy run,
+useless to explain a failure after the fact. This package adds the layer
+every serving stack has:
+
+  * :mod:`tempi_tpu.obs.trace` — a lock-light per-thread ring-buffer
+    flight recorder of structured runtime events, armed by ``TEMPI_TRACE``
+    and free (one module-flag truth test per site) when off;
+  * :mod:`tempi_tpu.obs.export` — Chrome trace-event JSON export (opens
+    directly in Perfetto / chrome://tracing) and the per-strategy span
+    summaries ``benches/perf_report.py --trace`` prints.
+
+Instrumented layers: the p2p engine (post/match/dispatch/drain/complete/
+cancel/repost), the background progress pump and its supervisor verdicts,
+the circuit-breaker health registry, per-pair alltoallv lowering, and the
+measurement sweep's sections. Every ``WaitTimeout`` and breaker-open
+automatically snapshots the flight recorder next to its diagnostics.
+"""
+
+from . import export, trace  # noqa: F401

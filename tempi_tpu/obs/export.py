@@ -1,0 +1,96 @@
+"""Chrome trace-event export and span summaries for the flight recorder.
+
+The reference's observability affordance is NVTX: named ranges that a
+Perfetto-family viewer renders (streams.cpp nvtxNameCudaStreamA; see
+runtime/events.py for the TPU analog). This module gives the flight
+recorder the same destination without a profiler attached: recorder
+snapshots serialize to the Chrome trace-event JSON format
+(https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU),
+which opens directly in https://ui.perfetto.dev or chrome://tracing.
+
+Lane mapping for the merged multi-rank dump: events that name a library
+rank (``rank`` field >= 0) land in that rank's process lane (pid =
+rank + 1, named "rank N"); rank-less runtime events (pump, sweep,
+breakers) share pid 0, "runtime". Thread lanes carry the recording
+thread's name, so the application thread, the background pump, its
+supervisor-spawned replacements, and the watchdog are distinguishable.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+_CAT = "tempi"
+
+
+def to_chrome(events: List[Dict[str, Any]],
+              metadata: Optional[dict] = None) -> dict:
+    """Recorder snapshot (:func:`tempi_tpu.obs.trace.snapshot` dicts) ->
+    Chrome trace-event JSON document. Span events (with ``dur``) become
+    complete ("X") events; the rest become instants ("i"). Timestamps are
+    microseconds since the session epoch."""
+    tes: List[dict] = []
+    pids: Dict[int, str] = {}
+    threads: Dict[tuple, str] = {}
+    for d in events:
+        rank = d.get("rank")
+        pid = rank + 1 if isinstance(rank, int) and rank >= 0 else 0
+        pids.setdefault(pid, f"rank {rank}" if pid else "runtime")
+        tid = d.get("tid", 0)
+        threads.setdefault((pid, tid), d.get("thread", f"thread {tid}"))
+        args = {k: v for k, v in d.items()
+                if k not in ("ts", "dur", "name", "tid", "thread")}
+        ev: Dict[str, Any] = dict(name=d["name"], cat=_CAT, pid=pid, tid=tid,
+                                  ts=round(d["ts"] * 1e6, 3))
+        if "dur" in d:
+            ev["ph"] = "X"
+            ev["dur"] = round(d["dur"] * 1e6, 3)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"  # instant scoped to its thread
+        if args:
+            ev["args"] = args
+        tes.append(ev)
+    meta = [dict(name="process_name", ph="M", pid=pid, tid=0,
+                 args=dict(name=label))
+            for pid, label in sorted(pids.items())]
+    meta += [dict(name="thread_name", ph="M", pid=pid, tid=tid,
+                  args=dict(name=label))
+             for (pid, tid), label in sorted(threads.items())]
+    return {"traceEvents": meta + tes, "displayTimeUnit": "ms",
+            "otherData": dict(metadata or {}, exporter="tempi_tpu.obs")}
+
+
+def write(path: str, events: List[Dict[str, Any]],
+          metadata: Optional[dict] = None) -> str:
+    """Serialize a snapshot to ``path`` as Chrome trace JSON; returns the
+    path. Non-JSON-native field values (an exception repr that slipped in
+    raw, a numpy scalar) degrade to ``str`` rather than failing the dump —
+    a failure snapshot that refuses to serialize is no snapshot at all."""
+    with open(path, "w") as f:
+        json.dump(to_chrome(events, metadata), f, default=str)
+    return path
+
+
+def summarize(doc: dict) -> List[dict]:
+    """Per-(span name, strategy) latency summary of a Chrome trace dump —
+    what ``benches/perf_report.py --trace`` prints. Returns rows sorted by
+    total time descending: ``{name, strategy, count, total_us, mean_us,
+    p50_us, max_us}``."""
+    groups: Dict[tuple, List[float]] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        strategy = (ev.get("args") or {}).get("strategy", "-")
+        groups.setdefault((ev["name"], strategy), []).append(
+            float(ev.get("dur", 0.0)))
+    rows = []
+    for (name, strategy), durs in groups.items():
+        durs.sort()
+        n = len(durs)
+        rows.append(dict(name=name, strategy=strategy, count=n,
+                         total_us=sum(durs), mean_us=sum(durs) / n,
+                         p50_us=durs[n // 2], max_us=durs[-1]))
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows

@@ -1,0 +1,335 @@
+"""Flight-recorder core: lock-light per-thread ring buffers of runtime events.
+
+No reference analog beyond NVTX ranges: the reference can show a healthy
+run's timeline in Perfetto but keeps no evidence once something fails. This
+recorder is the missing black box — after PR 1 (fault injection) and PR 2
+(self-healing), a fault is *recovered from* but never *explainable*,
+because the evidence (which requests were in flight, what the breaker saw,
+when the pump last beat) is gone by the time anyone asks. Here every
+instrumented layer appends structured events (monotonic ts, kind, rank,
+peer, tag, nbytes, strategy, request id, outcome) to a bounded per-thread
+ring, and the ring is snapshotted automatically next to each failure's
+diagnostics.
+
+Knobs (parsed LOUDLY in utils/env.py, like the resilience knobs)::
+
+    TEMPI_TRACE        = off | flight | full      (default off)
+    TEMPI_TRACE_EVENTS = per-thread ring capacity (default 4096)
+    TEMPI_TRACE_PATH   = file stem or directory for dumps/snapshots
+
+Modes:
+  off    — nothing recorded; every instrumented site costs one
+           module-attribute truth test (no event objects constructed, no
+           ring allocated — the zero-cost pattern of ``runtime/faults.py``).
+  flight — events recorded into the rings; dumped only on failure (every
+           ``WaitTimeout`` and breaker-open snapshots the recorder — the
+           snapshot rides the exception as ``e.trace`` and, with
+           ``TEMPI_TRACE_PATH`` set, lands on disk as Chrome trace JSON)
+           or on demand (``api.trace_snapshot()`` / ``api.trace_dump()``).
+  full   — flight, plus a merged multi-rank dump written automatically at
+           ``api.finalize()``.
+
+Hot-path contract (acceptance criterion: < 1 % ``bench_mpi_isend``
+regression with tracing off): sites guard themselves with the module-level
+``ENABLED`` flag —
+
+    if obstrace.ENABLED:
+        obstrace.emit("p2p.post", rank=r, peer=p, tag=t, nbytes=n)
+
+— and spans on hot paths use the two-call form so even ``time.monotonic``
+is skipped when off::
+
+    t0 = time.monotonic() if obstrace.ENABLED else 0.0
+    ...work...
+    if obstrace.ENABLED:
+        obstrace.emit_span("p2p.dispatch", t0, strategy=s, outcome="ok")
+
+Concurrency: each thread appends to its OWN ring (no lock on the append
+path; the module lock guards only configuration swaps and the registry of
+rings). ``snapshot()`` reads other threads' rings without stopping them —
+a torn read can at worst miss or duplicate the newest event per ring,
+which is acceptable for diagnostics and keeps the recorder off every hot
+path's lock graph.
+
+NOTE: distinct from ``TEMPI_TRACE_DIR`` (utils/env.py), which arms the
+*device*-side jax profiler over the whole init..finalize window. This
+recorder is host-side, structured, always-cheap, and failure-scoped.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils import env as envmod
+from ..utils import logging as log
+
+MODES = ("off", "flight", "full")
+
+#: Module-level fast-path flag: True iff mode != off. Instrumented sites
+#: test this before calling into the module (see module docstring).
+ENABLED = False
+MODE = "off"
+
+_DEFAULT_CAPACITY = 4096
+_FAILURE_KEEP = 20  # bounded failure-snapshot history (diagnostics, not logs)
+
+_lock = threading.Lock()  # guards config swaps + ring registry, NOT appends
+_rings: List["_Ring"] = []
+_tls = threading.local()
+_gen = 0          # bumped by configure()/reset(): stale rings detach lazily
+_capacity = _DEFAULT_CAPACITY
+_path = ""
+_t0 = time.monotonic()   # session epoch; exported timestamps are relative
+_snap_seq = itertools.count(1)
+_failures: List[dict] = []
+
+
+class TraceConfigError(ValueError):
+    """A malformed trace knob (fails loudly at configure time — a typo'd
+    TEMPI_TRACE that silently recorded nothing would defeat the one run
+    where the evidence mattered)."""
+
+
+class _Ring:
+    """One thread's event ring. ``append`` runs only on the owning thread;
+    cross-thread readers (:func:`snapshot`) tolerate approximate
+    consistency at the write cursor."""
+
+    __slots__ = ("buf", "cap", "idx", "total", "tid", "tname", "gen")
+
+    def __init__(self, cap: int, gen: int):
+        self.buf: List[Optional[tuple]] = [None] * cap
+        self.cap = cap
+        self.idx = 0
+        self.total = 0     # lifetime appends; total - cap = dropped
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.tname = t.name
+        self.gen = gen
+
+    def append(self, ev: tuple) -> None:
+        i = self.idx
+        self.buf[i] = ev
+        self.idx = (i + 1) % self.cap
+        self.total += 1
+
+    def events(self) -> List[tuple]:
+        """Events oldest-first (wraparound unrolled)."""
+        if self.total <= self.cap:
+            return [e for e in self.buf[: self.idx] if e is not None]
+        i = self.idx
+        return [e for e in self.buf[i:] + self.buf[:i] if e is not None]
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.total - self.cap)
+
+
+def configure(mode: Optional[str] = None, capacity: Optional[int] = None,
+              path: Optional[str] = None) -> None:
+    """(Re)arm the recorder. ``None`` arguments read the parsed env's
+    ``trace_mode``/``trace_events``/``trace_path`` (so call after
+    ``read_environment``); explicit values override (test convenience).
+    Clears all rings and the failure-snapshot history — the recorder is
+    per-session state, like counters."""
+    global ENABLED, MODE, _capacity, _path, _gen, _t0
+    if mode is None:
+        mode = getattr(envmod.env, "trace_mode", "off")
+    if mode not in MODES:
+        raise TraceConfigError(
+            f"bad trace mode {mode!r}: want one of {MODES}")
+    if capacity is None:
+        capacity = getattr(envmod.env, "trace_events", _DEFAULT_CAPACITY)
+    if int(capacity) <= 0:
+        raise TraceConfigError(
+            f"bad trace ring capacity {capacity!r}: want a positive integer")
+    if path is None:
+        path = getattr(envmod.env, "trace_path", "")
+    with _lock:
+        MODE = mode
+        ENABLED = mode != "off"
+        _capacity = int(capacity)
+        _path = path or ""
+        _gen += 1
+        _rings.clear()
+        _failures.clear()
+        _t0 = time.monotonic()
+    if ENABLED:
+        log.debug(f"trace recorder armed: mode={mode} "
+                  f"capacity={_capacity}/thread"
+                  + (f" path={_path}" if _path else ""))
+
+
+def reset() -> None:
+    """Drop all recorded events and failure snapshots, keeping the
+    configured mode (session teardown / test isolation)."""
+    global _gen, _t0
+    with _lock:
+        _gen += 1
+        _rings.clear()
+        _failures.clear()
+        _t0 = time.monotonic()
+
+
+def _ring() -> _Ring:
+    r = getattr(_tls, "ring", None)
+    if r is None or r.gen != _gen:
+        r = _Ring(_capacity, _gen)
+        _tls.ring = r
+        with _lock:
+            # a configure() racing this creation bumps _gen; the stale ring
+            # must not register (its events would survive the reset)
+            if r.gen == _gen:
+                _rings.append(r)
+    return r
+
+
+def emit(name: str, **fields: Any) -> None:
+    """Record one instant event. Callers guard with ``ENABLED``."""
+    _ring().append((time.monotonic(), None, name, fields or None))
+
+
+def emit_span(name: str, t0: float, **fields: Any) -> None:
+    """Record one duration event begun at ``t0`` (a ``time.monotonic()``
+    stamp the caller took before the work). Callers guard with
+    ``ENABLED`` — on hot paths, around BOTH the stamp and this call."""
+    _ring().append((t0, time.monotonic() - t0, name, fields or None))
+
+
+class span:
+    """Context-manager span for non-hot paths (pump iterations, sweep
+    sections): records a duration event on exit, stamping
+    ``outcome="error"`` + the repr when the body raised (unless the body
+    already set an outcome via :meth:`note`)."""
+
+    __slots__ = ("name", "fields", "t0")
+
+    def __init__(self, name: str, **fields: Any):
+        self.name = name
+        self.fields = fields
+
+    def __enter__(self) -> "span":
+        self.t0 = time.monotonic()
+        return self
+
+    def note(self, **fields: Any) -> None:
+        self.fields.update(fields)
+
+    def __exit__(self, et, ev, tb) -> bool:
+        if et is not None and "outcome" not in self.fields:
+            self.fields["outcome"] = "error"
+            self.fields["error"] = repr(ev)[:200]
+        emit_span(self.name, self.t0, **self.fields)
+        return False
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """Merged view of every thread's ring, oldest-first: one plain dict
+    per event (``ts`` seconds since the session epoch, ``dur`` for spans,
+    ``name``, ``tid``/``thread``, plus the event's structured fields).
+    Pure data — safe to serialize. Empty when tracing is off."""
+    with _lock:
+        rings = list(_rings)
+        t0 = _t0
+    out: List[Dict[str, Any]] = []
+    for r in rings:
+        for ts, dur, name, fields in r.events():
+            d: Dict[str, Any] = dict(ts=ts - t0, name=name, tid=r.tid,
+                                     thread=r.tname)
+            if dur is not None:
+                d["dur"] = dur
+            if fields:
+                d.update(fields)
+            out.append(d)
+    out.sort(key=lambda d: d["ts"])
+    return out
+
+
+def stats() -> dict:
+    """Recorder bookkeeping for assertions/diagnostics: mode, per-thread
+    capacity, ring count, live event count, and how many events the rings
+    have dropped to wraparound."""
+    with _lock:
+        rings = list(_rings)
+    return dict(mode=MODE, capacity=_capacity, threads=len(rings),
+                events=sum(min(r.total, r.cap) for r in rings),
+                dropped=sum(r.dropped for r in rings),
+                failure_snapshots=len(_failures))
+
+
+def failures() -> List[dict]:
+    """The bounded history of failure snapshots taken this session
+    (newest last): ``{reason, detail, path, events}`` dicts."""
+    with _lock:
+        return list(_failures)
+
+
+def _snapshot_file(reason: str, seq: int) -> str:
+    """Where an auto-snapshot lands for the configured TEMPI_TRACE_PATH:
+    a directory gets ``tempi-trace-<reason>-<seq>.json`` inside it; a
+    file path gets the suffix spliced before its extension so repeated
+    failures never overwrite each other's evidence."""
+    if os.path.isdir(_path):
+        return os.path.join(_path, f"tempi-trace-{reason}-{seq}.json")
+    stem, ext = os.path.splitext(_path)
+    return f"{stem}-{reason}-{seq}{ext or '.json'}"
+
+
+def failure_snapshot(reason: str, detail: str = "") -> dict:
+    """Capture the flight recorder next to a failure's diagnostics: the
+    snapshot is appended to the bounded :func:`failures` history and,
+    with ``TEMPI_TRACE_PATH`` set, written to disk as Chrome trace JSON
+    (the file every ``WaitTimeout``/breaker-open names in its warning).
+    Never raises — evidence capture must not mask the failure itself."""
+    snap = dict(reason=reason, detail=str(detail)[:500], path="",
+                events=snapshot())
+    if _path:
+        try:
+            from . import export
+            with _lock:
+                seq = next(_snap_seq)
+            out = _snapshot_file(reason, seq)
+            export.write(out, snap["events"],
+                         metadata=dict(reason=reason,
+                                       detail=snap["detail"]))
+            snap["path"] = out
+            log.warn(f"flight recorder snapshot ({reason}) written to {out}")
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            log.warn(f"flight recorder snapshot ({reason}) failed to "
+                     f"write: {e!r}")
+    with _lock:
+        _failures.append(snap)
+        del _failures[:-_FAILURE_KEEP]
+    return snap
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write the current merged snapshot as Chrome trace-event JSON and
+    return the path. ``path=None`` resolves TEMPI_TRACE_PATH (a directory
+    gets ``tempi-trace.json`` inside it), falling back to
+    ``./tempi-trace.json``."""
+    from . import export
+    if path is None:
+        path = _path or "tempi-trace.json"
+        if os.path.isdir(path):
+            path = os.path.join(path, "tempi-trace.json")
+    return export.write(path, snapshot(), metadata=dict(reason="dump"))
+
+
+def finalize() -> Optional[str]:
+    """Session teardown hook (api.finalize): in ``full`` mode write the
+    merged multi-rank dump, then reset — recorder history is per-session,
+    like counters. Returns the dump path, if one was written."""
+    out = None
+    if ENABLED and MODE == "full":
+        try:
+            out = dump()
+            log.info(f"trace dump written to {out}")
+        except Exception as e:  # noqa: BLE001 — teardown must not fail
+            log.warn(f"finalize trace dump failed: {e!r}")
+    reset()
+    return out

@@ -24,6 +24,7 @@ single-controller style); counts are in elements of a dense datatype.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..obs import trace as obstrace
 from ..ops import dtypes, type_cache
 from ..ops.dtypes import Datatype
 from ..runtime import faults
@@ -424,6 +426,7 @@ def _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order: str):
     # pre-committed BYTE with count=n: see the tail-message note in
     # _device_fused (no per-length type-cache growth)
     packer = type_cache.get_or_commit(dtypes.BYTE).best_packer()
+    t0 = time.monotonic() if obstrace.ENABLED else 0.0
     for a, p in pairs:
         if faults.ENABLED:
             # per-peer injection site of the isend/irecv lowering: a raise
@@ -431,12 +434,18 @@ def _pair_messages(comm, sendbuf, sc, sd, recvbuf, rd, order: str):
             # dispatches only after every pair is built), so a faulted
             # alltoallv is clean-failed, never half-applied
             faults.check("alltoallv.pair")
+        if obstrace.ENABLED:
+            obstrace.emit("alltoallv.pair", rank=comm.library_rank(a),
+                          peer=comm.library_rank(p), nbytes=int(sc[a, p]))
         n = int(sc[a, p])
         msgs.append(Message(
             src=comm.library_rank(a), dst=comm.library_rank(p), tag=0,
             nbytes=n, sbuf=sendbuf, spacker=packer, scount=n,
             soffset=int(sd[a, p]), rbuf=recvbuf, rpacker=packer, rcount=n,
             roffset=int(rd[p, a])))
+    if obstrace.ENABLED:
+        obstrace.emit_span("alltoallv.lower", t0, pairs=len(pairs),
+                           order=order)
     return msgs
 
 

@@ -30,6 +30,28 @@ from . import topology as topo_mod
 
 AXIS = "ranks"
 
+
+def put_global(host: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """device_put of an identical-on-every-process host array onto a
+    (possibly multi-process) sharding. Multi-controller worlds cannot use
+    the one-call ``jax.device_put(np, sharding)``: jax internally verifies
+    the input is identical across processes with an ``assert_equal``
+    COLLECTIVE, which the multiprocess CPU backend refuses outright
+    ("Multiprocess computations aren't implemented on the CPU backend" —
+    the test_two_process_dcn_exchange failure), can cross other in-flight
+    Gloo traffic on the same TCP pair (a preamble-length abort, see
+    measure/sweep._pingpong_curve), and is a needless sync on TPU (the
+    SPMD contract already guarantees identical arguments). Assemble the
+    global array from this process's addressable shards instead."""
+    if jax.process_count() == 1:
+        return jax.device_put(host, sharding)
+    arrays = [jax.device_put(host[idx], d)
+              for d, idx in sharding.addressable_devices_indices_map(
+                  host.shape).items()]
+    return jax.make_array_from_single_device_arrays(host.shape, sharding,
+                                                    arrays)
+
+
 # every live communicator, so finalize can release cached resources held by
 # derived (dist-graph) communicators the app never explicitly freed
 _all_comms: "weakref.WeakSet[Communicator]" = weakref.WeakSet()
@@ -104,9 +126,12 @@ class Communicator:
     def sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(AXIS, None))
 
+    def _put_global(self, host: np.ndarray) -> jax.Array:
+        return put_global(host, self.sharding())
+
     def alloc(self, nbytes: int) -> "DistBuffer":
-        data = jax.device_put(
-            np.zeros((self.size, nbytes), dtype=np.uint8), self.sharding())
+        data = self._put_global(np.zeros((self.size, nbytes),
+                                         dtype=np.uint8))
         return DistBuffer(self, nbytes, data)
 
     def buffer_from_host(self, rows: Sequence[np.ndarray]) -> "DistBuffer":
@@ -118,7 +143,7 @@ class Communicator:
         for ar, row in enumerate(rows):
             assert len(row) == nbytes
             lib_rows[self.library_rank(ar)] = np.asarray(row, dtype=np.uint8)
-        data = jax.device_put(np.stack(lib_rows), self.sharding())
+        data = self._put_global(np.stack(lib_rows))
         return DistBuffer(self, nbytes, data)
 
     def free(self) -> None:

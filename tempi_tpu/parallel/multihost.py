@@ -88,6 +88,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
             v = os.environ.get(name)
             return int(v) if v else None
 
+        # The CPU PJRT client is built WITHOUT a cross-process collectives
+        # implementation unless one is selected before backend init — a
+        # multi-process CPU world then joins fine but every jitted
+        # computation over the global mesh dies with "Multiprocess
+        # computations aren't implemented on the CPU backend" (the
+        # test_two_process_dcn_exchange regression: newer jaxlib also
+        # routes device_put-onto-a-multiprocess-sharding through such a
+        # computation). Selecting Gloo here is a no-op for TPU/GPU
+        # backends and must precede the first backend touch, which
+        # jax.distributed.initialize below does not count as.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # older jax without the option
+            log.debug(f"cpu collectives selection unavailable: {e!r}")
+
         _initialize_with_retry(lambda: jax.distributed.initialize(
             coordinator_address=addr,
             num_processes=(num_processes

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
+from ..obs import trace as obstrace
 from ..runtime import faults, health
 from ..ops import type_cache
 from ..ops.dtypes import Datatype
@@ -102,6 +103,22 @@ class WaitTimeout(RuntimeError):
             f"incomplete request(s): [{lines}]")
         self.timeout_s = timeout_s
         self.stuck = stuck
+        # flight-recorder auto-snapshot (ISSUE 3): the diagnostics above
+        # say WHAT is stuck; the snapshot preserves HOW it got there (the
+        # posts, dispatches, retries, and breaker events leading up to the
+        # deadline). Taken in the constructor so every raise site — eager,
+        # persistent, completion-sync drain — gets it uniformly. Rides the
+        # exception as ``.trace`` and lands on disk when TEMPI_TRACE_PATH
+        # is set.
+        self.trace = None
+        if obstrace.ENABLED:
+            try:
+                obstrace.emit("p2p.wait_timeout", stuck=len(stuck),
+                              timeout_s=timeout_s)
+                self.trace = obstrace.failure_snapshot(
+                    "wait-timeout", detail=str(self))
+            except Exception:  # noqa: BLE001
+                pass  # evidence capture must never mask the timeout
 
 
 # bounded waits re-drive progress at this period; small enough that a
@@ -193,6 +210,12 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
         if comm.freed:
             raise RuntimeError("communicator has been freed")
         comm._pending.append(op)
+        if obstrace.ENABLED:
+            # UNDER the lock: any pump thread that matches this op must
+            # serialize behind this frame, so the trace can never show a
+            # match/dispatch preceding the post that caused it
+            obstrace.emit("p2p.post", kind=kind, rank=rank_lib,
+                          peer=peer_lib, tag=tag, nbytes=nbytes, req=req.id)
     from ..runtime import progress
     progress.notify(comm)
     group = ctr.counters.isend if kind == "send" else ctr.counters.irecv
@@ -456,9 +479,16 @@ def try_progress(comm: Communicator, strategy: Optional[str] = None,
         if comm.freed:
             raise RuntimeError("communicator has been freed with operations "
                                "still pending")
+        t0 = time.monotonic() if obstrace.ENABLED else 0.0
         messages, consumed, leftover = _match(comm._pending)
         if not messages:
             return 0
+        if obstrace.ENABLED:
+            # only fruitful matches are recorded — bounded waits re-drive
+            # progress every couple of ms and an event per empty poll
+            # would wrap the ring past the evidence that matters
+            obstrace.emit_span("p2p.match", t0, matched=len(messages),
+                               pending=len(leftover))
         groups = None
         if compiled_only:
             groups = _group_by_strategy(comm, messages, strategy)
@@ -558,6 +588,7 @@ def _execute_matched(comm: Communicator, messages, consumed,
         for op in ops:
             op.request.strategy = strat  # names the breaker key at
             # completion time (and the real transport in diagnostics)
+        t0 = time.monotonic() if obstrace.ENABLED else 0.0
         try:
             plan = get_plan(comm, batch)
             plan.run(strat)
@@ -565,6 +596,11 @@ def _execute_matched(comm: Communicator, messages, consumed,
                 plans_out.append((plan, strat,
                                   (plan.bufs, plan.messages, plan.rounds)))
         except Exception as e:
+            if obstrace.ENABLED:
+                obstrace.emit_span(
+                    "p2p.dispatch", t0, strategy=strat, msgs=len(batch),
+                    nbytes=sum(m.nbytes for m in batch), outcome="error",
+                    error=repr(e)[:200])
             # feed the health registry BEFORE unwinding: a strategy whose
             # compiled plan keeps faulting on this link must eventually
             # trip its breaker and be skipped in AUTO decisions. ONE
@@ -583,8 +619,16 @@ def _execute_matched(comm: Communicator, messages, consumed,
         # in the completion drain (the wedged-tunnel signature) must
         # accumulate failures, not reset its own counter on every
         # dispatch. _record_success_reqs runs at drain time instead.
+        if obstrace.ENABLED:
+            obstrace.emit_span(
+                "p2p.dispatch", t0, strategy=strat, msgs=len(batch),
+                nbytes=sum(m.nbytes for m in batch), outcome="ok")
         for op in ops:
             op.request.done = True
+            if obstrace.ENABLED:
+                obstrace.emit("p2p.complete", req=op.request.id,
+                              kind=op.kind, rank=op.rank, peer=op.peer,
+                              tag=op.tag, strategy=strat)
 
 
 def _diag(req: Request, strategy: Optional[str]) -> dict:
@@ -911,8 +955,11 @@ def _sync_bufs(bufs: Sequence[DistBuffer], deadline: Optional[float] = None,
         events.release(ev)
 
     for b in bufs:
+        t0 = time.monotonic() if obstrace.ENABLED else 0.0
         if deadline is None:
             drain(b)
+            if obstrace.ENABLED:
+                obstrace.emit_span("p2p.drain", t0, outcome="ok")
             continue
         remaining = deadline - time.monotonic()
         if remaining <= 0:
@@ -926,6 +973,8 @@ def _sync_bufs(bufs: Sequence[DistBuffer], deadline: Optional[float] = None,
             remaining = 0.05
         res = faults.call_with_timeout(lambda b=b: drain(b), remaining)
         if res == "timeout":
+            if obstrace.ENABLED:
+                obstrace.emit_span("p2p.drain", t0, outcome="timeout")
             stuck = (stuck_fn(b) if stuck_fn is not None else
                      [dict(kind="?", rank=-1, peer=-1, tag=0,
                            nbytes=0, strategy="auto", age_s=0.0,
@@ -942,7 +991,12 @@ def _sync_bufs(bufs: Sequence[DistBuffer], deadline: Optional[float] = None,
                     health.record_failure(lk, strat, error="completion-sync")
             raise WaitTimeout(envmod.env.wait_timeout_s, stuck)
         if isinstance(res, BaseException):
+            if obstrace.ENABLED:
+                obstrace.emit_span("p2p.drain", t0, outcome="error",
+                                   error=repr(res)[:200])
             raise res
+        if obstrace.ENABLED:
+            obstrace.emit_span("p2p.drain", t0, outcome="ok")
 
 
 # -- persistent requests ------------------------------------------------------
@@ -1219,6 +1273,10 @@ def cancel(reqs: Sequence[Request]) -> None:
     for c in _distinct_comms(reqs):
         with c._progress_lock:
             _withdraw_pending(c, [r for r in reqs if r.comm is c])
+    if obstrace.ENABLED:
+        for r in reqs:
+            obstrace.emit("p2p.cancel", req=r.id, kind=r.kind, rank=r.rank,
+                          peer=r.peer, tag=r.tag)
 
 
 # -- retry-with-demotion (ISSUE 2) --------------------------------------------
@@ -1267,6 +1325,9 @@ def _with_retry(attempt, note, repost, retryable=None) -> None:
                 raise
             if faults.ENABLED:
                 faults.check("p2p.repost")  # chaos on the recovery path
+            if obstrace.ENABLED:
+                obstrace.emit("p2p.retry", attempt=attempt_no + 1,
+                              retries=retries)
             repost()
             delay = envmod.env.retry_backoff_s * (2 ** attempt_no)
             if delay > 0:
@@ -1366,6 +1427,10 @@ def _repost(reqs: Sequence[Request]) -> None:
             for op in stale:
                 op.request.posted_at = now
                 c._pending.append(op)
+    if obstrace.ENABLED:
+        for r in reqs:
+            obstrace.emit("p2p.repost", req=r.id, kind=r.kind, rank=r.rank,
+                          peer=r.peer, tag=r.tag)
     for c in comms:
         progress.notify(c)
 

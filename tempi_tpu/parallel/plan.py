@@ -20,6 +20,7 @@ Transport strategies (reference DEVICE/STAGED/ONESHOT, sender.cpp:88-249):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import trace as obstrace
 from ..runtime import faults
 from ..utils import compat
 from ..utils import counters as ctr
@@ -488,6 +490,7 @@ class ExchangePlan:
                 # pack, so a raise leaves buffers exactly as the previous
                 # round left them (rebind() has already restored datas)
                 faults.check("p2p.staged_copy")
+            t0 = time.monotonic() if obstrace.ENABLED else 0.0
             pf, uf = fns[ri]
             if host_kind is not None:
                 try:
@@ -542,6 +545,14 @@ class ExchangePlan:
             self._staging_inflight = dev
             datas = list(uf(dev, *datas))
             rebind()
+            if obstrace.ENABLED:
+                # the pack -> D2H -> host-move -> H2D -> unpack unit of the
+                # staged/oneshot transports, one span per round: the
+                # per-strategy latency the --trace report attributes
+                obstrace.emit_span(
+                    "p2p.staged_round", t0, round=ri,
+                    strategy="oneshot" if host_kind else "staged",
+                    nbytes=int(host.nbytes))
 
     def _round_moves(self, ri: int):
         """Host-transport index groups for round ``ri``, built once per plan:

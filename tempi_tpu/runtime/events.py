@@ -19,15 +19,31 @@ events after pack_async (async_operation.cpp:119,161).
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import threading
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 
+from ..obs import trace as obstrace
 from ..utils import counters as ctr
 from ..utils import logging as log
 
 PREWARM = 5  # reference pre-creates 5 events (events.cpp:69)
+
+
+def _caller_site() -> str:
+    """file:line of the first frame outside this module — the creation
+    site a leaked event is reported against (the reference's events.cpp
+    finalize check names leak sites the same way). Only paid when the
+    flight recorder is armed; the healthy hot path never walks frames."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
 
 
 class Event:
@@ -67,28 +83,43 @@ class _EventPool:
         self._lock = threading.Lock()
         self._free: List[Event] = [Event() for _ in range(PREWARM)]
         self._outstanding = 0
+        # id(event) -> creation site, tracked only while the flight
+        # recorder is armed (zero-cost contract: untraced runs keep the
+        # bare counter the seed had)
+        self._sites: Dict[int, str] = {}
 
     def request(self) -> Event:
         with self._lock:
             self._outstanding += 1
-            if self._free:
-                return self._free.pop()
-        return Event()
+            ev = self._free.pop() if self._free else None
+        if ev is None:
+            ev = Event()
+        if obstrace.ENABLED:
+            site = _caller_site()
+            with self._lock:
+                self._sites[id(ev)] = site
+        return ev
 
     def release(self, ev: Event) -> None:
         ev.reset()
         with self._lock:
             self._outstanding -= 1
             self._free.append(ev)
+            if self._sites:
+                self._sites.pop(id(ev), None)
 
-    def finalize(self) -> int:
-        """Returns leaked (requested, never released) events; reference logs
-        these at finalize (events.cpp:31-37)."""
+    def finalize(self) -> "tuple[int, List[str]]":
+        """Returns (leaked count, creation sites of the leaked events);
+        leaked = requested, never released/synchronized back to the pool.
+        The reference logs these at finalize (events.cpp:31-37); sites are
+        known only for events requested while TEMPI_TRACE was armed."""
         with self._lock:
             leaked = self._outstanding
+            sites = list(self._sites.values())
+            self._sites.clear()
             self._free = [Event() for _ in range(PREWARM)]
             self._outstanding = 0
-        return leaked
+        return leaked, sites
 
 
 _pool: Optional[_EventPool] = None
@@ -109,9 +140,20 @@ def release(ev: Event) -> None:
 def finalize() -> None:
     global _pool
     if _pool is not None:
-        leaked = _pool.finalize()
+        leaked, sites = _pool.finalize()
         if leaked:
-            log.error(f"events: {leaked} event(s) never released")
+            for site in sites:
+                log.error(f"events: event requested at {site} never "
+                          "synchronized/released")
+                if obstrace.ENABLED:
+                    obstrace.emit("events.leak", site=site)
+            untraced = leaked - len(sites)
+            if untraced:
+                log.error(f"events: {untraced} event(s) never released "
+                          "(requested while TEMPI_TRACE was off — no "
+                          "creation sites recorded)")
+                if obstrace.ENABLED:
+                    obstrace.emit("events.leak", site="?", count=untraced)
     _pool = None
 
 

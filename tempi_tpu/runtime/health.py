@@ -48,6 +48,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import trace as obstrace
 from ..utils import env as envmod
 
 CLOSED = "closed"
@@ -120,7 +121,18 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
             if opened:
                 b.times_opened += 1
         _recompute_flags_locked()
-        return opened
+        consecutive = b.consecutive
+    if opened and obstrace.ENABLED:
+        # outside the registry lock: the snapshot walks every thread's
+        # ring and must not serialize breaker bookkeeping behind it
+        obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
+                      consecutive=consecutive, error=(error or "")[:200])
+        obstrace.failure_snapshot(
+            "breaker-open",
+            detail=f"link {peer} strategy {strategy!r}: "
+                   f"{consecutive} consecutive failures "
+                   f"(last: {error or '?'})")
+    return opened
 
 
 def record_success(peer: tuple, strategy: str) -> None:
@@ -136,9 +148,13 @@ def record_success(peer: tuple, strategy: str) -> None:
             return
         b.successes += 1
         b.consecutive = 0
+        closed = False
         if b.state == HALF_OPEN:
             b.state = CLOSED
+            closed = True
             _recompute_flags_locked()
+    if closed and obstrace.ENABLED:
+        obstrace.emit("breaker.close", link=list(peer), strategy=strategy)
 
 
 def allowed(peer: tuple, strategy: str) -> bool:
@@ -160,6 +176,9 @@ def allowed(peer: tuple, strategy: str) -> bool:
             b.state = HALF_OPEN
             b.probes += 1
             _recompute_flags_locked()
+            if obstrace.ENABLED:
+                obstrace.emit("breaker.half_open", link=list(peer),
+                              strategy=strategy)
             return True
         return False
 
@@ -182,6 +201,9 @@ def note_demotion(peer: tuple, from_strategy: str, to_strategy: str) -> None:
         if len(_demotions) < 100:
             _demotions.append(dict(peer=list(peer), **{"from": from_strategy},
                                    to=to_strategy))
+    if obstrace.ENABLED:
+        obstrace.emit("breaker.demotion", link=list(peer),
+                      **{"from": from_strategy}, to=to_strategy)
 
 
 def snapshot() -> dict:

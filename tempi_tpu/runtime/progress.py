@@ -41,6 +41,7 @@ import time
 import weakref
 from typing import List, Optional
 
+from ..obs import trace as obstrace
 from ..utils import env as envmod
 from ..utils import logging as log
 from .queue import Queue, ShutDown
@@ -92,8 +93,11 @@ class ProgressPump:
                 except faults.InjectedFault as e:
                     log.error(f"background progress failed: {e}")
                     continue
+            t0 = time.monotonic() if obstrace.ENABLED else 0.0
+            served = 0
             try:
                 if not comm.freed and comm._pending and not comm.quarantined:
+                    served = 1
                     p2p.try_progress(comm)
             except Exception as e:
                 # try_progress attaches the error to every request in the
@@ -101,7 +105,13 @@ class ProgressPump:
                 # for wait() to re-raise; failures outside that window (e.g.
                 # the freed check) consume no ops, so a waiter's own
                 # try_progress call reproduces them directly
+                if obstrace.ENABLED:
+                    obstrace.emit_span("pump.step", t0, outcome="error",
+                                       error=repr(e)[:200])
                 log.error(f"background progress failed: {e}")
+            else:
+                if obstrace.ENABLED and served:
+                    obstrace.emit_span("pump.step", t0, outcome="ok")
 
     def stop(self, deadline: Optional[float] = None) -> bool:
         """Returns False if the thread failed to stop — the caller must then
@@ -221,6 +231,8 @@ def _lift_dead_quarantines_locked() -> None:
             continue
         comm.quarantined = False
         _quarantined.discard(comm)
+        if obstrace.ENABLED:
+            obstrace.emit("pump.quarantine_lifted")
         log.warn("abandoned pump thread exited; lifting its "
                  "communicator's background-service quarantine")
         if _pump is not None and not comm.freed and comm._pending:
@@ -250,6 +262,12 @@ def _replace_pump_locked(pump: ProgressPump, stuck_comm, reason: str) -> None:
     for comm in backlog:
         if not comm.quarantined:
             _pump.notify(comm)
+    if obstrace.ENABLED:
+        # the supervisor's verdict, on the record: which failure mode it
+        # saw and whether a communicator lost background service for it
+        obstrace.emit("pump.replaced", reason=reason,
+                      quarantined=stuck_comm is not None,
+                      replacement=_replacements)
     log.error(
         f"progress pump {reason}"
         + (f" while serving a communicator (now quarantined from "

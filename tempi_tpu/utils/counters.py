@@ -105,6 +105,19 @@ def init() -> None:
     counters = Counters()
 
 
+def snapshot(reset: bool = False) -> dict:
+    """Public counters access (ISSUE 3 satellite): the grouped counters as
+    one nested dict, without waiting for the DEBUG-gated finalize dump.
+    ``reset=True`` zeroes every group after reading — the per-interval
+    pattern a monitoring scraper (or a benchmark reporting per-run
+    deltas, see benches/_common.report_counters) needs."""
+    global counters
+    out = counters.as_dict()
+    if reset:
+        counters = Counters()
+    return out
+
+
 def finalize() -> None:
     """Dump all counters at DEBUG level, like counters.cpp:30-121."""
     if log.get_level() <= log.DEBUG:

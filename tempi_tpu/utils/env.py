@@ -50,8 +50,26 @@ runtime/progress.py and the README "Recovery & degradation" section):
                          leaking the slab pools instead of freeing memory
                          under a live thread (default 5)
 
-All resilience knobs parse LOUDLY (a typo raises at init rather than
-silently reverting to the hang/die behavior the knob exists to prevent).
+Observability knobs (ISSUE 3; see obs/trace.py and the README
+"Observability" section):
+  TEMPI_TRACE          = off | flight | full — the host-side flight
+                         recorder of structured runtime events (default
+                         off = one module-flag truth test per site).
+                         ``flight`` records into bounded per-thread rings
+                         dumped on failure/demand; ``full`` also writes a
+                         merged Chrome-trace dump at finalize. Distinct
+                         from TEMPI_TRACE_DIR (the device-side jax
+                         profiler capture).
+  TEMPI_TRACE_EVENTS   per-thread ring capacity (default 4096; must be a
+                         positive integer)
+  TEMPI_TRACE_PATH     file stem or directory for trace dumps and the
+                         automatic WaitTimeout/breaker-open snapshots
+                         (default "" = snapshots stay in memory only,
+                         readable via obs.trace.failures())
+
+All resilience and observability knobs parse LOUDLY (a typo raises at
+init rather than silently reverting to the hang/die/fly-blind behavior
+the knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -163,6 +181,11 @@ class Environment:
     breaker_cooldown_s: float = 30.0  # open -> half-open probe delay
     pump_heartbeat_s: float = 30.0    # pump wedge detection (0 = off)
     pump_stop_timeout_s: float = 5.0  # stop()/finalize join budget
+    # observability (no reference analog beyond NVTX; ISSUE 3) — see
+    # obs/trace.py (flight recorder) and obs/export.py (Chrome trace)
+    trace_mode: str = "off"        # off | flight | full
+    trace_events: int = 4096       # per-thread ring capacity
+    trace_path: str = ""           # dump/snapshot destination ("" = memory)
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -275,6 +298,28 @@ class Environment:
         e.pump_heartbeat_s = _float_env("TEMPI_PUMP_HEARTBEAT_S", 30.0)
         e.pump_stop_timeout_s = _float_env("TEMPI_PUMP_STOP_TIMEOUT_S", 5.0)
 
+        # observability knobs parse as loudly as the resilience knobs: a
+        # typo'd TEMPI_TRACE silently recording nothing would defeat the
+        # one run where the flight-recorder evidence mattered
+        tm = (getenv("TEMPI_TRACE") or "off").lower()
+        if tm not in ("off", "flight", "full"):
+            raise ValueError(
+                f"bad TEMPI_TRACE={tm!r}: want off | flight | full")
+        e.trace_mode = tm
+        v = getenv("TEMPI_TRACE_EVENTS")
+        try:
+            e.trace_events = int(v) if v else 4096
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_TRACE_EVENTS={v!r}: want a positive "
+                "integer") from exc
+        if e.trace_events <= 0:
+            # no silent clamp: a zero/negative ring capacity would arm the
+            # recorder while guaranteeing every snapshot comes up empty
+            raise ValueError(
+                f"bad TEMPI_TRACE_EVENTS={v!r}: want a positive integer")
+        e.trace_path = getenv("TEMPI_TRACE_PATH") or ""
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -293,6 +338,9 @@ class Environment:
             # the bail-out also disarms our own chaos layer: "underlying
             # library" behavior means no framework-injected failures
             e.faults = ""
+            # ...and our own introspection: the flight recorder observes
+            # framework machinery the bail-out turns off
+            e.trace_mode = "off"
         return e
 
 

@@ -23,6 +23,10 @@ def pytest_configure(config):
         "(the tier-1-compatible smoke is `pytest -m faults`)")
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "obs: observability-subsystem tests (the <30s trace smoke is "
+        "`pytest -m obs`)")
 
 
 @pytest.fixture(autouse=True)
@@ -31,15 +35,19 @@ def _reset_globals():
     disarmed fault table (a chaos test's wedges/specs must never leak
     into the next test — release() also frees any still-blocked
     wedged thread so it can exit)."""
+    from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.runtime import faults, health
     from tempi_tpu.utils import counters, env
 
     env.read_environment()
     faults.configure()
+    obstrace.configure()
     counters.init()
     health.reset()
     yield
     faults.reset()
     # breaker state and quarantine history must not leak across tests any
-    # more than an armed fault spec may
+    # more than an armed fault spec may — nor may a test's recorded trace
+    # events or its armed recorder mode
     health.reset()
+    obstrace.configure("off")

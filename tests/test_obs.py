@@ -1,0 +1,374 @@
+"""Observability-subsystem suite (ISSUE 3).
+
+The flight recorder only earns its keep if (a) it costs nothing when off,
+(b) it is actually there when a failure needs explaining, and (c) what it
+dumps opens in a real viewer. This suite pins all three: ring-buffer
+wraparound semantics, the off-mode zero-allocation guard (no ring, no
+event objects), the automatic WaitTimeout / breaker-open snapshots, the
+Chrome trace-event JSON schema round-trip (the format Perfetto loads),
+the event-pool leak check's creation sites, the public counters snapshot,
+and a seeded wedge -> recovery chaos case whose dump must read back as a
+coherent span sequence naming the stuck request and the recovery action.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.obs import export, trace
+from tempi_tpu.parallel import p2p
+from tempi_tpu.runtime import events, faults, health
+from tempi_tpu.utils import env as envmod
+
+from test_faults import _post_pair
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+# -- knob parsing (loud, like the resilience knobs) ---------------------------
+
+
+def test_trace_knob_rejects_unknown_mode(monkeypatch):
+    monkeypatch.setenv("TEMPI_TRACE", "verbose")
+    with pytest.raises(ValueError, match="TEMPI_TRACE"):
+        envmod.read_environment()
+
+
+@pytest.mark.parametrize("bad", ["0", "-4", "many"])
+def test_trace_events_knob_rejects_non_positive(monkeypatch, bad):
+    monkeypatch.setenv("TEMPI_TRACE_EVENTS", bad)
+    with pytest.raises(ValueError, match="TEMPI_TRACE_EVENTS"):
+        envmod.read_environment()
+
+
+def test_trace_knobs_parse(monkeypatch):
+    monkeypatch.setenv("TEMPI_TRACE", "FLIGHT")  # case-insensitive
+    monkeypatch.setenv("TEMPI_TRACE_EVENTS", "128")
+    monkeypatch.setenv("TEMPI_TRACE_PATH", "/tmp/somewhere")
+    e = envmod.read_environment()
+    assert e.trace_mode == "flight"
+    assert e.trace_events == 128
+    assert e.trace_path == "/tmp/somewhere"
+
+
+def test_tempi_disable_forces_trace_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    monkeypatch.setenv("TEMPI_TRACE", "full")
+    assert envmod.read_environment().trace_mode == "off"
+
+
+def test_configure_rejects_bad_explicit_args():
+    with pytest.raises(trace.TraceConfigError):
+        trace.configure("everything")
+    with pytest.raises(trace.TraceConfigError):
+        trace.configure("flight", capacity=0)
+
+
+# -- recorder core ------------------------------------------------------------
+
+
+def test_off_mode_records_nothing_and_allocates_no_rings(world):
+    """The zero-cost contract: with TEMPI_TRACE=off (the default) an
+    exchange constructs no event objects and registers no ring — the
+    instrumented sites' ENABLED guard short-circuits before any call
+    into the recorder."""
+    assert not trace.ENABLED
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    assert trace._rings == []
+    assert trace.snapshot() == []
+    assert trace.stats()["events"] == 0
+
+
+def test_ring_wraparound_keeps_newest_and_counts_dropped():
+    trace.configure("flight", capacity=8)
+    for i in range(20):
+        trace.emit("tick", i=i)
+    snap = trace.snapshot()
+    assert [d["i"] for d in snap] == list(range(12, 20))  # newest, in order
+    st = trace.stats()
+    assert st["events"] == 8
+    assert st["dropped"] == 12
+    assert st["threads"] == 1
+
+
+def test_span_and_emit_span_record_durations():
+    trace.configure("flight", capacity=64)
+    with trace.span("outer", strategy="staged") as sp:
+        time.sleep(0.01)
+        sp.note(outcome="ok")
+    t0 = time.monotonic()
+    trace.emit_span("inner", t0, outcome="ok")
+    outer, inner = trace.snapshot()
+    assert outer["name"] == "outer" and outer["dur"] >= 0.01
+    assert outer["strategy"] == "staged" and outer["outcome"] == "ok"
+    assert inner["name"] == "inner" and inner["dur"] >= 0.0
+
+
+def test_span_stamps_error_outcome_on_raise():
+    trace.configure("flight", capacity=64)
+    with pytest.raises(RuntimeError):
+        with trace.span("doomed"):
+            raise RuntimeError("boom")
+    (ev,) = trace.snapshot()
+    assert ev["outcome"] == "error" and "boom" in ev["error"]
+
+
+def test_rings_merge_across_threads():
+    trace.configure("flight", capacity=32)
+    trace.emit("main-side")
+
+    def worker():
+        trace.emit("worker-side")
+
+    t = threading.Thread(target=worker, name="obs-worker")
+    t.start()
+    t.join()
+    snap = trace.snapshot()
+    assert {d["name"] for d in snap} == {"main-side", "worker-side"}
+    assert {d["thread"] for d in snap} >= {"obs-worker"}
+    assert trace.stats()["threads"] == 2
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+
+def test_chrome_trace_json_schema_roundtrip(tmp_path):
+    """The dump must be loadable, schema-valid Chrome trace JSON: spans as
+    complete ("X") events with microsecond ts/dur, instants as "i", rank
+    fields mapped to named process lanes — what Perfetto renders."""
+    trace.configure("flight", capacity=64)
+    t0 = time.monotonic()
+    trace.emit_span("p2p.dispatch", t0, strategy="device", rank=3,
+                    outcome="ok")
+    trace.emit("p2p.post", kind="send", rank=3, peer=1, tag=7, nbytes=64,
+               req=12)
+    path = trace.dump(str(tmp_path / "dump.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert all({"name", "ph", "pid", "tid"} <= set(e) for e in evs)
+    spans = [e for e in evs if e["ph"] == "X"]
+    (sp,) = spans
+    assert sp["name"] == "p2p.dispatch" and sp["dur"] >= 0
+    assert isinstance(sp["ts"], float) and sp["args"]["strategy"] == "device"
+    (inst,) = [e for e in evs if e["ph"] == "i"]
+    assert inst["args"]["peer"] == 1 and inst["args"]["tag"] == 7
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "rank 3" in lanes  # rank-carrying events get their own lane
+    # and the summary report reads the same document
+    (row,) = export.summarize(doc)
+    assert row["name"] == "p2p.dispatch" and row["strategy"] == "device"
+    assert row["count"] == 1
+
+
+def test_full_mode_finalize_writes_merged_dump(tmp_path):
+    trace.configure("full", capacity=64, path=str(tmp_path))
+    trace.emit("something", rank=0)
+    out = trace.finalize()
+    assert out and os.path.dirname(out) == str(tmp_path)
+    with open(out) as f:
+        doc = json.load(f)
+    assert any(e.get("name") == "something" for e in doc["traceEvents"])
+    assert trace.stats()["events"] == 0  # finalize resets, like counters
+
+
+def test_flight_mode_finalize_writes_no_dump(tmp_path):
+    trace.configure("flight", capacity=64, path=str(tmp_path))
+    trace.emit("something")
+    assert trace.finalize() is None
+    assert os.listdir(tmp_path) == []
+
+
+# -- lifecycle instrumentation ------------------------------------------------
+
+
+def test_exchange_leaves_lifecycle_span_sequence(world):
+    """A healthy exchange must read back as post -> match -> dispatch ->
+    complete -> drain, in timestamp order, with the request envelope on
+    the post and the strategy on the dispatch."""
+    trace.configure("flight", capacity=256)
+    reqs, rbuf, row, dst = _post_pair(world, tag=3)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    snap = trace.snapshot()
+    by = lambda n: [d for d in snap if d["name"] == n]  # noqa: E731
+    posts = by("p2p.post")
+    assert {(d["kind"], d["rank"], d["peer"], d["tag"]) for d in posts} \
+        == {("send", 0, 1, 3), ("recv", 1, 0, 3)}
+    (match,) = by("p2p.match")
+    assert match["matched"] == 1  # one matched MESSAGE (send/recv pair)
+    (disp,) = by("p2p.dispatch")
+    assert disp["outcome"] == "ok" and disp["strategy"] in (
+        "device", "oneshot", "staged")
+    assert len(by("p2p.complete")) == 2
+    assert by("p2p.drain")
+    assert (max(d["ts"] for d in posts) <= match["ts"] <= disp["ts"]
+            <= min(d["ts"] for d in by("p2p.complete")))
+
+
+def test_wait_timeout_auto_snapshot_names_stuck_request(world, monkeypatch,
+                                                        tmp_path):
+    """Every WaitTimeout carries the flight recorder's contents next to
+    its diagnostics: the snapshot rides the exception as ``.trace``,
+    lands in the failures() history, and (with TEMPI_TRACE_PATH set)
+    persists as loadable Chrome trace JSON."""
+    monkeypatch.setenv("TEMPI_WAIT_TIMEOUT_S", "0.2")
+    envmod.read_environment()
+    trace.configure("flight", capacity=256, path=str(tmp_path))
+    faults.configure("p2p.progress:wedge:1.0:5")  # stalled engine
+    reqs, _, _, _ = _post_pair(world, tag=9)
+    with pytest.raises(p2p.WaitTimeout) as ei:
+        p2p.waitall(reqs)
+    p2p.cancel(reqs)
+    snap = ei.value.trace
+    assert snap is not None and snap["reason"] == "wait-timeout"
+    posts = [d for d in snap["events"] if d["name"] == "p2p.post"]
+    assert {(d["rank"], d["peer"], d["tag"]) for d in posts} \
+        == {(0, 1, 9), (1, 0, 9)}
+    assert "tag 9" in snap["detail"]  # the diagnostics name the envelope
+    assert trace.failures()[-1]["reason"] == "wait-timeout"
+    # the on-disk evidence is valid Chrome trace JSON
+    assert snap["path"] and os.path.exists(snap["path"])
+    with open(snap["path"]) as f:
+        doc = json.load(f)
+    assert any(e.get("name") == "p2p.post" for e in doc["traceEvents"])
+
+
+def test_breaker_open_takes_failure_snapshot(monkeypatch):
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "2")
+    envmod.read_environment()
+    trace.configure("flight", capacity=64)
+    lk = health.link(0, 1)
+    health.record_failure(lk, "device", error="boom-1")
+    assert trace.failures() == []  # below threshold: no evidence capture
+    health.record_failure(lk, "device", error="boom-2")
+    (snap,) = trace.failures()
+    assert snap["reason"] == "breaker-open"
+    assert "device" in snap["detail"] and "(0, 1)" in snap["detail"]
+    (opened,) = [d for d in trace.snapshot() if d["name"] == "breaker.open"]
+    assert opened["link"] == [0, 1] and opened["strategy"] == "device"
+    assert opened["consecutive"] == 2
+
+
+def test_breaker_transition_events(monkeypatch):
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0")
+    envmod.read_environment()
+    trace.configure("flight", capacity=64)
+    lk = health.link(2, 3)
+    health.record_failure(lk, "oneshot")
+    assert health.allowed(lk, "oneshot")  # cooldown 0: the half-open probe
+    health.record_success(lk, "oneshot")
+    names = [d["name"] for d in trace.snapshot()
+             if d["name"].startswith("breaker.")]
+    assert names == ["breaker.open", "breaker.half_open", "breaker.close"]
+
+
+# -- chaos: wedge -> recovery must leave a readable story ---------------------
+
+
+@pytest.mark.faults
+def test_wedge_recovery_leaves_readable_span_sequence(world, monkeypatch,
+                                                      tmp_path):
+    """Acceptance criterion: under a seeded wedge fault the flight
+    recorder's dump names the stuck request (rank/peer/tag) and the
+    recovery action taken (cancel + repost, retry), in order — the
+    post-hoc story ISSUE 2's recovery machinery could not tell. The
+    wedge clears while the retry layer backs off (the transient-wedge
+    schedule of test_recovery), so the reposted exchange completes."""
+    monkeypatch.setenv("TEMPI_WAIT_TIMEOUT_S", "0.3")
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0.2")
+    envmod.read_environment()
+    trace.configure("flight", capacity=512, path=str(tmp_path))
+    faults.configure("p2p.progress:wedge:1.0:7")
+    clearer = threading.Timer(0.45, lambda: faults.configure(""))
+    clearer.start()
+    try:
+        reqs, rbuf, row, dst = _post_pair(world, tag=11)
+        p2p.waitall(reqs)  # recovers; must NOT raise
+        np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    finally:
+        clearer.cancel()
+    snap = trace.snapshot()
+    one = lambda n: min(  # noqa: E731 — earliest event of a kind
+        (d for d in snap if d["name"] == n), key=lambda d: d["ts"])
+    post, timeout, repost = (one("p2p.post"), one("p2p.wait_timeout"),
+                             one("p2p.repost"))
+    retry, disp = one("p2p.retry"), one("p2p.dispatch")
+    # the stuck request is named...
+    assert (post["rank"], post["peer"], post["tag"]) == (0, 1, 11)
+    assert repost["tag"] == 11 and repost["req"] == post["req"]
+    # ...the recovery action is on the record, in causal order...
+    assert post["ts"] <= timeout["ts"] <= retry["ts"] <= disp["ts"]
+    assert disp["outcome"] == "ok"
+    # ...and the auto-snapshot file from the WaitTimeout is valid Chrome
+    # trace JSON (the acceptance criterion's "opens in Perfetto" form)
+    (wt_snap,) = [s for s in trace.failures()
+                  if s["reason"] == "wait-timeout"][:1]
+    with open(wt_snap["path"]) as f:
+        doc = json.load(f)
+    assert {e["ph"] for e in doc["traceEvents"]} <= {"M", "X", "i"}
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+def test_counters_snapshot_public_and_resettable(world):
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    snap = api.counters_snapshot()
+    assert snap["isend"]["num_device"] == 1
+    assert snap["irecv"]["num_device"] == 1
+    snap2 = api.counters_snapshot(reset=True)
+    assert snap2["isend"]["num_device"] == 1
+    assert api.counters_snapshot()["isend"]["num_device"] == 0
+
+
+def test_event_pool_leak_reports_creation_site(capsys):
+    """Satellite: a never-synchronized event is reported at finalize with
+    the site that requested it (events.cpp:31-37 analog), and the leak
+    lands in the trace."""
+    trace.configure("flight", capacity=64)
+    leaked = events.request()  # deliberately never released
+    assert leaked is not None
+    events.finalize()
+    err = capsys.readouterr().err
+    assert "never synchronized/released" in err
+    assert "test_obs.py" in err  # the creation site names THIS file
+    (ev,) = [d for d in trace.snapshot() if d["name"] == "events.leak"]
+    assert "test_obs.py" in ev["site"]
+
+
+def test_event_pool_clean_path_reports_no_leak(capsys):
+    trace.configure("flight", capacity=64)
+    ev = events.request()
+    events.release(ev)
+    events.finalize()
+    assert "never" not in capsys.readouterr().err
+    assert not [d for d in trace.snapshot() if d["name"] == "events.leak"]
+
+
+def test_api_trace_snapshot_and_dump(world, tmp_path):
+    trace.configure("flight", capacity=64)
+    reqs, rbuf, _, _ = _post_pair(world)
+    p2p.waitall(reqs)
+    assert any(d["name"] == "p2p.dispatch" for d in api.trace_snapshot())
+    path = api.trace_dump(str(tmp_path / "t.json"))
+    with open(path) as f:
+        assert json.load(f)["traceEvents"]

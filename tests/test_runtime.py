@@ -80,7 +80,7 @@ def test_event_pool_roundtrip():
     ev.record(None)
     ev.synchronize()
     events.release(ev)
-    assert events._pool.finalize() == 0
+    assert events._pool.finalize() == (0, [])
 
 
 def test_event_tracks_device_array():
@@ -95,7 +95,9 @@ def test_event_tracks_device_array():
 
 def test_event_leak_detected():
     events.request()
-    assert events._pool.finalize() == 1
+    leaked, sites = events._pool.finalize()
+    assert leaked == 1
+    assert sites == []  # creation sites only tracked while TEMPI_TRACE is on
 
 
 def test_exchange_counters_wired():
