@@ -6,6 +6,12 @@ containers for the sender's model-decision cache): measures a strategy-cache
 hit against re-running the measured-model composition
 (interp_2d + interp_time) it memoizes, plus the dict insert cost, justifying
 the per-plan decision cache in p2p.choose_strategy.
+
+ISSUE 4 extension: also reports the tune.json learned-state cache's init
+behavior next to the perf.json coverage — load of a healthy file,
+discard on version mismatch, invalidation on a perf-sheet hash change,
+and quarantine of a corrupt file to tune.json.corrupt — with the time
+each path costs at init.
 """
 
 import sys
@@ -67,7 +73,77 @@ def main() -> int:
                r_re.trimean / len(keys)),
               ("dict_cache", len(keys), r_hit.trimean,
                r_hit.trimean / len(keys))])
+    _bench_tune_state()
     return 0
+
+
+def _bench_tune_state() -> None:
+    """tune.json init-path behaviors (ISSUE 4 satellite): the learned
+    state must stay cheap AND safe to consult at init — a corrupt or
+    superseded file falls through in microseconds, never wedges init."""
+    import json
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from tempi_tpu.runtime import health
+    from tempi_tpu.tune import online as tonline, persist as tpersist
+    from tempi_tpu.utils import env as envmod
+
+    tmpdir = tempfile.mkdtemp(prefix="tempi-bench-tune-")
+    old_cache = envmod.env.cache_dir
+    envmod.env.cache_dir = tmpdir
+    rows = []
+
+    def timed(scenario, fn):
+        t0 = time.perf_counter()
+        loaded = fn()
+        rows.append((scenario, "loaded" if loaded else "discarded",
+                     time.perf_counter() - t0))
+
+    try:
+        tonline.configure("observe")
+        # a realistic learned population: every link of an 8-rank ring,
+        # 3 strategies, a few size bins with enough samples to be stale
+        for a in range(8):
+            lk = health.link(a, (a + 1) % 8)
+            for strat in ("device", "oneshot", "staged"):
+                for b in (6, 12, 20):
+                    for _ in range(12):
+                        tonline.record(lk, strat, 1 << b, 512, False,
+                                       True, 5e-2)
+        path = tonline.save()
+        tonline.configure("observe")
+        timed("healthy_load", tonline.load)
+
+        with open(path) as f:
+            doc = json.load(f)
+        doc["version"] = tpersist.VERSION + 1
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        tonline.configure("observe")
+        timed("version_mismatch", tonline.load)
+
+        doc["version"] = tpersist.VERSION
+        doc["perf_hash"] = "0" * 64  # learned against a sheet that's gone
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        tonline.configure("observe")
+        timed("perf_hash_invalidated", tonline.load)
+
+        with open(path, "w") as f:
+            f.write('{"version": 1, "bins": [{"trunc')
+        tonline.configure("observe")
+        timed("corrupt_quarantined", tonline.load)
+        rows.append(("quarantine_sidecar",
+                     "present" if os.path.exists(path + ".corrupt")
+                     else "MISSING", 0.0))
+        emit_csv(("tune_scenario", "outcome", "time_s"), rows)
+    finally:
+        tonline.configure("off")
+        envmod.env.cache_dir = old_cache
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 if __name__ == "__main__":

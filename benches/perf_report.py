@@ -15,6 +15,13 @@ Usage: python benches/perf_report.py [path-to-sheet.json]
        latency stats from the Chrome trace JSON written by
        api.trace_dump() / TEMPI_TRACE=full at finalize / the automatic
        WaitTimeout & breaker-open snapshots)
+
+       python benches/perf_report.py --tune [path-to-tune.json]
+       (ISSUE 4: summarize the learned online-tuning state — per-(link,
+       strategy, size-bin) observed-vs-predicted seconds with drift
+       verdicts, from the tune.json written at api.finalize() under
+       TEMPI_TUNE=observe|adapt; default: the active
+       TEMPI_CACHE_DIR/tune.json)
 """
 
 import json
@@ -67,6 +74,42 @@ def trace_report(path: str) -> int:
     return 0
 
 
+def tune_report(path: str) -> int:
+    """Observed-vs-predicted summary of a learned tune.json (ISSUE 4).
+
+    Purely a FILE reader like the sheet report below: must never call
+    jax (and never needs the active sheet — the file carries the hash of
+    the sheet it was learned against, printed for provenance)."""
+    with open(path) as f:
+        doc = json.load(f)
+    bins = doc.get("bins", [])
+    print(f"tune state: {path}")
+    print(f"format v{doc.get('version', '?')}  learned against perf sheet "
+          f"{str(doc.get('perf_hash', '?'))[:12]}…  "
+          f"adoptions this session: {doc.get('adoptions', 0)}")
+    if not bins:
+        print("no learned bins (no completed traffic was ingested)")
+        return 1
+    stale = sum(1 for b in bins if b.get("stale"))
+    print(f"{len(bins)} learned bin(s), {stale} marked stale (drifted)")
+    print(f"{'link':>10} {'strategy':>9} {'size':>8} {'n':>6} "
+          f"{'observed':>10} {'swept':>10} {'rel err':>8}  drift")
+    for b in sorted(bins, key=lambda d: (d.get("link", []), d.get("bin", 0),
+                                         d.get("strategy", ""))):
+        pred = float(b.get("pred_s", 0.0))
+        obs = float(b.get("mean_s", 0.0))
+        rel = abs(obs - pred) / pred if pred > 0 else float("nan")
+        lk = "-".join(str(r) for r in b.get("link", []))
+        print(f"{lk:>10} {b.get('strategy', '?'):>9} "
+              f"{'2^' + str(b.get('bin', '?')) + 'B':>8} "
+              f"{b.get('count', 0):>6} {_fmt_t(obs):>10} "
+              f"{(_fmt_t(pred) if pred > 0 else 'none'):>10} "
+              f"{rel:>8.2f}  {'STALE' if b.get('stale') else 'ok'}")
+    print("(a STALE bin's swept prediction disagrees with live traffic; "
+          "under TEMPI_TUNE=adapt the chooser re-ranks it)")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--trace":
         if len(sys.argv) < 3:
@@ -74,6 +117,18 @@ def main() -> int:
                   file=sys.stderr)
             return 2
         return trace_report(sys.argv[2])
+    if len(sys.argv) > 1 and sys.argv[1] == "--tune":
+        if len(sys.argv) > 2:
+            tpath = sys.argv[2]
+        else:
+            from tempi_tpu.utils import env as envmod
+            envmod.read_environment()
+            tpath = os.path.join(envmod.env.cache_dir, "tune.json")
+        if not os.path.exists(tpath):
+            print(f"no tune state at {tpath} (run with "
+                  "TEMPI_TUNE=observe|adapt to learn one)")
+            return 1
+        return tune_report(tpath)
     from tempi_tpu.measure import system as msys
 
     # purely a FILE reader: this tool must never call jax (current_platform

@@ -36,6 +36,9 @@ def init(devices=None) -> Communicator:
     from .obs import trace as obstrace
     obstrace.configure()  # arm TEMPI_TRACE the same way: a typo'd mode
     # must fail init, not silently record nothing
+    from .tune import online as tune_online
+    tune_online.configure()  # arm TEMPI_TUNE (knobs already loud-parsed
+    # by read_environment; this clears any prior session's learned state)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -64,6 +67,11 @@ def init(devices=None) -> Communicator:
         msys.load_cached()
     except Exception as e:  # perf cache is optional at init
         log.spew(f"no system measurement cache loaded: {e}")
+    if tune_online.ENABLED:
+        # AFTER the perf sheet loads: the learned state is versioned
+        # against a hash of the ACTIVE sheet and must be validated (or
+        # invalidated) against what this session actually interpolates
+        tune_online.load()
     log.debug(f"tempi init: {_world.size} ranks, "
               f"{_world.num_nodes} node(s)")
     return _world
@@ -161,6 +169,11 @@ def finalize() -> None:
         # counters
         from .obs import trace as obstrace
         obstrace.finalize()
+        # persist the learned tune state (observations are expensive
+        # evidence) BEFORE the registries reset, then disarm — learned
+        # history survives sessions via tune.json, not via module state
+        from .tune import online as tune_online
+        tune_online.finalize()
         type_cache.clear()
         from .runtime import health
         health.reset()  # breaker history is per-session, like counters
@@ -185,6 +198,20 @@ def health_snapshot() -> dict:
     snap = health.snapshot()
     snap["pump"] = progress.supervision_stats()
     return snap
+
+
+def tune_snapshot() -> dict:
+    """Diagnostic snapshot of the online performance-model tuner (ISSUE
+    4): mode and gating flags, every (link, strategy, size-bin)
+    estimator's observed-vs-predicted seconds with its drift verdict
+    (``bins``), the drift and adoption audit trails
+    (``drifted``/``adopted``), sweep session-staleness notes
+    (``session_staleness`` — satellite: session-level and per-bin drift
+    in one report), and tune.json persistence provenance. Pure data —
+    safe to serialize. Callable before init and after finalize
+    (everything simply reads empty)."""
+    from .tune import online as tune_online
+    return tune_online.snapshot()
 
 
 def counters_snapshot(reset: bool = False) -> dict:

@@ -26,8 +26,10 @@ from .system import (GRID_BLOCKLEN, GRID_BYTES, GRID_STRIDE,
 
 
 # sentinel time for a grid point the backend could not measure: ~30 years,
-# decisively worse than any real path yet finite (see _pack_grid)
-_UNMEASURABLE_S = 1e9
+# decisively worse than any real path yet finite (see _pack_grid). Lives
+# in measure/system.py so interp_2d can exclude sentinel cells from its
+# blend instead of poisoning neighboring real cells.
+_UNMEASURABLE_S = msys.UNMEASURABLE_S
 
 # strided extents at or past 2**31 overflow int32 in the backend's HLO
 # proto path (observed on-chip 2026-07-31: the bytes=4MiB/blocklen=1 cell,
@@ -496,6 +498,13 @@ def _session_staleness(sp, rtt_now: float, checkpoint=None) -> None:
         return
     for k in cleared:
         setattr(sp, k, [])
+    # session-level staleness is drift too (ISSUE 4 satellite): surface
+    # it where the per-bin drift verdicts land — api.tune_snapshot()'s
+    # session_staleness list and a tune.drift trace event — instead of
+    # only a log line that scrolls away
+    from ..tune import online as tune_online
+    tune_online.note_session_stale(
+        cleared, float(prev) if prev else None, rtt_now * 1e6)
     if prev:
         log.warn(f"re-measuring {cleared}: sheet measured at dispatch "
                  f"RTT {float(prev):.0f} us, session is now "

@@ -45,6 +45,15 @@ GRID_BYTES = [1 << (2 * i + 6) for i in range(9)]      # 64 B .. 4 MiB
 GRID_BLOCKLEN = [1 << j for j in range(9)]             # 1 .. 256 B
 GRID_STRIDE = 512
 
+# sentinel time for a grid point the sweep could not measure (~30 years):
+# decisively worse than any real path yet finite. Written by
+# measure/sweep._pack_grid; interp_2d treats cells at/above it as "no
+# data" rather than as a time — bilinearly blending 1e9 s into
+# neighboring REAL cells would poison every prediction near a skipped
+# grid point (ISSUE 4 satellite regression: a single unmeasurable cell
+# must not steer AUTO away from the whole surrounding region).
+UNMEASURABLE_S = 1e9
+
 
 def current_platform() -> str:
     """Identity of the system the curves describe. The reference scopes
@@ -311,7 +320,12 @@ def interp_time(curve: List[Tuple[int, float]], nbytes: int) -> float:
 
 def interp_2d(grid: List[List[float]], nbytes: int, block_length: int) -> float:
     """Bilinear on the (log2 bytes, log2 blockLength) grid with clamping
-    (measure_system.cpp:217-293)."""
+    (measure_system.cpp:217-293). Cells holding the ``UNMEASURABLE_S``
+    sentinel are EXCLUDED from the blend, not interpolated: the remaining
+    real corners renormalize, so a skipped grid point degrades only the
+    query that lands exactly on it (which stays sentinel — decisively
+    worse than any real path, still finite) instead of poisoning every
+    neighboring prediction with a share of 1e9 seconds."""
     if not grid or not grid[0]:
         return math.inf
     bx = [math.log2(b) for b in GRID_BYTES[: len(grid)]]
@@ -329,8 +343,19 @@ def interp_2d(grid: List[List[float]], nbytes: int, block_length: int) -> float:
     i1 = min(i + 1, len(bx) - 1)
     j1 = min(j + 1, len(by) - 1)
     g = grid
-    return ((1 - fx) * (1 - fy) * g[i][j] + fx * (1 - fy) * g[i1][j]
-            + (1 - fx) * fy * g[i][j1] + fx * fy * g[i1][j1])
+    corners = ((g[i][j], (1 - fx) * (1 - fy)),
+               (g[i1][j], fx * (1 - fy)),
+               (g[i][j1], (1 - fx) * fy),
+               (g[i1][j1], fx * fy))
+    real = [(v, w) for v, w in corners if v < UNMEASURABLE_S]
+    if len(real) < 4:
+        wsum = sum(w for _, w in real)
+        if wsum <= 0.0:
+            # the query's whole weight sits on sentinel cells (an exact
+            # hit on a skipped knot): stay sentinel, never interpolate it
+            return UNMEASURABLE_S
+        return sum(v * w for v, w in real) / wsum
+    return sum(v * w for v, w in corners)
 
 
 # -- model composition (measure_system.cpp:100-132) ---------------------------

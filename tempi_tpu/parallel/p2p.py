@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Sequence
 from ..measure import system as msys
 from ..obs import trace as obstrace
 from ..runtime import faults, health
+from ..tune import model as tune_model
+from ..tune import online as tune_online
 from ..ops import type_cache
 from ..ops.dtypes import Datatype
 from ..ops.packer import Packer1D
@@ -155,6 +157,15 @@ class Request:
     # exchange later fails (or succeeds) at completion time, and upgrades
     # the WaitTimeout diagnostics from "auto" to the real transport
     strategy: str = ""
+    # modeling envelope for the online tuner (ISSUE 4), stamped at
+    # dispatch ONLY when TEMPI_TUNE is armed: the clamped block length
+    # and which chooser arm decided (contig = the contiguous/1-D arm,
+    # whose device is the direct transport with no pack step) — so the
+    # ingest hook composes the swept prediction exactly like the
+    # candidate thunks the chooser compared. Slots with defaults: zero
+    # per-request allocation on the off path.
+    block: int = 0
+    contig: bool = False
 
     def wait(self) -> None:
         wait(self)
@@ -337,6 +348,24 @@ def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str
     return choice
 
 
+def _auto_choice(comm: Communicator, m: Message, key: tuple,
+                 models) -> Optional[str]:
+    """Model-driven AUTO choice with the online-tuning overlay (ISSUE 4):
+    when ``TEMPI_TUNE=adapt`` has proven drift somewhere
+    (``tune_online.ADAPTING``, a one-flag gate like ``health.TRIPPED``),
+    the learned estimators may re-rank THIS link's candidates — bypassing
+    the shared decision cache, whose key carries no link and whose frozen
+    verdicts would undo the adaptation. Links/bins without proven drift
+    (adapt_choice → None) ride the cached swept-model path unchanged, as
+    does everything when tune is off or observing."""
+    if tune_online.ADAPTING:
+        adapted = tune_model.adapt_choice(health.link(m.src, m.dst),
+                                          m.nbytes, models)
+        if adapted is not None:
+            return adapted
+    return _cached_model_choice(comm, key, models)
+
+
 #: Demotion preference when a chosen strategy's breaker is open: toward the
 #: conservative host-staged path first (ISSUE 2 "demote toward STAGED"),
 #: then whatever else is still healthy.
@@ -372,7 +401,10 @@ def _model_choice_message(comm: Communicator, m: Message):
     explicit configuration). Side-effect-free on the health registry, so
     failure attribution (:func:`_strategy_for_req`) can ask "what would
     AUTO ride here" without consuming half-open probes or logging
-    spurious demotions."""
+    spurious demotions. AUTO arms go through :func:`_auto_choice`, where
+    the online tuner (ISSUE 4) may re-rank candidates on drifted
+    link/bins — forced choices return before that overlay, so tune can
+    never override explicit configuration either."""
     # contiguous (1-D) messages honor TEMPI_CONTIGUOUS_* first, like the
     # reference instantiating SendRecv1DStaged/SendRecv1D at type commit
     # (type_commit.cpp:52-73)
@@ -383,8 +415,8 @@ def _model_choice_message(comm: Communicator, m: Message):
         if cm is ContiguousMethod.AUTO:
             try:
                 colocated = comm.is_colocated(m.src, m.dst)
-                choice = _cached_model_choice(
-                    comm, ("1d", colocated, m.nbytes),
+                choice = _auto_choice(
+                    comm, m, ("1d", colocated, m.nbytes),
                     {"device": lambda: msys.model_direct_1d(m.nbytes,
                                                             colocated),
                      "staged": lambda: msys.model_staged_1d(m.nbytes)})
@@ -404,9 +436,9 @@ def _model_choice_message(comm: Communicator, m: Message):
     # AUTO
     try:
         colocated = comm.is_colocated(m.src, m.dst)
-        block = min(max(_block_length(m), 1), 512)
-        choice = _cached_model_choice(
-            comm, (colocated, m.nbytes, block),
+        block = _clamped_block(m)
+        choice = _auto_choice(
+            comm, m, (colocated, m.nbytes, block),
             {"device": lambda: msys.model_device(m.nbytes, block, colocated),
              "oneshot": lambda: msys.model_oneshot(m.nbytes, block,
                                                    colocated)})
@@ -447,6 +479,15 @@ def _block_length(m: Message) -> int:
     if sb is not None and sb.ndims >= 2:
         return sb.counts[0]
     return m.nbytes
+
+
+def _clamped_block(m: Message) -> int:
+    """The block length the 2-D pack grids are consulted with — ONE
+    expression shared by the chooser's model key and the tune envelope
+    stamp, so the ingest prediction is composed against exactly the
+    value the chooser modeled (a divergent clamp would fabricate or
+    mask drift)."""
+    return min(max(_block_length(m), 1), 512)
 
 
 def try_progress(comm: Communicator, strategy: Optional[str] = None,
@@ -588,6 +629,17 @@ def _execute_matched(comm: Communicator, messages, consumed,
         for op in ops:
             op.request.strategy = strat  # names the breaker key at
             # completion time (and the real transport in diagnostics)
+        if tune_online.ENABLED:
+            # stamp the modeling envelope the completion-time ingest
+            # needs (Request docstring); ops[2k], ops[2k+1] pair with
+            # batch[k]
+            for k, m in enumerate(batch):
+                blk = _clamped_block(m)
+                cont = (isinstance(m.spacker, Packer1D)
+                        and envmod.env.contiguous is ContiguousMethod.AUTO)
+                for op in (ops[2 * k], ops[2 * k + 1]):
+                    op.request.block = blk
+                    op.request.contig = cont
         t0 = time.monotonic() if obstrace.ENABLED else 0.0
         try:
             plan = get_plan(comm, batch)
@@ -656,7 +708,15 @@ def _record_success_reqs(reqs) -> None:
     the exchanged data ready), not at dispatch: only a fully-delivered
     exchange may reset a breaker's consecutive-failure counter or close a
     half-open probe. ACTIVE-guarded — free until something has failed;
-    requests that never dispatched (no stamped strategy) are skipped."""
+    requests that never dispatched (no stamped strategy) are skipped.
+
+    The online tuner ingests at the same hook (ISSUE 4): a completed
+    request's post→drain wall-clock is the ground truth the swept model
+    predicted, and completion — not dispatch — is the only point where
+    the whole cost (including a slow drain) has been paid. ENABLED-
+    guarded like faults/obstrace: free with TEMPI_TUNE=off."""
+    if tune_online.ENABLED:
+        tune_online.record_completions(reqs)
     if not health.ACTIVE:
         return
     for r in reqs:

@@ -84,6 +84,12 @@ SITES = (
     "multihost.init",     # each jax.distributed.initialize attempt
     "alltoallv.pair",     # each per-peer message of an isend/irecv lowering
     "sweep.section",      # each measurement section capture (measure/sweep)
+    "tune.ingest",        # each online-tuning completion sample
+                          # (tune/online.record_completions — a raise
+                          # drops the sample, never the exchange it
+                          # observes; delay slows the completing waiter,
+                          # the slow-but-alive simulation; wedge is
+                          # refused like every non-engine site)
 )
 
 KINDS = ("raise", "delay", "wedge")

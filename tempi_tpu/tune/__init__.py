@@ -1,0 +1,27 @@
+"""Online performance-model adaptation (ISSUE 4).
+
+The measured system model (measure/system.py) is a one-time prior: a sweep
+writes perf.json and every AUTO strategy decision interpolates those frozen
+curves forever, even when the machine's real behavior drifts — a contended
+ICI link, a thermally throttled host, a topology the sweep session never
+saw. This package closes the measure→choose→observe loop:
+
+  * ``online``  — ingest: per-(order-normalized link, strategy) estimators
+    over log2-size bins (EWMA mean + variance + sample count), fed each
+    request's post→drain wall-clock at completion — the same hook where
+    runtime/health.py records breaker successes.
+  * ``model``   — drift detection against the swept prediction and, under
+    ``TEMPI_TUNE=adapt``, re-ranking of AUTO choices on bins with proven
+    drift (learned-vs-prior blending, bounded epsilon exploration).
+  * ``persist`` — learned state in TEMPI_CACHE_DIR/tune.json, versioned
+    against a hash of the swept sheet it corrects; corrupt files are
+    quarantined to tune.json.corrupt like the perf-sheet path.
+
+Precedence is strict and enforced under test: env-forced strategies >
+open circuit breakers > tune re-ranking > the swept model. Tune only
+re-ranks decisions the model was free to make, among healthy strategies.
+
+With ``TEMPI_TUNE=off`` (default) every touchpoint costs one
+module-attribute truth test — the ``faults.ENABLED``/``obstrace.ENABLED``
+zero-cost pattern — and AUTO choices are byte-for-byte unchanged.
+"""

@@ -1,0 +1,140 @@
+"""Drift-aware blending and AUTO re-ranking over the online estimators.
+
+Part 2 of the ISSUE 4 tentpole — the loop's *choose* leg learning from
+its *observe* leg. :mod:`tune.online` accumulates per-(link, strategy,
+size-bin) ground truth; this module (a) composes the swept prediction
+for an ingested sample's envelope exactly like the chooser's candidate
+thunks, so observed-vs-predicted is apples-to-apples, and (b) under
+``TEMPI_TUNE=adapt`` re-ranks the chooser's AUTO candidates on bins
+where drift is proven, blending the learned estimate into the swept
+prior with a confidence weight that grows with sample count.
+
+Precedence (the invariants tests/test_tune.py pins):
+
+  env-forced  — DEVICE/ONESHOT/STAGED knobs never reach this module at
+                all (the chooser returns forced choices before the
+                overlay).
+  breakers    — an OPEN breaker's quarantine is never un-done: a
+                quarantined strategy is excluded from re-ranking no
+                matter how fast the learned estimate says it is.
+                Breakers quarantine *failures*; tune re-ranks *healthy*
+                options. The pure ``health.state()`` query is used —
+                ``allowed()`` would consume half-open probes from what
+                may be a bookkeeping call (failure attribution walks the
+                same chooser).
+  tune        — re-ranks only bins with proven drift; everything else
+                falls through to the shared decision cache untouched.
+  swept model — the prior, and the only voice when tune is off.
+
+NOTE on side effects: the chooser is also walked by failure attribution
+(p2p._strategy_for_req), which is breaker-side-effect-free by contract.
+This module keeps that contract for the health registry (pure state
+reads) but does draw from the exploration RNG and may log an adoption —
+bookkeeping noise on a rare path (timeout attribution), accepted to
+keep one code path for "what would AUTO ride".
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..measure import system as msys
+from ..runtime import health
+from . import online
+
+
+def predicted_seconds(strategy: str, nbytes: int, block: int, contig: bool,
+                      colocated: bool) -> float:
+    """The swept model's prediction for one completed request's envelope,
+    composed exactly like ``p2p._model_choice_message``'s candidate
+    thunks: ``contig`` marks a message ELIGIBLE for the contiguous (1-D)
+    arm — device there is the direct transport with no pack step — while
+    the datatype arm's device/oneshot include their pack/unpack grids.
+    The chooser falls through to the datatype arm when the 1-D curves
+    are unmeasured, so a contig device prediction of +inf falls back the
+    same way — otherwise traffic whose choice WAS model-driven (by the
+    datatype models) would never accumulate a finite prediction and its
+    drift could never be judged. Unknown strategies (nothing the chooser
+    models) predict +inf, which the ingest path treats as "no
+    prediction" (observed-only bin)."""
+    if strategy == "staged":
+        return msys.model_staged_1d(nbytes)
+    if strategy == "oneshot":
+        return msys.model_oneshot(nbytes, block, colocated)
+    if strategy == "device":
+        if contig:
+            t = msys.model_direct_1d(nbytes, colocated)
+            if t < math.inf:
+                return t
+        return msys.model_device(nbytes, block, colocated)
+    return math.inf
+
+
+def blend(swept_s: float, observed_s: float, count: int) -> float:
+    """Learned-vs-prior mix for a STALE bin: ``w = n / (n + MIN_SAMPLES)``
+    — at the drift-verdict floor the observation already carries half the
+    weight, and asymptotically it owns the estimate. An unmeasured prior
+    (+inf) defers to the observation entirely: a curve the sweep never
+    captured is exactly where live evidence is the only evidence."""
+    if swept_s >= math.inf:
+        return observed_s
+    w = count / (count + online.min_samples())
+    return (1.0 - w) * swept_s + w * observed_s
+
+
+def adapt_choice(link: tuple, nbytes: int, models) -> str | None:
+    """Re-rank the chooser's AUTO candidates for one (link, size-bin), or
+    return None to fall through to the cached swept-model path. Called
+    only under ``online.ADAPTING`` (adapt mode with ≥1 stale bin
+    anywhere); returns None unless THIS link/bin has a stale estimator
+    among the offered candidates — adaptation is evidence-scoped, never
+    a global behavior flip.
+
+    ``models`` is the chooser's ordered {strategy: thunk-returning-
+    seconds} dict; the thunks are walked here instead of through the
+    shared decision cache because re-ranked verdicts are per-link and
+    drift-dependent — caching them under the link-free key would freeze
+    the very adaptation this implements."""
+    b = online.size_bin(nbytes)
+    stats = online.bin_stats(link, b, tuple(models))
+    if not any(st is not None and st[2] for st in stats.values()):
+        return None
+    swept = {name: fn() for name, fn in models.items()}
+    blended = {}
+    for name, t in swept.items():
+        st = stats.get(name)
+        if st is not None and st[2] and st[0] > 0:
+            blended[name] = blend(t, st[1], st[0])
+        else:
+            blended[name] = t
+    # breaker precedence: an OPEN breaker's quarantine is never un-done
+    # by tune, regardless of what the learned estimate claims
+    eligible = {n: t for n, t in blended.items()
+                if t < math.inf and health.state(link, n) != health.OPEN}
+    if not eligible:
+        return None
+    choice = min(eligible, key=eligible.get)
+    reason = "drift"
+    if len(eligible) > 1 and online.explore() > 0:
+        # bounded epsilon exploration: occasionally ride a non-winning
+        # HEALTHY candidate so its estimator keeps receiving samples —
+        # without it, the loser's bin starves and a recovered link can
+        # never prove itself again
+        r = online.rng()
+        if r.random() < online.explore():
+            choice = r.choice(sorted(n for n in eligible if n != choice))
+            reason = "explore"
+    finite = {n: t for n, t in swept.items() if t < math.inf}
+    base = min(finite, key=finite.get) if finite else next(iter(models))
+    # exploration is audited even when it lands back on the swept winner
+    # — the trail must show every deliberate deviation from the blended
+    # ranking, or an exploration-heavy run reads as "no adaptation"
+    if choice != base or reason == "explore":
+        online.note_adoption(dict(
+            link=list(link), bin=b, nbytes=int(nbytes), reason=reason,
+            **{"from": base}, to=choice,
+            swept_s={n: (t if t < math.inf else None)
+                     for n, t in swept.items()},
+            blended_s={n: (t if t < math.inf else None)
+                       for n, t in blended.items()}))
+    return choice

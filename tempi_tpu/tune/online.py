@@ -1,0 +1,518 @@
+"""Online ingest: per-(link, strategy) estimators over log2-size bins.
+
+Part 1 of the ISSUE 4 tentpole — the *observe* leg of the
+measure→choose→observe loop. Every completed exchange already knows its
+own ground truth: the wall-clock from post to completion drain, stamped
+on the Request itself (``posted_at`` at post, read at the drain). This
+module catches that truth as it flows past the chooser's frozen swept
+model: at request completion (``parallel/p2p._record_success_reqs`` —
+the same hook where ``runtime/health.py`` records breaker successes, so
+only fully-delivered exchanges are ever ingested) each request feeds an
+online estimator keyed on ``(order-normalized link, strategy,
+floor(log2(nbytes)))``: EWMA mean, EWMA variance, and sample count, with
+the swept model's per-sample prediction tracked beside the observation
+so :mod:`tune.model` can declare drift when they disagree hard enough
+for long enough. No dependence on ``TEMPI_TRACE`` — the recorder may be
+off and ingest still sees every completion.
+
+Hot-path contract (the ``faults.ENABLED``/``obstrace.ENABLED`` pattern):
+with ``TEMPI_TUNE=off`` (default) every touchpoint costs one
+module-attribute truth test — no estimator objects, no clock reads, no
+per-request allocation — and AUTO choices are byte-for-byte what the
+swept model alone decides.
+
+Modes (``TEMPI_TUNE``, loud-parsed in utils/env.py):
+  off     — nothing recorded.
+  observe — ingest + drift detection + reporting (``api.tune_snapshot``,
+            ``tune.drift`` trace events); choices never change.
+  adapt   — observe, plus the chooser re-ranks AUTO decisions on bins
+            with proven drift (``ADAPTING`` below gates the overlay;
+            see tune/model.py).
+
+Ingest is chaos-covered via the ``tune.ingest`` fault site
+(runtime/faults.py): an injected ingest failure drops that sample and
+counts it in ``snapshot()['dropped']`` — the bookkeeping layer must
+never fail the exchange it observes. (``wedge`` is refused at the site
+like every non-engine site; ``delay`` slows the completing waiter — the
+slow-but-alive simulation — without dropping anything.)
+
+CAVEAT on the observed quantity: post→drain is the end-to-end latency
+the APPLICATION experienced for the exchange — per the ISSUE 4 design,
+stamped on the Request with no extra clocks — which includes any time
+the app spent between posting and waiting (compute/communication
+overlap) and any wait for the peer to post. The swept models predict
+transport-only seconds, so overlap-heavy traffic inflates observations
+uniformly across strategies; the EWMA damping, the sustained-error
+drift threshold (TEMPI_TUNE_DRIFT), and the fact that every candidate
+strategy rides the same traffic pattern keep the RANKING meaningful
+even when the absolute gap is partly app-induced. Deployments with
+extreme overlap should raise TEMPI_TUNE_DRIFT or stay in observe mode.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ..measure import system as msys
+from ..obs import trace as obstrace
+from ..runtime import faults, health
+from ..utils import env as envmod
+from ..utils import logging as log
+
+MODES = ("off", "observe", "adapt")
+
+#: Module-level fast-path flag: True iff mode != off. The p2p completion
+#: hook and dispatch stamping test this before calling into the module.
+ENABLED = False
+MODE = "off"
+
+#: True iff mode == adapt AND at least one bin is currently marked stale
+#: (drift proven). The strategy chooser's overlay (p2p._auto_choice)
+#: guards on this, so an adapt-mode session with no drift pays one truth
+#: test per AUTO decision and keeps riding the shared decision cache.
+ADAPTING = False
+
+# EWMA smoothing for both the observation and the per-sample prediction:
+# ~the last 2/alpha-ish samples dominate, so a genuine behavior change
+# shows within tens of exchanges while single outliers are damped
+_ALPHA = 0.2
+
+_AUDIT_KEEP = 100   # bounded audit trails (diagnostics, not logs)
+_NOTES_KEEP = 20    # bounded session-staleness notes
+
+
+@dataclass
+class BinStats:
+    """One (link, strategy, log2-size-bin) estimator."""
+
+    count: int = 0        # samples ingested
+    mean_s: float = 0.0   # EWMA of observed post->drain seconds
+    var_s2: float = 0.0   # EWMA variance of the observation
+    pred_s: float = 0.0   # EWMA of the swept model's per-sample prediction
+    pred_n: int = 0       # samples whose prediction was finite
+    stale: bool = False   # drift proven: observed disagrees with swept
+    rel_err: float = 0.0  # latest |mean - pred| / pred (0 until judged)
+    drift_events: int = 0  # stale transitions (flapping is visible)
+    last_nbytes: int = 0  # most recent message size in the bin
+
+
+_lock = threading.Lock()
+_table: Dict[Tuple[tuple, str, int], BinStats] = {}
+_stale_count = 0
+_samples = 0
+_dropped = 0
+_dropped_warned = False
+_drift_total = 0
+_drift_audit: list = []
+_adopt_total = 0
+_adopt_audit: list = []
+_session_notes: list = []
+# persistence bookkeeping surfaced by snapshot(): did a tune.json load,
+# and if not, why (invalidated = hash/version mismatch reason)
+_persist_info = dict(loaded=False, source="", saved="", invalidated="")
+
+_drift_threshold = 0.5
+_min_samples = 10
+_explore = 0.0
+# the msys.generation() the estimators were learned against: every
+# observation, prediction EWMA, and drift verdict is relative to ONE
+# swept sheet. A mid-session sheet swap (measure_all -> set_system)
+# invalidates the in-memory state exactly like a perf-hash mismatch
+# invalidates tune.json — checked at ingest and at the blender's read.
+_sheet_gen = -1
+# fixed seed: exploration draws are session-deterministic, the same
+# philosophy as a seeded fault schedule — an adopted exploration pick
+# observed at decision N reproduces from the same traffic
+_rng = random.Random(0x7E5E)
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the tuner. ``mode=None`` reads the parsed env's
+    ``tune_mode`` (so call after ``read_environment``); an explicit mode
+    overrides (test convenience). Clears all learned state, audits, and
+    session notes — the tuner is per-session state, like counters."""
+    global ENABLED, MODE, ADAPTING, _stale_count, _samples, _dropped
+    global _dropped_warned, _drift_total, _adopt_total, _persist_info
+    global _drift_threshold, _min_samples, _explore, _rng, _sheet_gen
+    if mode is None:
+        mode = getattr(envmod.env, "tune_mode", "off")
+    if mode not in MODES:
+        raise ValueError(f"bad tune mode {mode!r}: want one of {MODES}")
+    with _lock:
+        MODE = mode
+        _sheet_gen = msys.generation()
+        ENABLED = mode != "off"
+        ADAPTING = False
+        _drift_threshold = float(getattr(envmod.env, "tune_drift", 0.5))
+        _min_samples = max(1, int(getattr(envmod.env,
+                                          "tune_min_samples", 10)))
+        _explore = float(getattr(envmod.env, "tune_explore", 0.0))
+        _rng = random.Random(0x7E5E)
+        _table.clear()
+        _stale_count = 0
+        _samples = 0
+        _dropped = 0
+        _dropped_warned = False
+        _drift_total = 0
+        _drift_audit.clear()
+        _adopt_total = 0
+        _adopt_audit.clear()
+        _session_notes.clear()
+        _persist_info = dict(loaded=False, source="", saved="",
+                             invalidated="")
+    if ENABLED:
+        log.debug(f"online tuner armed: mode={mode} "
+                  f"drift>{_drift_threshold} min_samples={_min_samples}"
+                  + (f" explore={_explore}" if _explore else ""))
+
+
+def min_samples() -> int:
+    """Blending pivot and drift-verdict floor (TEMPI_TUNE_MIN_SAMPLES)."""
+    return _min_samples
+
+
+def explore() -> float:
+    """Adapt-mode epsilon (TEMPI_TUNE_EXPLORE)."""
+    return _explore
+
+
+def rng() -> random.Random:
+    """The session-seeded exploration RNG (see the seed note above)."""
+    return _rng
+
+
+def size_bin(nbytes: int) -> int:
+    """floor(log2(nbytes)) — the bin axis. 0- and 1-byte messages share
+    bin 0 (a 0-byte exchange has no transport to model anyway)."""
+    return max(0, int(nbytes).bit_length() - 1)
+
+
+def record_completions(reqs) -> None:
+    """Completion hook (parallel/p2p._record_success_reqs, guarded by
+    ``ENABLED`` there): ingest one observed sample per completed request
+    that actually dispatched (stamped strategy) on a concrete link (no
+    wildcard envelopes). Wall-clock is post→drain from the Request's own
+    stamps. Never raises — an ingest failure (chaos via the
+    ``tune.ingest`` fault site, or a real bug) drops the sample and
+    counts it; bookkeeping must not fail the exchange it observes."""
+    now = time.monotonic()
+    for r in reqs:
+        if (not r.strategy or not r.posted_at
+                or r.rank < 0 or r.peer < 0):
+            continue
+        try:
+            if faults.ENABLED:
+                faults.check("tune.ingest")
+            record(health.link(r.rank, r.peer), r.strategy, r.nbytes,
+                   r.block, r.contig, r.comm.is_colocated(r.rank, r.peer),
+                   now - r.posted_at)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            _note_dropped(e)
+
+
+def _note_dropped(e: BaseException) -> None:
+    global _dropped, _dropped_warned
+    with _lock:
+        _dropped += 1
+        first = not _dropped_warned
+        _dropped_warned = True
+    if first:
+        # once at warn level; a chaos run firing the ingest site per
+        # sample must not bury the log under its own safety net
+        log.warn(f"tune ingest dropped a sample (further drops counted "
+                 f"silently): {e!r}")
+
+
+def record(link: tuple, strategy: str, nbytes: int, block: int,
+           contig: bool, colocated: bool, elapsed_s: float) -> None:
+    """Ingest one observed (link, strategy, size-bin) sample and update
+    the bin's drift verdict against the swept prediction for the same
+    envelope. ``block``/``contig`` are the modeling envelope stamped on
+    the Request at dispatch (p2p._execute_matched) so the prediction is
+    composed exactly like the chooser's candidate thunks were."""
+    global _samples, _drift_total
+    from . import model  # lazy: model imports this module at its top
+    pred = model.predicted_seconds(strategy, nbytes, block, contig,
+                                   colocated)
+    b = size_bin(nbytes)
+    gen = msys.generation()
+    event = None
+    with _lock:
+        if gen != _sheet_gen:
+            _invalidate_for_sheet_locked(gen)
+        st = _table.get((link, strategy, b))
+        if st is None:
+            st = _table[(link, strategy, b)] = BinStats()
+        _samples += 1
+        x = float(elapsed_s)
+        if st.count == 0:
+            st.mean_s = x
+        else:
+            d = x - st.mean_s
+            st.mean_s += _ALPHA * d
+            st.var_s2 = (1.0 - _ALPHA) * (st.var_s2 + _ALPHA * d * d)
+        st.count += 1
+        st.last_nbytes = int(nbytes)
+        if pred < math.inf:
+            st.pred_s = (pred if st.pred_n == 0
+                         else st.pred_s + _ALPHA * (pred - st.pred_s))
+            st.pred_n += 1
+        event = _judge_drift_locked(link, strategy, b, st)
+    if event is not None:
+        phase = event["phase"]
+        if obstrace.ENABLED:
+            obstrace.emit("tune.drift", **event)
+        lvl = log.info if phase == "drifted" else log.debug
+        lvl(f"tune: bin (link {link}, {strategy!r}, 2^{b}B) {phase}: "
+            f"observed {event['observed_s']:.3e}s vs swept "
+            f"{event['predicted_s']:.3e}s (rel err "
+            f"{event['rel_err']:.2f}, {event['samples']} samples)")
+
+
+def _judge_drift_locked(link: tuple, strategy: str, b: int,
+                        st: BinStats) -> Optional[dict]:
+    """Update ``st.stale`` from the current observed-vs-predicted gap;
+    returns the audit/trace event dict when the verdict CHANGED (stale
+    transition — hysteresis at half the threshold keeps a bin sitting on
+    the line from flapping every sample). Caller holds the lock."""
+    global _drift_total
+    if (st.count < _min_samples or st.pred_n < _min_samples
+            or st.pred_s <= 0.0):
+        return None
+    st.rel_err = abs(st.mean_s - st.pred_s) / st.pred_s
+    changed = None
+    if not st.stale and st.rel_err > _drift_threshold:
+        st.stale = True
+        st.drift_events += 1
+        changed = "drifted"
+        _bump_stale_locked(+1)
+    elif st.stale and st.rel_err < _drift_threshold / 2.0:
+        st.stale = False
+        changed = "cleared"
+        _bump_stale_locked(-1)
+    if changed is None:
+        return None
+    event = dict(phase=changed, link=list(link), strategy=strategy,
+                 bin=b, observed_s=st.mean_s, predicted_s=st.pred_s,
+                 rel_err=st.rel_err, samples=st.count)
+    _drift_total += 1
+    _drift_audit.append(dict(event))
+    del _drift_audit[:-_AUDIT_KEEP]
+    return event
+
+
+def _bump_stale_locked(delta: int) -> None:
+    global _stale_count, ADAPTING
+    _stale_count += delta
+    ADAPTING = MODE == "adapt" and _stale_count > 0
+
+
+def _invalidate_for_sheet_locked(gen: int) -> None:
+    """The swept prior changed under us (measure_all → set_system):
+    every estimator's prediction EWMA and drift verdict was judged
+    against curves that no longer exist. Drop the table wholesale —
+    the in-memory analog of the tune.json perf-hash invalidation —
+    and re-learn against the new sheet from the next sample. Caller
+    holds the lock."""
+    global _stale_count, ADAPTING, _sheet_gen
+    if _table:
+        log.info(f"tune: swept sheet changed (generation {_sheet_gen} -> "
+                 f"{gen}); discarding {len(_table)} learned bin(s) "
+                 "judged against the old curves")
+    _table.clear()
+    _stale_count = 0
+    ADAPTING = False
+    _sheet_gen = gen
+
+
+def bin_stats(link: tuple, b: int, strategies) -> Dict[str, Optional[tuple]]:
+    """The blender's read view: ``{strategy: (count, mean_s, stale)}``
+    for one link/bin (None where never observed). Plain copies under the
+    lock — a re-rank never reads an estimator mid-update. A sheet swap
+    invalidates here too, so the adapt overlay goes inert the moment the
+    prior its evidence was judged against disappears (the chooser falls
+    back to the freshly-invalidated decision cache)."""
+    with _lock:
+        if msys.generation() != _sheet_gen:
+            _invalidate_for_sheet_locked(msys.generation())
+            return {s: None for s in strategies}
+        out = {}
+        for s in strategies:
+            st = _table.get((link, s, b))
+            out[s] = None if st is None else (st.count, st.mean_s, st.stale)
+        return out
+
+
+def note_adoption(entry: dict) -> None:
+    """Record that an adapt-mode re-rank changed (or explored away from)
+    the swept model's winner — the audit trail ``api.tune_snapshot``
+    exposes, bounded like the breaker demotion trail."""
+    global _adopt_total
+    with _lock:
+        _adopt_total += 1
+        _adopt_audit.append(dict(entry))
+        del _adopt_audit[:-_AUDIT_KEEP]
+    if obstrace.ENABLED:
+        obstrace.emit("tune.adopt", link=entry.get("link"),
+                      bin=entry.get("bin"),
+                      **{"from": entry.get("from")},
+                      to=entry.get("to"), reason=entry.get("reason"))
+
+
+def note_session_stale(sections, prev_rtt_us: Optional[float],
+                       now_rtt_us: float) -> None:
+    """Session-LEVEL staleness (measure/sweep._session_staleness): whole
+    curve sections re-measured because the sheet was captured in a much
+    sicker session. Recorded regardless of mode — the ISSUE 4 satellite
+    wants session staleness and per-bin drift in ONE report
+    (``api.tune_snapshot()['session_staleness']``) — and emitted as a
+    ``tune.drift``-style trace event instead of only a log line."""
+    note = dict(scope="session", sections=list(sections),
+                prev_rtt_us=(float(prev_rtt_us) if prev_rtt_us else None),
+                now_rtt_us=float(now_rtt_us))
+    with _lock:
+        _session_notes.append(note)
+        del _session_notes[:-_NOTES_KEEP]
+    if obstrace.ENABLED:
+        obstrace.emit("tune.drift", phase="session-stale",
+                      scope="session", sections=",".join(sections),
+                      prev_rtt_us=float(prev_rtt_us or 0.0),
+                      now_rtt_us=float(now_rtt_us))
+
+
+def snapshot() -> dict:
+    """Diagnostic snapshot (exported via ``api.tune_snapshot``): mode and
+    gating flags, every bin's observed-vs-predicted estimate, the drift
+    and adoption audit trails, session-staleness notes, and persistence
+    provenance. Pure data — safe to serialize. Callable any time (reads
+    empty when the tuner is off)."""
+    with _lock:
+        bins = []
+        for (lk, strategy, b), st in sorted(
+                _table.items(), key=lambda kv: (kv[0][0], kv[0][2],
+                                                kv[0][1])):
+            bins.append(dict(
+                link=list(lk), strategy=strategy, bin=b,
+                bytes_lo=1 << b, bytes_hi=(1 << (b + 1)) - 1,
+                count=st.count, observed_s=st.mean_s,
+                observed_var_s2=st.var_s2,
+                predicted_s=(st.pred_s if st.pred_n else None),
+                rel_err=st.rel_err, stale=st.stale,
+                drift_events=st.drift_events,
+                last_nbytes=st.last_nbytes))
+        return dict(mode=MODE, adapting=ADAPTING, samples=_samples,
+                    dropped=_dropped, stale_bins=_stale_count, bins=bins,
+                    drifts=_drift_total,
+                    drifted=[dict(d) for d in _drift_audit],
+                    adoptions=_adopt_total,
+                    adopted=[dict(d) for d in _adopt_audit],
+                    session_staleness=[dict(n) for n in _session_notes],
+                    persistence=dict(_persist_info))
+
+
+# -- persistence (part 3; file format in tune/persist.py) ---------------------
+
+
+def save() -> Optional[str]:
+    """Persist the learned state to TEMPI_CACHE_DIR/tune.json, versioned
+    against a hash of the swept sheet it corrects. Returns the path, or
+    None when there is nothing to save (off, or no samples)."""
+    from . import persist
+    with _lock:
+        if not ENABLED or not _table:
+            return None
+        if msys.generation() != _sheet_gen:
+            # the sheet changed after the last ingest: the estimators
+            # were judged against curves sheet_hash() no longer
+            # describes — stamping them with the NEW sheet's hash would
+            # smuggle them past the very invalidation the hash enforces
+            _invalidate_for_sheet_locked(msys.generation())
+            return None
+        bins = [dict(link=list(lk), strategy=s, bin=b, count=st.count,
+                     mean_s=st.mean_s, var_s2=st.var_s2, pred_s=st.pred_s,
+                     pred_n=st.pred_n, stale=st.stale,
+                     last_nbytes=st.last_nbytes)
+                for (lk, s, b), st in _table.items()]
+        adoptions = _adopt_total
+        # hash UNDER the same lock as the generation check: a concurrent
+        # set_system between check and hash would pair old-sheet bins
+        # with the new sheet's hash — the exact smuggle the check exists
+        # to prevent
+        perf_hash = persist.sheet_hash()
+    doc = dict(version=persist.VERSION, perf_hash=perf_hash,
+               bins=bins, adoptions=adoptions)
+    path = persist.save(doc)
+    with _lock:
+        _persist_info["saved"] = path
+    return path
+
+
+def load() -> bool:
+    """Adopt learned state from TEMPI_CACHE_DIR/tune.json if its
+    ``perf_hash`` matches the ACTIVE swept sheet — learned corrections
+    are corrections *to a specific prior*; a sheet re-measured since
+    they were learned invalidates them wholesale (the state is
+    discarded, not quarantined: the file itself is healthy and a
+    rolled-back sheet would revalidate it). Returns True when state was
+    adopted. Never raises (init must not fail on a bad cache)."""
+    global _stale_count, _sheet_gen
+    from . import persist
+    try:
+        doc = persist.load()
+        if doc is None:
+            return False
+        expected = persist.sheet_hash()
+        got = doc.get("perf_hash", "")
+        if got != expected:
+            why = (f"learned under perf sheet {got[:12]}…, active sheet "
+                   f"is {expected[:12]}…")
+            with _lock:
+                _persist_info["invalidated"] = why
+            log.info(f"ignoring {persist.path()}: {why} (re-learning "
+                     "from live traffic)")
+            return False
+        with _lock:
+            _table.clear()
+            _stale_count = 0
+            for d in doc["bins"]:
+                st = BinStats(count=int(d["count"]),
+                              mean_s=float(d["mean_s"]),
+                              var_s2=float(d["var_s2"]),
+                              pred_s=float(d["pred_s"]),
+                              pred_n=int(d["pred_n"]),
+                              stale=bool(d["stale"]),
+                              last_nbytes=int(d.get("last_nbytes", 0)))
+                if st.pred_s > 0 and st.pred_n:
+                    st.rel_err = abs(st.mean_s - st.pred_s) / st.pred_s
+                key = (tuple(int(r) for r in d["link"]),
+                       str(d["strategy"]), int(d["bin"]))
+                _table[key] = st
+                if st.stale:
+                    _bump_stale_locked(+1)
+            _persist_info["loaded"] = True
+            _persist_info["source"] = persist.path()
+            # the hash matched the ACTIVE sheet: the adopted state is
+            # valid for the current generation
+            _sheet_gen = msys.generation()
+        log.debug(f"tune state loaded from {persist.path()}: "
+                  f"{len(doc['bins'])} bins, {_stale_count} stale")
+        return True
+    except Exception as e:  # noqa: BLE001 — cache is optional at init
+        log.warn(f"tune state load failed: {e!r}")
+        return False
+
+
+def finalize() -> None:
+    """Session teardown hook (api.finalize): persist the learned state —
+    observations are expensive evidence in observe AND adapt mode — then
+    disarm. Never raises."""
+    try:
+        save()
+    except Exception as e:  # noqa: BLE001 — teardown must not fail
+        log.warn(f"tune state save failed at finalize: {e!r}")
+    configure("off")

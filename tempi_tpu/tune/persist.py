@@ -1,0 +1,146 @@
+"""tune.json persistence: learned state versioned against the swept sheet.
+
+Part 3 of the ISSUE 4 tentpole. The learned estimators are corrections
+*to a specific swept prior* — a drift verdict says "reality disagrees
+with THESE curves". So the file carries a content hash of the active
+``SystemPerformance`` sheet (cache-dir perf.json or the shipped
+PERF_TPU.json, whichever loaded), and :func:`tune.online.load` discards
+the state wholesale when the hash no longer matches — re-measuring the
+system invalidates every correction learned against the old sheet.
+
+File handling mirrors the perf-sheet discipline (measure/system.py):
+atomic save (temp + rename, stranded temps reaped), corrupt files
+quarantined to ``tune.json.corrupt`` on CONTENT errors only (transient
+I/O never quarantines — the file may be healthy), and a version field
+so a format change discards (not quarantines: the file is well-formed,
+just older) stale state loudly.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+from typing import Optional
+
+from ..measure import system as msys
+from ..utils import env as envmod
+from ..utils import logging as log
+
+TUNE_JSON = "tune.json"
+
+#: Bump when the bin schema changes meaning; older files are discarded
+#: (logged, kept on disk) rather than misread.
+VERSION = 1
+
+#: Every bin entry must carry these keys with these shapes — anything
+#: else is a corrupt file, quarantined like a truncated perf.json.
+_BIN_KEYS = ("link", "strategy", "bin", "count", "mean_s", "var_s2",
+             "pred_s", "pred_n", "stale")
+
+
+def path() -> str:
+    return os.path.join(envmod.env.cache_dir, TUNE_JSON)
+
+
+def sheet_hash() -> str:
+    """Content hash of the ACTIVE swept sheet (canonical serialization of
+    ``measure.system.get()``): the version stamp the learned state is
+    valid against. Hashing the live object rather than the perf.json
+    file covers every way a sheet can arrive — cache dir, shipped
+    PERF_TPU.json, or a test's ``set_system`` — and an empty default
+    sheet hashes consistently too (observed-only learning is still
+    versioned)."""
+    blob = json.dumps(msys.get().to_json(), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def save(doc: dict) -> str:
+    """Atomic write of ``doc`` to TEMPI_CACHE_DIR/tune.json (temp +
+    rename, like the perf sheet's save): finalize may race a kill and a
+    truncated file would cost the whole learned history at next init."""
+    p = path()
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    for stale in glob.glob(f"{p}.tmp.*"):
+        try:  # temp files stranded by an earlier mid-save kill
+            os.remove(stale)
+        except OSError:
+            pass
+    tmp = f"{p}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, p)
+    return p
+
+
+def load() -> Optional[dict]:
+    """Read + validate TEMPI_CACHE_DIR/tune.json. Returns the document,
+    or None when the file is absent, unreadable (transient I/O — left in
+    place), version-mismatched (discarded, left in place), or corrupt
+    (quarantined to tune.json.corrupt). The perf-hash check is the
+    CALLER's (tune.online.load) — this layer owns file integrity only."""
+    p = path()
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            doc = json.load(f)
+        _validate(doc)
+    except OSError as e:
+        # transient I/O (flaky mount, permissions hiccup): the file may
+        # be perfectly healthy — never quarantine on this
+        log.warn(f"failed to read {p}: {e}")
+        return None
+    except Exception as e:
+        log.warn(f"failed to load {p}: {e}")
+        _quarantine(p)
+        return None
+    if int(doc["version"]) != VERSION:
+        log.info(f"ignoring {p}: format version {doc['version']} != "
+                 f"{VERSION} (learned state discarded; re-learning from "
+                 "live traffic)")
+        return None
+    return doc
+
+
+def _validate(doc) -> None:
+    """Structural validation; raises on anything a healthy save() could
+    not have produced (the quarantine trigger)."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"tune state is {type(doc).__name__}, want dict")
+    int(doc["version"])  # KeyError/ValueError -> corrupt
+    if not isinstance(doc.get("perf_hash"), str):
+        raise ValueError("missing/invalid perf_hash")
+    bins = doc.get("bins")
+    if not isinstance(bins, list):
+        raise ValueError("missing/invalid bins list")
+    for d in bins:
+        if not isinstance(d, dict):
+            raise ValueError("bin entry is not a dict")
+        for k in _BIN_KEYS:
+            if k not in d:
+                raise ValueError(f"bin entry missing {k!r}")
+        link = d["link"]
+        if (not isinstance(link, list) or len(link) != 2
+                or not all(isinstance(r, int) for r in link)):
+            raise ValueError(f"bad bin link {link!r}")
+        # numeric fields must convert — a corrupted value surfaces here,
+        # not as a TypeError deep inside the blender mid-decision
+        int(d["count"]), int(d["bin"]), int(d["pred_n"])
+        float(d["mean_s"]), float(d["var_s2"]), float(d["pred_s"])
+
+
+def _quarantine(p: str) -> None:
+    """Rename a tune.json that failed to parse/validate to
+    tune.json.corrupt so the next init falls through cleanly instead of
+    re-parsing and re-warning the same bad file forever (the perf-sheet
+    quarantine discipline). The sidecar keeps the evidence; the next
+    finalize simply writes a fresh tune.json."""
+    corrupt = p + ".corrupt"
+    try:
+        os.replace(p, corrupt)  # clobbers an older .corrupt: newest wins
+        log.warn(f"quarantined corrupt tune state to {corrupt}; learning "
+                 "restarts from live traffic")
+    except OSError as e:
+        log.warn(f"could not quarantine corrupt tune state {p}: {e}")

@@ -67,9 +67,37 @@ Observability knobs (ISSUE 3; see obs/trace.py and the README
                          (default "" = snapshots stay in memory only,
                          readable via obs.trace.failures())
 
-All resilience and observability knobs parse LOUDLY (a typo raises at
-init rather than silently reverting to the hang/die/fly-blind behavior
-the knob exists to prevent).
+Online performance-model adaptation knobs (ISSUE 4; see tune/online.py,
+tune/model.py and the README "Adaptive tuning" section):
+  TEMPI_TUNE           = off | observe | adapt — close the
+                         measure→choose→observe loop (default off = one
+                         module-flag truth test per touchpoint; AUTO
+                         choices byte-for-byte what the swept model
+                         alone decides). ``observe`` ingests every
+                         completed request's post→drain wall-clock into
+                         per-(link, strategy, log2-size-bin) estimators
+                         and reports drift against the swept prediction
+                         (api.tune_snapshot(), tune.drift trace events)
+                         without changing any choice; ``adapt``
+                         additionally re-ranks AUTO decisions on bins
+                         with proven drift (env-forced strategies and
+                         open breakers always win — tune only re-ranks
+                         decisions the model was free to make among
+                         healthy strategies).
+  TEMPI_TUNE_DRIFT     relative error |observed - predicted| / predicted
+                         that marks a bin's swept prediction stale once
+                         sustained (default 0.5)
+  TEMPI_TUNE_MIN_SAMPLES samples a bin needs before drift can be
+                         declared — and the pivot of the learned-vs-
+                         prior blending weight n/(n + MIN) (default 10)
+  TEMPI_TUNE_EXPLORE   epsilon in [0, 1]: probability an adapt-mode
+                         re-rank deliberately picks a non-winning
+                         healthy strategy to keep its estimator fed
+                         (default 0 = never explore)
+
+All resilience, observability, and tuning knobs parse LOUDLY (a typo
+raises at init rather than silently reverting to the
+hang/die/fly-blind/frozen-model behavior the knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -186,6 +214,12 @@ class Environment:
     trace_mode: str = "off"        # off | flight | full
     trace_events: int = 4096       # per-thread ring capacity
     trace_path: str = ""           # dump/snapshot destination ("" = memory)
+    # online performance-model adaptation (no reference analog; ISSUE 4) —
+    # see tune/online.py (ingest), tune/model.py (drift + re-ranking)
+    tune_mode: str = "off"         # off | observe | adapt
+    tune_drift: float = 0.5        # sustained relative error marking drift
+    tune_min_samples: int = 10     # samples before a drift verdict
+    tune_explore: float = 0.0      # adapt-mode epsilon exploration in [0,1]
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -259,17 +293,19 @@ class Environment:
         # revert the deployment to the exact hang-forever behavior the
         # knob exists to prevent (same philosophy as a bad TEMPI_FAULTS
         # spec failing init instead of quietly testing nothing)
-        def _float_env(name: str, default: float) -> float:
+        def _float_env(name: str, default: float,
+                       unit: str = "seconds") -> float:
             v = getenv(name)
             try:
                 f = float(v) if v else default
             except ValueError as exc:
                 raise ValueError(
                     f"bad {name}={v!r}: want a non-negative number "
-                    "(seconds)") from exc
+                    f"({unit})") from exc
             if f < 0:
                 raise ValueError(
-                    f"bad {name}={v!r}: want a non-negative number (seconds)")
+                    f"bad {name}={v!r}: want a non-negative number "
+                    f"({unit})")
             return f
 
         def _pos_int_env(name: str, default: int) -> int:
@@ -320,6 +356,26 @@ class Environment:
                 f"bad TEMPI_TRACE_EVENTS={v!r}: want a positive integer")
         e.trace_path = getenv("TEMPI_TRACE_PATH") or ""
 
+        # tuning knobs parse as loudly as the rest: a typo'd TEMPI_TUNE
+        # silently staying off would freeze AUTO decisions on the swept
+        # prior in the one deployment that asked for adaptation
+        tn = (getenv("TEMPI_TUNE") or "off").lower()
+        if tn not in ("off", "observe", "adapt"):
+            raise ValueError(
+                f"bad TEMPI_TUNE={tn!r}: want off | observe | adapt")
+        e.tune_mode = tn
+        e.tune_drift = _float_env("TEMPI_TUNE_DRIFT", 0.5,
+                                  unit="relative-error ratio")
+        e.tune_min_samples = _pos_int_env("TEMPI_TUNE_MIN_SAMPLES", 10)
+        e.tune_explore = _float_env("TEMPI_TUNE_EXPLORE", 0.0,
+                                    unit="probability in [0, 1]")
+        if e.tune_explore > 1.0:
+            # a probability; >1 is a unit confusion (percent?), not a
+            # bigger appetite for exploration — refuse it loudly
+            raise ValueError(
+                f"bad TEMPI_TUNE_EXPLORE={e.tune_explore!r}: want a "
+                "probability in [0, 1]")
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -341,6 +397,9 @@ class Environment:
             # ...and our own introspection: the flight recorder observes
             # framework machinery the bail-out turns off
             e.trace_mode = "off"
+            # ...and the adaptive layer: no strategy modeling means
+            # nothing to observe or re-rank
+            e.tune_mode = "off"
         return e
 
 

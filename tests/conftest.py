@@ -27,6 +27,10 @@ def pytest_configure(config):
         "markers",
         "obs: observability-subsystem tests (the <30s trace smoke is "
         "`pytest -m obs`)")
+    config.addinivalue_line(
+        "markers",
+        "tune: online performance-model adaptation tests (the <30s "
+        "smoke is `pytest -m tune`)")
 
 
 @pytest.fixture(autouse=True)
@@ -37,17 +41,20 @@ def _reset_globals():
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.runtime import faults, health
+    from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env
 
     env.read_environment()
     faults.configure()
     obstrace.configure()
+    tune_online.configure()
     counters.init()
     health.reset()
     yield
     faults.reset()
     # breaker state and quarantine history must not leak across tests any
     # more than an armed fault spec may — nor may a test's recorded trace
-    # events or its armed recorder mode
+    # events, its armed recorder mode, or its learned tune estimators
     health.reset()
     obstrace.configure("off")
+    tune_online.configure("off")

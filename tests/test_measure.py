@@ -61,6 +61,71 @@ def test_interp_2d_clamped_bilinear():
     assert interp_2d(grid, 1 << 30, 512) == 88.0
 
 
+def test_interp_1d_extrapolation_edges():
+    """ISSUE 4 satellite: the paths the tune blender leans on — below-min
+    and above-max linear extrapolation in log2 space (which may go
+    NEGATIVE below the min knot: the reference extrapolates without
+    clamping, measure_system.cpp:184-205), single-point curves, and
+    exact-knot hits."""
+    curve = [(1024, 1e-6), (4096, 3e-6)]
+    # exact knots
+    assert interp_time(curve, 1024) == 1e-6
+    assert interp_time(curve, 4096) == 3e-6
+    # log2 midpoint
+    assert math.isclose(interp_time(curve, 2048), 2e-6)
+    # below min: slope 1e-6 per log2 octave, two octaves down
+    assert math.isclose(interp_time(curve, 256), -1e-6)
+    # above max: two octaves up
+    assert math.isclose(interp_time(curve, 16384), 5e-6)
+    # a single-point curve is a constant everywhere
+    single = [(4096, 7e-6)]
+    for nb in (1, 4096, 1 << 30):
+        assert interp_time(single, nb) == 7e-6
+    # degenerate sizes clamp to log2(1), never crash
+    assert math.isfinite(interp_time(curve, 0))
+    # duplicate knots (x1 == x0) return the left value, no div-by-zero
+    assert interp_time([(1024, 1e-6), (1024, 9e-6)], 1024) == 1e-6
+
+
+def test_interp_2d_single_cell_and_row():
+    # a 1x1 grid is a constant everywhere (fx = fy = 0 by construction)
+    assert interp_2d([[4.0]], 1, 1) == 4.0
+    assert interp_2d([[4.0]], 1 << 30, 512) == 4.0
+    # a single-row grid interpolates only along blocklen
+    row = [[float(j) for j in range(9)]]
+    assert interp_2d(row, 1 << 20, 4) == 2.0
+    assert interp_2d(row, 64, 256) == 8.0
+    # empty grids are unmeasured, not zero
+    assert interp_2d([], 64, 1) == math.inf
+    assert interp_2d([[]], 64, 1) == math.inf
+
+
+def test_interp_2d_sentinel_neighbors_excluded():
+    """ISSUE 4 satellite regression: a single unmeasurable grid point
+    (the ~1e9 s sentinel left by a skipped sweep cell) must not bleed
+    into neighboring REAL cells — before the fix, any query between a
+    sentinel knot and its neighbors blended in a share of 30 years."""
+    from tempi_tpu.measure.system import (GRID_BLOCKLEN, GRID_BYTES,
+                                          UNMEASURABLE_S)
+
+    grid = [[1e-6] * 9 for _ in range(9)]
+    grid[2][3] = UNMEASURABLE_S
+    # queries in every cell ADJACENT to the sentinel knot renormalize
+    # over the real corners: the prediction stays at the real value
+    for nb in (int(GRID_BYTES[1] * 1.5), int(GRID_BYTES[2] * 1.5)):
+        for bl in (int(GRID_BLOCKLEN[2] * 1.5), int(GRID_BLOCKLEN[3] * 1.5)):
+            assert interp_2d(grid, nb, bl) == pytest.approx(1e-6)
+    # an exact hit ON the sentinel knot stays sentinel (decisively worse
+    # than any real path, still finite — never interpolated away)
+    assert interp_2d(grid, GRID_BYTES[2], GRID_BLOCKLEN[3]) == UNMEASURABLE_S
+    # an all-sentinel grid is sentinel everywhere
+    dead = [[UNMEASURABLE_S] * 9 for _ in range(9)]
+    assert interp_2d(dead, 4096, 8) == UNMEASURABLE_S
+    # and a fully-real grid is numerically identical to plain bilinear
+    real = [[float(10 * i + j) for j in range(9)] for i in range(9)]
+    assert math.isclose(interp_2d(real, 128, 1), 5.0)
+
+
 def test_model_composition():
     sp = SystemPerformance()
     sp.pack_device = [[1e-6]]

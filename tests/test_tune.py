@@ -1,0 +1,487 @@
+"""Online performance-model adaptation suite (ISSUE 4).
+
+The swept model (measure/system.py) is a one-time prior; tempi_tpu/tune/
+closes the measure→choose→observe loop. This suite pins the contract:
+
+  * ``TEMPI_TUNE=off`` (default) — byte-for-byte choice-identical to the
+    swept model alone, zero samples ingested, zero per-request stamping.
+  * ``observe`` — real completions are ingested (post→drain wall-clock,
+    no TEMPI_TRACE dependence), drift against the swept prediction is
+    detected, reported via ``api.tune_snapshot()`` and ``tune.drift``
+    trace events — and choices never change.
+  * ``adapt`` — a synthetically drifted link flips the AUTO strategy for
+    that link/size bin only; precedence invariants hold (env-forced >
+    open breaker > tune > swept model).
+  * persistence — tune.json round-trips, is invalidated by a perf-sheet
+    hash change, discarded on a version bump, and quarantined to
+    tune.json.corrupt when corrupt.
+  * chaos — the ``tune.ingest`` fault site drops samples, never the
+    exchange that produced them.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.measure import system as msys
+from tempi_tpu.obs import trace as obstrace
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+from tempi_tpu.parallel.plan import Message
+from tempi_tpu.runtime import faults, health
+from tempi_tpu.tune import model as tmodel
+from tempi_tpu.tune import online as tonline
+from tempi_tpu.tune import persist as tpersist
+from tempi_tpu.utils import env as envmod
+
+from test_faults import _post_pair
+
+pytestmark = pytest.mark.tune
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def _install_sheet(device_cheap=True):
+    """Synthetic swept sheet with a clear ND-arm winner: device when
+    ``device_cheap`` (pack grids 1us vs oneshot's 5us), oneshot
+    otherwise. Curves cover 1B..8MiB so every judged size interpolates."""
+    sp = msys.SystemPerformance()
+    sp.host_pingpong = [(1 << i, 2e-6 * (i + 1)) for i in range(24)]
+    sp.intra_node_pingpong = [(1 << i, 1e-6 * (i + 1)) for i in range(24)]
+    sp.inter_node_pingpong = [(1 << i, 1e-6 * (i + 1)) for i in range(24)]
+    # the pack-grid gap must dominate the transport gap (host_pingpong
+    # is ~2x intra here), so the non-cheap side needs a decisive 20us
+    dev, host = (1e-6, 5e-6) if device_cheap else (2e-5, 1e-6)
+    sp.pack_device = [[dev] * 9 for _ in range(9)]
+    sp.unpack_device = [[dev] * 9 for _ in range(9)]
+    sp.pack_host = [[host] * 9 for _ in range(9)]
+    sp.unpack_host = [[host] * 9 for _ in range(9)]
+    msys.set_system(sp)
+    return sp
+
+
+def _msg(src, dst, nbytes=4096):
+    packer, _ = p2p._packer_for(dt.contiguous(nbytes, dt.BYTE))
+    return Message(src=src, dst=dst, tag=0, nbytes=nbytes, sbuf=None,
+                   spacker=packer, scount=1, soffset=0, rbuf=None,
+                   rpacker=packer, rcount=1, roffset=0)
+
+
+def _arm(monkeypatch, mode, tmp_path=None, min_samples=5, **extra):
+    monkeypatch.setenv("TEMPI_TUNE", mode)
+    monkeypatch.setenv("TEMPI_TUNE_MIN_SAMPLES", str(min_samples))
+    if tmp_path is not None:
+        monkeypatch.setenv("TEMPI_CACHE_DIR", str(tmp_path))
+    for k, v in extra.items():
+        monkeypatch.setenv(k, str(v))
+    envmod.read_environment()
+    tonline.configure()
+
+
+def _drift_device(link, n=8, nbytes=4096, elapsed=5e-2):
+    """Feed ``n`` synthetic completions showing device is ~3000x the
+    swept prediction on ``link`` — the drifted-link injection."""
+    for _ in range(n):
+        tonline.record(link, "device", nbytes, 512, False, True, elapsed)
+
+
+# -- knob parsing --------------------------------------------------------------
+
+
+def test_knob_defaults():
+    e = envmod.Environment.from_environ({})
+    assert (e.tune_mode, e.tune_drift, e.tune_min_samples,
+            e.tune_explore) == ("off", 0.5, 10, 0.0)
+
+
+@pytest.mark.parametrize("name,val", [
+    ("TEMPI_TUNE", "sometimes"),
+    ("TEMPI_TUNE_DRIFT", "-0.5"),
+    ("TEMPI_TUNE_DRIFT", "fast"),
+    ("TEMPI_TUNE_MIN_SAMPLES", "-2"),
+    ("TEMPI_TUNE_MIN_SAMPLES", "2.5"),
+    ("TEMPI_TUNE_EXPLORE", "-0.1"),
+    ("TEMPI_TUNE_EXPLORE", "1.5"),
+])
+def test_knobs_parse_loudly(name, val):
+    with pytest.raises(ValueError):
+        envmod.Environment.from_environ({name: val})
+
+
+def test_disable_forces_tune_off():
+    e = envmod.Environment.from_environ({"TEMPI_DISABLE": "1",
+                                         "TEMPI_TUNE": "adapt"})
+    assert e.tune_mode == "off"
+
+
+def test_ingest_site_refuses_wedge():
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("tune.ingest:wedge:1:1")
+
+
+# -- off mode: byte-for-byte identical, zero ingest ---------------------------
+
+
+def test_off_mode_ingests_nothing_and_keeps_choices(world):
+    assert not tonline.ENABLED and not tonline.ADAPTING
+    _install_sheet()
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "device"
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(np.asarray(rbuf.get_rank(dst)), row)
+    snap = api.tune_snapshot()
+    assert snap["mode"] == "off" and snap["samples"] == 0
+    assert snap["bins"] == []
+    # the dispatch stamping is ENABLED-gated too: off-path requests keep
+    # their slot defaults (zero per-request tuning work)
+    assert all(r.block == 0 and r.contig is False for r in reqs)
+
+
+# -- observe mode: ingest + drift report, choices unchanged -------------------
+
+
+def test_observe_ingests_real_completions(world, monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(np.asarray(rbuf.get_rank(dst)), row)
+    snap = api.tune_snapshot()
+    # both the send and recv requests of the pair completed on link (0,1)
+    assert snap["samples"] >= 2
+    (b,) = [b for b in snap["bins"] if b["link"] == [0, 1]]
+    assert b["strategy"] in ("device", "oneshot", "staged")
+    assert b["count"] >= 2 and b["observed_s"] > 0
+    assert b["bytes_lo"] <= 64 <= b["bytes_hi"]
+    # requests were stamped with the modeling envelope at dispatch
+    assert all(r.block > 0 for r in reqs)
+
+
+def test_observe_reports_drift_without_changing_choices(world, monkeypatch,
+                                                        tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    obstrace.configure("flight")
+    _install_sheet()
+    lk = health.link(0, 1)
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "device"
+    _drift_device(lk)
+    snap = api.tune_snapshot()
+    assert snap["stale_bins"] == 1 and not snap["adapting"]
+    (b,) = [b for b in snap["bins"] if b["stale"]]
+    assert b["link"] == [0, 1] and b["strategy"] == "device"
+    assert b["bin"] == 12 and b["rel_err"] > 100
+    assert snap["drifted"][0]["phase"] == "drifted"
+    # observe mode NEVER re-ranks: the drifted link keeps the swept winner
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "device"
+    assert snap["adoptions"] == 0
+    events = [e for e in obstrace.snapshot() if e["name"] == "tune.drift"]
+    assert events and events[0]["strategy"] == "device"
+
+
+def test_drift_verdict_has_hysteresis(monkeypatch, tmp_path):
+    """A bin that converges back onto the swept prior (rel err below half
+    the threshold) clears its stale flag — and the flap is audited."""
+    _arm(monkeypatch, "observe", tmp_path, min_samples=3)
+    _install_sheet()
+    lk = health.link(0, 1)
+    _drift_device(lk, n=5)
+    assert tonline.snapshot()["stale_bins"] == 1
+    # now reality matches the prediction again (~1.5e-5s for 4KiB):
+    # enough agreeing samples pull the EWMA back under threshold/2
+    for _ in range(60):
+        tonline.record(lk, "device", 4096, 512, False, True, 1.5e-5)
+    snap = tonline.snapshot()
+    assert snap["stale_bins"] == 0
+    phases = [d["phase"] for d in snap["drifted"]]
+    assert phases == ["drifted", "cleared"]
+
+
+# -- adapt mode: the acceptance-criterion flip --------------------------------
+
+
+def test_adapt_flips_auto_choice_on_drifted_link_only(world, monkeypatch,
+                                                      tmp_path):
+    _arm(monkeypatch, "adapt", tmp_path)
+    _install_sheet()
+    m01, m23 = _msg(0, 1), _msg(2, 3)
+    assert p2p.choose_strategy_message(world, m01) == "device"
+    _drift_device(health.link(0, 1))
+    assert tonline.ADAPTING
+    # the drifted link/bin flips; the same shape on a healthy link and a
+    # different size bin on the SAME link both keep the swept winner
+    assert p2p.choose_strategy_message(world, m01) == "oneshot"
+    assert p2p.choose_strategy_message(world, m23) == "device"
+    assert p2p.choose_strategy_message(world, _msg(0, 1, 1 << 20)) == "device"
+    snap = api.tune_snapshot()
+    assert snap["adapting"] and snap["adoptions"] >= 1
+    a = snap["adopted"][0]
+    assert (a["from"], a["to"], a["link"]) == ("device", "oneshot", [0, 1])
+    assert a["reason"] == "drift"
+
+
+def test_adapt_emits_adopt_trace_event(world, monkeypatch, tmp_path):
+    _arm(monkeypatch, "adapt", tmp_path)
+    obstrace.configure("flight")
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    p2p.choose_strategy_message(world, _msg(0, 1))
+    names = [e["name"] for e in obstrace.snapshot()]
+    assert "tune.drift" in names and "tune.adopt" in names
+
+
+def test_adapt_blends_learned_into_prior():
+    """The blend weight grows with samples: at the MIN_SAMPLES pivot the
+    observation carries half the weight; an unmeasured prior defers to
+    the observation entirely."""
+    n = tonline.min_samples()
+    assert tmodel.blend(1e-3, 3e-3, n) == pytest.approx(2e-3)
+    assert tmodel.blend(math.inf, 7e-4, 1) == pytest.approx(7e-4)
+
+
+def test_epsilon_exploration_is_bounded_and_audited(world, monkeypatch,
+                                                    tmp_path):
+    _arm(monkeypatch, "adapt", tmp_path, TEMPI_TUNE_EXPLORE="1.0")
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    # epsilon 1.0: every re-rank explores the non-winning healthy
+    # candidate — for this drifted bin the winner is oneshot, so the
+    # exploration pick is device, and the adoption trail says why
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "device"
+    snap = api.tune_snapshot()
+    assert snap["adopted"][-1]["reason"] == "explore"
+    # exploration is evidence-scoped like the re-rank itself: a healthy
+    # link never explores
+    assert p2p.choose_strategy_message(world, _msg(2, 3)) == "device"
+
+
+# -- precedence invariants ----------------------------------------------------
+
+
+def test_env_forced_strategy_never_overridden_by_tune(world, monkeypatch,
+                                                      tmp_path):
+    _arm(monkeypatch, "adapt", tmp_path)
+    monkeypatch.setenv("TEMPI_DATATYPE_ONESHOT", "1")
+    envmod.read_environment()
+    _install_sheet()  # device would win on the swept model
+    _drift_device(health.link(0, 1))
+    assert tonline.ADAPTING
+    # forced is forced: the tune overlay is never consulted
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "oneshot"
+    assert api.tune_snapshot()["adoptions"] == 0
+
+
+def test_open_breaker_quarantine_never_undone_by_tune(world, monkeypatch,
+                                                      tmp_path):
+    """Learned evidence says the quarantined strategy is FAST — the open
+    breaker still wins: tune re-ranks healthy options only."""
+    _arm(monkeypatch, "adapt", tmp_path)
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "3600")
+    envmod.read_environment()
+    _install_sheet(device_cheap=False)  # swept winner: oneshot
+    lk = health.link(0, 1)
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "oneshot"
+    # device is observed far FASTER than its (expensive) swept prediction
+    # -> drift -> adapt would flip to device...
+    _drift_device(lk, elapsed=1e-7)
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "device"
+    # ...until its breaker opens: quarantine beats learned evidence
+    health.record_failure(lk, "device")
+    health.record_failure(lk, "device")
+    assert health.state(lk, "device") == health.OPEN
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "oneshot"
+
+
+# -- persistence --------------------------------------------------------------
+
+
+def test_tune_state_roundtrip(monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    path = tonline.save()
+    assert path == str(tmp_path / "tune.json") and os.path.exists(path)
+    assert tonline.snapshot()["persistence"]["saved"] == path
+    tonline.configure()  # fresh session, same sheet
+    assert tonline.snapshot()["bins"] == []
+    assert tonline.load() is True
+    snap = tonline.snapshot()
+    assert snap["persistence"]["loaded"]
+    (b,) = snap["bins"]
+    assert b["stale"] and b["count"] == 8 and b["link"] == [0, 1]
+    # restored staleness re-arms adaptation in adapt mode
+    _arm(monkeypatch, "adapt", tmp_path)
+    assert tonline.load() is True and tonline.ADAPTING
+
+
+def test_resweep_invalidates_in_memory_state(world, monkeypatch, tmp_path):
+    """A mid-session sheet swap (measure_all → set_system) invalidates
+    the LIVE estimators like a perf-hash mismatch invalidates tune.json:
+    drift verdicts judged against the old curves neither keep steering
+    adapt-mode choices nor get stamped with the new sheet's hash."""
+    _arm(monkeypatch, "adapt", tmp_path)
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    assert tonline.ADAPTING
+    _install_sheet(device_cheap=False)  # the system was re-measured
+    # the overlay goes inert at its next read: the new sheet's winner
+    # rides, not a re-rank based on old-sheet drift
+    assert p2p.choose_strategy_message(world, _msg(0, 1)) == "oneshot"
+    assert not tonline.ADAPTING
+    # nothing valid to persist either — save() must not stamp old-sheet
+    # evidence with the new sheet's hash
+    assert tonline.save() is None
+    # the next ingest re-learns against the new sheet from scratch
+    tonline.record(health.link(0, 1), "device", 4096, 512, False, True,
+                   1e-3)
+    snap = tonline.snapshot()
+    assert snap["stale_bins"] == 0
+    (b,) = snap["bins"]
+    assert b["count"] == 1
+
+
+def test_contig_prediction_tracks_the_arm_that_decided():
+    """A Packer1D message under TEMPI_CONTIGUOUS_AUTO rides the 1-D
+    arm's direct composition while that arm is measured; when its curves
+    are unmeasured the chooser falls through to the datatype arm, and
+    the ingest prediction must follow it there rather than pinning the
+    never-consulted 1-D composition."""
+    _install_sheet()
+    assert tmodel.predicted_seconds("device", 4096, 512, True, True) == \
+        pytest.approx(msys.model_direct_1d(4096, True))
+    sp = _install_sheet()
+    sp.intra_node_pingpong = []  # 1-D device arm: unmeasured
+    msys.set_system(sp)
+    assert math.isinf(msys.model_direct_1d(4096, True))
+    assert tmodel.predicted_seconds("device", 4096, 512, True, True) == \
+        msys.model_device(4096, 512, True)
+    # non-contig traffic is untouched by the fallback
+    assert tmodel.predicted_seconds("device", 4096, 512, False, True) == \
+        msys.model_device(4096, 512, True)
+
+
+def test_tune_state_invalidated_by_perf_hash_change(monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    assert tonline.save()
+    # the system is re-measured: every learned correction is against a
+    # prior that no longer exists
+    _install_sheet(device_cheap=False)
+    tonline.configure()
+    assert tonline.load() is False
+    snap = tonline.snapshot()
+    assert snap["bins"] == [] and not snap["persistence"]["loaded"]
+    assert "perf sheet" in snap["persistence"]["invalidated"]
+    assert os.path.exists(tmp_path / "tune.json")  # discarded, not deleted
+
+
+def test_version_mismatch_discarded_not_quarantined(monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    _install_sheet()
+    _drift_device(health.link(0, 1))
+    path = tonline.save()
+    with open(path) as f:
+        doc = json.load(f)
+    doc["version"] = tpersist.VERSION + 1
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    tonline.configure()
+    assert tonline.load() is False
+    assert os.path.exists(path)  # well-formed, just newer: kept in place
+    assert not os.path.exists(str(path) + ".corrupt")
+
+
+def test_corrupt_tune_state_quarantined(monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    path = tpersist.path()
+    os.makedirs(tmp_path, exist_ok=True)
+    with open(path, "w") as f:
+        f.write('{"version": 1, "perf_hash": "x", "bins": [{"broken"')
+    assert tonline.load() is False
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    # structurally-invalid-but-parseable is corrupt too
+    with open(path, "w") as f:
+        json.dump({"version": 1, "perf_hash": "x",
+                   "bins": [{"link": "nope"}]}, f)
+    assert tonline.load() is False
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_finalize_persists_learned_state(monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    world = api.init()
+    try:
+        _install_sheet()
+        reqs, rbuf, row, dst = _post_pair(world)
+        p2p.waitall(reqs)
+    finally:
+        api.finalize()
+    assert os.path.exists(tmp_path / "tune.json")
+    assert not tonline.ENABLED  # finalize disarms
+
+
+# -- chaos: the tune.ingest fault site ----------------------------------------
+
+
+def test_ingest_fault_drops_sample_not_exchange(world, monkeypatch,
+                                                tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    faults.configure("tune.ingest:raise:1:7")
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)  # the exchange must complete despite chaos ingest
+    np.testing.assert_array_equal(np.asarray(rbuf.get_rank(dst)), row)
+    snap = api.tune_snapshot()
+    assert snap["dropped"] >= 2 and snap["samples"] == 0
+
+
+def test_ingest_fault_delay_only_slows_ingest(world, monkeypatch, tmp_path):
+    _arm(monkeypatch, "observe", tmp_path)
+    monkeypatch.setenv("TEMPI_FAULT_DELAY_S", "0.001")
+    envmod.read_environment()
+    faults.configure("tune.ingest:delay:1:7")
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    assert api.tune_snapshot()["samples"] >= 2  # delayed, not dropped
+
+
+# -- session-level staleness surfaces beside per-bin drift --------------------
+
+
+def test_session_staleness_in_tune_snapshot_and_trace(monkeypatch):
+    from tempi_tpu.measure import sweep
+
+    obstrace.configure("flight")
+    sp = msys.SystemPerformance()
+    sp.d2h = [(1024, 1e-3)]
+    sp.intra_node_pingpong = [(1024, 2e-3)]
+    sp.measured_conditions = {"dispatch_rtt_us": 40000.0}
+    sweep._session_staleness(sp, rtt_now=100e-6)
+    assert sp.d2h == [] and sp.intra_node_pingpong == []
+    notes = api.tune_snapshot()["session_staleness"]
+    assert notes and notes[0]["scope"] == "session"
+    assert set(notes[0]["sections"]) == {"d2h", "intra_node_pingpong"}
+    assert notes[0]["prev_rtt_us"] == 40000.0
+    events = [e for e in obstrace.snapshot()
+              if e["name"] == "tune.drift" and e.get("scope") == "session"]
+    assert events and "d2h" in events[0]["sections"]
+
+
+def test_session_staleness_not_triggered_by_healthy_session(monkeypatch):
+    from tempi_tpu.measure import sweep
+
+    sp = msys.SystemPerformance()
+    sp.d2h = [(1024, 1e-3)]
+    sp.measured_conditions = {"dispatch_rtt_us": 120.0}
+    sweep._session_staleness(sp, rtt_now=100e-6)
+    assert sp.d2h  # same scale: nothing cleared
+    assert api.tune_snapshot()["session_staleness"] == []
