@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""One-shot vs persistent-replay alltoallv across skew patterns (ISSUE 5).
+
+The persistent API (`api.alltoallv_init` -> start/wait) pays matching,
+method choice, and schedule compilation once; this bench measures what that
+amortization is worth against the one-shot dispatcher re-deriving
+everything per call, across the traffic shapes that stress different parts
+of the engine:
+
+  * uniform — every pair moves the same bytes (the fused fast path)
+  * sparse  — a random sparse matrix (the judged config)
+  * skewed  — sparse plus a single large outlier pair (the skew-split and
+              chunk-split shape)
+
+CSV columns: pattern, method, mode (oneshot|persistent), setup_s (init/
+compile wall time), time_s (trimean per exchange). The nonzero counters —
+including the coll.num_compiles/num_replays and plan cache hit/miss
+evidence — print to stderr via benches/_common.report_counters.
+"""
+
+import sys
+import time
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+from bench_mpi_random_alltoallv import make_displs, make_sparse_counts
+
+
+def make_patterns(size, scale, seed):
+    import numpy as np
+    uniform = np.full((size, size), scale, np.int64)
+    np.fill_diagonal(uniform, 0)
+    sparse = make_sparse_counts(size, 0.3, scale, seed)
+    skewed = sparse.copy()
+    s, d = 1, (1 + size // 2) % size
+    skewed[s, d] = scale * 64  # the outlier pair
+    return {"uniform": uniform, "sparse": sparse, "skewed": skewed}
+
+
+def main() -> int:
+    p = base_parser("one-shot vs persistent-replay alltoallv")
+    p.add_argument("--scale", type=int, default=1 << 12)
+    p.add_argument("--methods", default="auto,remote_first,isir_staged",
+                   help="comma list: auto or AlltoallvMethod values")
+    args = p.parse_args()
+    setup_platform(args)
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils.env import AlltoallvMethod
+
+    devices_or_die(1)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    methods = [None if m.strip() == "auto" else AlltoallvMethod(m.strip())
+               for m in args.methods.split(",") if m.strip()]
+
+    rows = []
+    for pattern, counts in make_patterns(size, args.scale, seed=5).items():
+        sdispls, rdispls = make_displs(counts)
+        nb_s = max(1, int(counts.sum(1).max()))
+        nb_r = max(1, int(counts.sum(0).max()))
+        sb = comm.alloc(nb_s)
+        rb = comm.alloc(nb_r)
+        for method in methods:
+            label = method.value if method else "auto"
+
+            def oneshot():
+                api.alltoallv(comm, sb, counts, sdispls, rb, counts.T,
+                              rdispls, method=method)
+                rb.data.block_until_ready()
+
+            oneshot()  # compile/caches hot
+            r1 = benchmark(oneshot, **kw)
+            rows.append((pattern, label, "oneshot", 0.0, r1.trimean))
+
+            t0 = time.perf_counter()
+            pc = api.alltoallv_init(comm, sb, counts, sdispls, rb,
+                                    counts.T, rdispls, method=method)
+
+            def persistent():
+                pc.start()
+                pc.wait()
+                rb.data.block_until_ready()
+
+            persistent()  # first start compiles the lowering's programs
+            setup = time.perf_counter() - t0
+            r2 = benchmark(persistent, **kw)
+            rows.append((pattern, label, "persistent", setup, r2.trimean))
+            pc.free()
+
+    emit_csv(("pattern", "method", "mode", "setup_s", "time_s"), rows)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

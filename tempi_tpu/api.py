@@ -384,6 +384,22 @@ def alltoallv(*args, **kwargs):
     return _a2av(*args, **kwargs)
 
 
+def alltoallv_init(*args, **kwargs):
+    """MPI_Alltoallv_init analog (ISSUE 5): compile the collective once —
+    round schedule, method choice, message lowering — and replay it with
+    ``start()``/``wait()`` on the returned ``PersistentColl``. See
+    coll/persistent.py and the README "Persistent collectives" section."""
+    from .coll.persistent import alltoallv_init as _init
+    return _init(*args, **kwargs)
+
+
+def neighbor_alltoallv_init(*args, **kwargs):
+    """MPI_Neighbor_alltoallv_init analog over a dist-graph communicator's
+    adjacency (matrix-expressible graphs only)."""
+    from .coll.persistent import neighbor_alltoallv_init as _init
+    return _init(*args, **kwargs)
+
+
 def neighbor_alltoallv(*args, **kwargs):
     from .parallel.neighbor import neighbor_alltoallv as _nav
     return _nav(*args, **kwargs)

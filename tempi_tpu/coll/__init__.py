@@ -1,0 +1,13 @@
+"""Persistent collective schedules (ISSUE 5).
+
+Compile-once, run-many alltoallv/neighbor plans in the MPI 4.0
+``MPI_Alltoallv_init`` direction (which the TEMPI reference,
+arXiv:2012.14363, predates): :mod:`schedule` compiles a byte-count matrix
+into contention-free rounds (bipartite edge-coloring, off-node rounds
+first, oversized messages chunk-split); :mod:`persistent` lowers the
+schedule onto the existing exchange machinery and replays it.
+"""
+
+from .persistent import (PersistentColl, alltoallv_init,  # noqa: F401
+                         neighbor_alltoallv_init)
+from .schedule import Schedule, SMsg, compile_schedule  # noqa: F401

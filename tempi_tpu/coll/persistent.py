@@ -1,0 +1,629 @@
+"""Persistent collectives: compile-once, run-many alltoallv plans.
+
+MPI 4.0 ``MPI_Alltoallv_init`` analog (ISSUE 5), one level above the p2p
+layer's ``Send_init``/``Start`` machinery: :func:`alltoallv_init` /
+:func:`neighbor_alltoallv_init` compile the counts matrix ONCE — round
+schedule (coll/schedule.py), method choice, message lowering — and return a
+:class:`PersistentColl` whose ``start()`` replays the compiled plan. A
+training loop issuing the identical collective every step pays matching,
+strategy modeling, and schedule derivation exactly once instead of per
+call.
+
+Method set and lowering:
+
+  * ``device_fused``  — the one-shot engine's hardware-native path (ragged
+    all-to-all with fused-collective fallback); the compiled XLA program is
+    cached by the one-shot machinery, so every ``start()`` after the first
+    is a cache hit + dispatch.
+  * ``staged``        — bulk D2H -> host permute -> H2D with the gather
+    index arrays precomputed at compile time (the one-shot path re-derives
+    them per call).
+  * ``isir_remote_first`` / ``isir_staged`` / ``isir_remote_staged`` — the
+    schedule's rounds lowered to persistent isend/irecv batches
+    (``send_init``-style requests at the reserved ``tags.COLL_SCHEDULE``
+    tag) replayed through the p2p ``_PersistentBatch`` path; off-node
+    rounds dispatch first (the schedule compiler's remote-first prefix).
+
+AUTO method choice is model-driven with the established precedence:
+env-forced (explicit ``method=`` or a TEMPI_ALLTOALLV_* knob) > open
+breaker (a quarantined transport is never chosen, and an already-compiled
+plan RECOMPILES when its transport's breaker opens — no stale replay) >
+tune (drift-proven learned estimators scale the swept estimate) > swept
+model. Every choice emits a ``coll.choice`` trace event carrying the
+per-method estimates.
+
+Runtime integration: each round is a ``coll.round`` obs span and a
+``coll.round`` fault site; a faulted round retries under the
+TEMPI_RETRY_ATTEMPTS policy (rounds write disjoint regions, so re-dispatch
+is idempotent); ``num_coll_compiles``/``num_coll_replays`` land in the
+``coll`` counter group.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..measure import system as msys
+from ..obs import trace as obstrace
+from ..ops import dtypes
+from ..ops.dtypes import Datatype
+from ..runtime import faults, health
+from ..tune import model as tune_model
+from ..tune import online as tune_online
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from ..utils.env import AlltoallvMethod
+from ..parallel import p2p, tags
+from ..parallel import plan as planmod
+from ..parallel.communicator import Communicator, DistBuffer
+from .schedule import Schedule, compile_schedule
+
+#: Transport strategy each collective method rides — the breaker/tune key
+#: space (runtime/health.py, tune/online.py) is per-p2p-strategy, so the
+#: health and drift evidence of the underlying transport steers the
+#: collective method the same way it steers individual exchanges.
+_UNDERLYING = {
+    "device_fused": "device",
+    "staged": "staged",
+    "isir_remote_first": "device",
+    "isir_staged": "staged",
+    "isir_remote_staged": "staged",
+}
+
+#: The AUTO candidate set (isir_remote_staged is reachable only by forcing,
+#: like the one-shot dispatcher's AUTO never picks it either).
+_AUTO_METHODS = ("device_fused", "staged", "isir_remote_first",
+                 "isir_staged")
+
+_FORCED_BY_ENUM = {
+    AlltoallvMethod.STAGED: "staged",
+    AlltoallvMethod.REMOTE_FIRST: "isir_remote_first",
+    AlltoallvMethod.ISIR_STAGED: "isir_staged",
+    AlltoallvMethod.ISIR_REMOTE_STAGED: "isir_remote_staged",
+    # NONE is the TEMPI_DISABLE/TEMPI_NO_ALLTOALLV bail-out: "native
+    # all_to_all, no strategy modeling" — forced onto the device path like
+    # the one-shot dispatcher, never through the chooser/breaker/tune
+    AlltoallvMethod.NONE: "device_fused",
+}
+
+
+def _method_estimates(comm: Communicator, sched: Schedule,
+                      sc: np.ndarray) -> Dict[str, float]:
+    """Swept-sheet cost of each AUTO candidate, in seconds. Composed from
+    the same measured curves the p2p chooser consults (measure/system.py);
+    an unmeasured curve prices its methods at +inf, and an all-inf result
+    means "unmeasured system" (the caller falls back to the TPU-first
+    default, like the one-shot AUTO path)."""
+    sp = msys.get()
+    size = sched.size
+    est: Dict[str, float] = {m: 0.0 for m in _AUTO_METHODS}
+    M = int(sc.max()) if sc.size else 0
+    if M == 0 or not sched.rounds:
+        return est  # nothing moves: every method is free
+    any_remote = sched.remote_rounds > 0
+    from ..parallel.alltoallv import _split_threshold
+    # device_fused: one fused collective of size*T padded bytes per rank,
+    # plus the largest skew-split tail riding the p2p engine
+    T = min(_split_threshold(sc, size), M)
+    fused = msys.interp_time(
+        sp.inter_node_pingpong if (any_remote and sp.inter_node_pingpong)
+        else sp.intra_node_pingpong, size * max(T, 1))
+    tails = sc[sc > T]
+    if tails.size:
+        fused += msys.model_direct_1d(int(tails.max() - T), not any_remote)
+    est["device_fused"] = fused
+    # staged: bulk D2H of the widest send row, one host move of the
+    # largest pair, H2D of the widest recv row
+    out_max = int(sc.sum(axis=1).max())
+    in_max = int(sc.sum(axis=0).max())
+    est["staged"] = (msys.interp_time(sp.d2h, out_max)
+                     + msys.interp_time(sp.host_pingpong, M)
+                     + msys.interp_time(sp.h2d, in_max))
+    # isir variants: rounds run back-to-back; each round's cost is its
+    # largest message through the per-pair transport
+    dev = stg = 0.0
+    for rnd in sched.rounds:
+        maxb = max(s.nbytes for s in rnd)
+        colocated = not any(s.remote for s in rnd)
+        dev += msys.model_direct_1d(maxb, colocated)
+        stg += msys.model_staged_1d(maxb)
+    est["isir_remote_first"] = dev
+    est["isir_staged"] = stg
+    return est
+
+
+def _tune_overlay(comm: Communicator, sc: np.ndarray, remote: np.ndarray,
+                  est: Dict[str, float]) -> List[str]:
+    """Scale the swept estimates by the drift-proven learned evidence of
+    each method's underlying transport on the REPRESENTATIVE link (the
+    largest pair — the message the batch-level p2p chooser keys on too).
+    Only bins the tuner has judged stale participate (the same
+    evidence-scoping as ``tune_model.adapt_choice``); the correction is a
+    ratio, so a transport observed 3x slower than its swept prediction
+    prices its collective methods 3x up. Returns the adjusted methods."""
+    s, d = np.unravel_index(int(np.argmax(sc)), sc.shape)
+    nb = int(sc[s, d])
+    if nb <= 0:
+        return []
+    lk = health.link(comm.library_rank(int(s)), comm.library_rank(int(d)))
+    colocated = not bool(remote[s, d])
+    stats = tune_online.bin_stats(lk, tune_online.size_bin(nb),
+                                  tuple({_UNDERLYING[m] for m in est}))
+    adjusted = []
+    for m in list(est):
+        st = stats.get(_UNDERLYING[m])
+        if st is None or not st[2] or st[0] <= 0 or st[1] <= 0:
+            continue  # never observed / not drift-proven
+        pred = tune_model.predicted_seconds(_UNDERLYING[m], nb, nb, True,
+                                            colocated)
+        if 0.0 < pred < math.inf and est[m] < math.inf:
+            est[m] = est[m] * tune_model.blend(pred, st[1], st[0]) / pred
+            adjusted.append(m)
+    return adjusted
+
+
+def _choose_method(comm: Communicator, sched: Schedule, sc: np.ndarray,
+                   remote: np.ndarray, links, forced: Optional[str]) -> str:
+    """One method for the compiled schedule, with the established
+    precedence: env-forced > open breaker > tune > swept model."""
+    if forced is not None:
+        if obstrace.ENABLED:
+            obstrace.emit("coll.choice", method=forced, forced=True)
+        return forced
+    est = _method_estimates(comm, sched, sc)
+    tuned = _tune_overlay(comm, sc, remote, est) \
+        if tune_online.ADAPTING else []
+    quarantined = []
+    if health.TRIPPED:
+        for m in list(est):
+            us = _UNDERLYING[m]
+            if any(health.state(lk, us) == health.OPEN for lk in links):
+                quarantined.append(m)
+    eligible = {m: t for m, t in est.items() if m not in quarantined}
+    finite = {m: t for m, t in eligible.items() if t < math.inf}
+    if finite:
+        choice = min(finite, key=finite.get)
+    elif "device_fused" in eligible:
+        # unmeasured system: the TPU-first default, same as one-shot AUTO
+        choice = "device_fused"
+    elif eligible:
+        choice = next(iter(eligible))
+    else:
+        # every transport quarantined: ride the conservative host path —
+        # its half-open probes are what eventually close a breaker again
+        choice = "isir_staged"
+    if obstrace.ENABLED:
+        obstrace.emit("coll.choice", method=choice, forced=False,
+                      estimates={m: (t if t < math.inf else None)
+                                 for m, t in est.items()},
+                      tuned=tuned, quarantined=quarantined)
+    return choice
+
+
+# -- lowerings ---------------------------------------------------------------
+
+
+class _FusedLowering:
+    """``device_fused``: the one-shot engine's device path, whose compiled
+    XLA program (ragged or masked-fused) is cached per table signature —
+    the first round compiles, every later start is dispatch only."""
+
+    num_rounds = 1
+
+    def __init__(self, comm, sendbuf, recvbuf, sc, sd, rd):
+        self.comm, self.sendbuf, self.recvbuf = comm, sendbuf, recvbuf
+        self.sc, self.sd, self.rd = sc, sd, rd
+        self._stats = (int(np.count_nonzero(sc)), int(sc.sum()))
+
+    def run_round(self, ri: int) -> None:
+        from ..parallel import alltoallv as a2a
+        with self.comm._progress_lock:
+            if not a2a._device_ragged(self.comm, self.sendbuf, self.sc,
+                                      self.sd, self.recvbuf, self.rd):
+                a2a._device_fused(self.comm, self.sendbuf, self.sc, self.sd,
+                                  self.recvbuf, self.rd)
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._stats
+
+    def poll(self) -> bool:
+        return p2p._buf_ready(self.recvbuf)
+
+    def finish(self) -> None:
+        p2p._sync_bufs([self.recvbuf], deadline=p2p._deadline())
+
+    def abort(self) -> None:
+        pass  # dispatch is synchronous; nothing stays in flight
+
+
+class _StagedLowering:
+    """``staged``: bulk D2H -> host permute -> H2D, with the byte-gather
+    index arrays the one-shot path derives per call precomputed once at
+    compile time (the compile-once win of the host path)."""
+
+    num_rounds = 1
+
+    def __init__(self, comm, sendbuf, recvbuf, sc, sd, rd):
+        from ..parallel.alltoallv import _STAGED_GATHER_BYTES, _lib_perm
+        self.comm, self.sendbuf, self.recvbuf = comm, sendbuf, recvbuf
+        ar, pr = np.nonzero(sc)
+        self._stats = (int(ar.size), int(sc.sum()))
+        self._segments = None
+        self._flats = None
+        if ar.size:
+            lib = _lib_perm(comm)
+            n = sc[ar, pr].astype(np.int64)
+            if int(n.sum()) <= _STAGED_GATHER_BYTES:
+                seg = (np.arange(int(n.sum()), dtype=np.int64)
+                       - np.repeat(np.cumsum(n) - n, n))
+                # row stride of the (size, nbytes) sharded arrays — taken
+                # from the concrete device shape, stable across starts
+                srow = int(sendbuf.data.shape[1])
+                rrow = int(recvbuf.data.shape[1])
+                src_flat = np.repeat(lib[ar] * srow
+                                     + sd[ar, pr].astype(np.int64), n) + seg
+                dst_flat = np.repeat(lib[pr] * rrow
+                                     + rd[pr, ar].astype(np.int64), n) + seg
+                self._flats = (src_flat, dst_flat)
+            else:
+                self._segments = [(int(lib[a]), int(lib[p]), int(sd[a, p]),
+                                   int(rd[p, a]), int(nn))
+                                  for a, p, nn in zip(ar, pr, n)]
+
+    def run_round(self, ri: int) -> None:
+        import jax
+        comm = self.comm
+        with comm._progress_lock:
+            host_s = np.ascontiguousarray(np.asarray(self.sendbuf.data))
+            host_r = np.array(self.recvbuf.data, copy=True, order="C")
+            if self._flats is not None:
+                src_flat, dst_flat = self._flats
+                host_r.reshape(-1)[dst_flat] = host_s.reshape(-1)[src_flat]
+            elif self._segments is not None:
+                for la, lp, so, ro, nn in self._segments:
+                    host_r[lp, ro: ro + nn] = host_s[la, so: so + nn]
+            self.recvbuf.data = jax.device_put(host_r, comm.sharding())
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._stats
+
+    def poll(self) -> bool:
+        return p2p._buf_ready(self.recvbuf)
+
+    def finish(self) -> None:
+        p2p._sync_bufs([self.recvbuf], deadline=p2p._deadline())
+
+    def abort(self) -> None:
+        pass
+
+
+class _IsirLowering:
+    """isir methods: each schedule round is one (or two, for
+    ``isir_remote_staged``) persistent p2p batches at the reserved
+    collective tag. The first start of each batch pays match + plan
+    compile and caches a ``_PersistentBatch``; later starts replay the
+    compiled exchange plans directly (p2p.startall's replay path)."""
+
+    def __init__(self, comm, sendbuf, recvbuf, sched: Schedule, mode: str):
+        self.comm = comm
+        self.bufs = [b for b in (recvbuf, sendbuf) if b is not None]
+        self.round_batches: List[List[Tuple[list, str]]] = []
+        self._round_stats: List[Tuple[int, int]] = []
+        for rnd in sched.rounds:
+            if mode == "remote_staged":
+                groups = [([m for m in rnd if m.remote], "staged"),
+                          ([m for m in rnd if not m.remote], "device")]
+            else:
+                groups = [(list(rnd), mode)]
+            batches = []
+            for msgs, strat in groups:
+                if not msgs:
+                    continue
+                preqs = []
+                for m in msgs:
+                    preqs.append(p2p.PersistentRequest(
+                        "send", comm, m.src, sendbuf, m.dst, dtypes.BYTE,
+                        m.nbytes, tags.COLL_SCHEDULE, m.soffset,
+                        internal=True))
+                    preqs.append(p2p.PersistentRequest(
+                        "recv", comm, m.dst, recvbuf, m.src, dtypes.BYTE,
+                        m.nbytes, tags.COLL_SCHEDULE, m.roffset,
+                        internal=True))
+                batches.append((preqs, strat))
+            self.round_batches.append(batches)
+            self._round_stats.append((len(rnd), sum(m.nbytes for m in rnd)))
+        self.num_rounds = len(self.round_batches)
+
+    def run_round(self, ri: int) -> None:
+        for preqs, strat in self.round_batches[ri]:
+            if preqs and preqs[0].active is not None:
+                # an earlier attempt of this round already started this
+                # batch; the retry must not double-start it
+                continue
+            p2p.startall(preqs, strat)
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._round_stats[ri]
+
+    def _all_preqs(self) -> list:
+        return [p for batches in self.round_batches
+                for preqs, _ in batches for p in preqs]
+
+    def poll(self) -> bool:
+        acts = [p.active for p in self._all_preqs()]
+        if any(a is None or (not a.done and a.error is None) for a in acts):
+            return False
+        return all(p2p._buf_ready(b) for b in self.bufs)
+
+    def finish(self) -> None:
+        preqs = self._all_preqs()
+        if preqs:
+            p2p.waitall_persistent(preqs)
+
+    def abort(self) -> None:
+        """A failed start leaves earlier rounds applied (disjoint regions;
+        a restart re-delivers identical bytes) — but the in-flight
+        instances must be completed/withdrawn so the collective returns to
+        the restartable state."""
+        started = [p for p in self._all_preqs() if p.active is not None]
+        if started:
+            try:
+                p2p.waitall_persistent(started)
+            except Exception:
+                pass  # waitall's own failure paths restore restartability
+
+
+# -- the persistent collective handle ----------------------------------------
+
+
+class PersistentColl:
+    """A compiled, replayable alltoallv (MPI_Alltoallv_init analog).
+
+    ``start()`` dispatches the compiled schedule (nonblocking in the
+    single-controller sense: device work may still be in flight);
+    ``wait()`` completes the active instance and returns the handle to the
+    startable state; ``test()`` is the nonblocking completion query;
+    ``free()`` releases the compiled state (MPI_Request_free analog —
+    refused while active).
+
+    The compiled plan replays byte-for-byte until the health registry
+    opens a breaker for its transport on one of the schedule's links —
+    then the next ``start()`` RECOMPILES (re-choosing the method against
+    the current breaker/tune state) instead of replaying a quarantined
+    plan. Env-forced methods are never overridden, mirroring the p2p
+    chooser's contract."""
+
+    def __init__(self, comm: Communicator, sendbuf: DistBuffer,
+                 recvbuf: DistBuffer, sc: np.ndarray, sd: np.ndarray,
+                 rd: np.ndarray, method: Optional[AlltoallvMethod] = None):
+        self.comm = comm
+        self.sendbuf, self.recvbuf = sendbuf, recvbuf
+        self.sc, self.sd, self.rd = sc, sd, rd
+        m = method or envmod.env.alltoallv
+        self._forced = _FORCED_BY_ENUM.get(m)  # None = model-driven
+        self._chunk = envmod.env.coll_chunk_bytes
+        lib = [comm.library_rank(a) for a in range(comm.size)]
+        self._remote = np.zeros_like(sc, dtype=bool)
+        for a, p in zip(*np.nonzero(sc)):
+            self._remote[a, p] = not comm.is_colocated(lib[int(a)],
+                                                       lib[int(p)])
+        self.links = {health.link(lib[int(a)], lib[int(p)])
+                      for a, p in zip(*np.nonzero(sc))}
+        # the schedule is pure (matrix, topology, chunk) -> rounds: cached
+        # per communicator so N identical alltoallv_init calls compile one
+        # schedule (the plan cache's hit/miss counters are the evidence)
+        key = ("coll-sched", self._chunk, sc.tobytes(), sd.tobytes(),
+               rd.tobytes())
+        with comm._progress_lock:
+            sched = planmod.cache_get(comm, key)
+            if not isinstance(sched, Schedule):
+                sched = compile_schedule(sc, sd, rd, self._remote,
+                                         self._chunk)
+                planmod.cache_put(comm, key, sched)
+        self.schedule: Schedule = sched
+        self.method: str = ""
+        self._lowering = None
+        self._active = False
+        self._started = False
+        self._freed = False
+        self._compile()
+
+    # -- compile / recompile --------------------------------------------------
+
+    def _compile(self, recompile: bool = False) -> None:
+        method = _choose_method(self.comm, self.schedule, self.sc,
+                                self._remote, self.links, self._forced)
+        if recompile and method == self.method:
+            # no healthier alternative exists (e.g. every transport's
+            # breaker open): keep replaying the compiled plan rather than
+            # rebuilding an identical one on every start
+            return
+        self.method = method
+        self._lowering = self._build_lowering(method)
+        ctr.counters.coll.num_compiles += 1
+        if recompile:
+            ctr.counters.coll.num_recompiles += 1
+            log.info(f"persistent collective recompiled onto "
+                     f"{self.method!r} (breaker opened on a scheduled "
+                     "link)")
+
+    def _build_lowering(self, method: str):
+        addressable = all(
+            getattr(b.data, "is_fully_addressable", True)
+            for b in (self.sendbuf, self.recvbuf))
+        if method == "staged" and not addressable:
+            # the bulk host permute needs every shard; multi-controller
+            # worlds take the device path (same rationale as the one-shot
+            # _staged degrade)
+            log.debug("persistent staged alltoallv on a partially-"
+                      "addressable buffer: lowering to device_fused")
+            method = "device_fused"
+        if method == "device_fused":
+            return _FusedLowering(self.comm, self.sendbuf, self.recvbuf,
+                                  self.sc, self.sd, self.rd)
+        if method == "staged":
+            return _StagedLowering(self.comm, self.sendbuf, self.recvbuf,
+                                   self.sc, self.sd, self.rd)
+        mode = {"isir_remote_first": "device", "isir_staged": "staged",
+                "isir_remote_staged": "remote_staged"}[method]
+        return _IsirLowering(self.comm, self.sendbuf, self.recvbuf,
+                             self.schedule, mode)
+
+    def _needs_recompile(self) -> bool:
+        """True when the compiled plan's transport has been quarantined on
+        one of the schedule's links — replaying it would ride exactly the
+        path the breaker took out of AUTO rotation. Env-forced methods
+        never recompile (explicit configuration is never overridden)."""
+        if self._forced is not None or not health.TRIPPED:
+            return False
+        us = _UNDERLYING[self.method]
+        return any(health.state(lk, us) == health.OPEN for lk in self.links)
+
+    # -- MPI persistent-request surface ---------------------------------------
+
+    def start(self) -> None:
+        """Dispatch the compiled schedule (MPI_Start analog). Each round is
+        a ``coll.round`` fault site and obs span; a faulted round retries
+        under TEMPI_RETRY_ATTEMPTS (re-dispatch is idempotent — rounds
+        write disjoint regions). On failure the handle returns to the
+        inactive, restartable state; delivered rounds stay applied and a
+        restart re-delivers identical bytes."""
+        if self._freed:
+            raise RuntimeError("start() on a freed persistent collective")
+        if self._active:
+            raise RuntimeError("start() on an already-active persistent "
+                               "collective (MPI: operation error)")
+        if self._needs_recompile():
+            self._compile(recompile=True)
+        if self._started:
+            ctr.counters.coll.num_replays += 1
+        retries = envmod.env.retry_attempts
+        low = self._lowering
+        try:
+            for ri in range(low.num_rounds):
+                t0 = time.monotonic() if obstrace.ENABLED else 0.0
+                attempt = 0
+                while True:
+                    try:
+                        if faults.ENABLED:
+                            # BEFORE the round dispatches: a raise never
+                            # leaves a round half-applied
+                            faults.check("coll.round")
+                        low.run_round(ri)
+                        break
+                    except Exception:
+                        if attempt >= retries:
+                            raise
+                        attempt += 1
+                        delay = envmod.env.retry_backoff_s \
+                            * (2 ** (attempt - 1))
+                        if delay > 0:
+                            time.sleep(delay)
+                ctr.counters.coll.num_rounds += 1
+                if obstrace.ENABLED:
+                    msgs, nbytes = low.round_stats(ri)
+                    obstrace.emit_span("coll.round", t0, round=ri,
+                                       msgs=msgs, nbytes=nbytes,
+                                       method=self.method,
+                                       retries=attempt)
+        except BaseException:
+            low.abort()
+            raise
+        self._started = True
+        self._active = True
+
+    def wait(self) -> None:
+        """Complete the active instance (MPI_Wait analog); the handle
+        becomes startable again."""
+        if self._freed:
+            raise RuntimeError("wait() on a freed persistent collective")
+        if not self._active:
+            raise RuntimeError("wait() on an inactive persistent "
+                               "collective")
+        try:
+            self._lowering.finish()
+        finally:
+            self._active = False
+
+    def test(self) -> bool:
+        """Nonblocking completion query (MPI_Test analog): True completes
+        the active instance (the handle becomes startable again); False
+        leaves it active."""
+        if self._freed:
+            raise RuntimeError("test() on a freed persistent collective")
+        if not self._active:
+            raise RuntimeError("test() on an inactive persistent "
+                               "collective")
+        if not self._lowering.poll():
+            return False
+        self.wait()
+        return True
+
+    def free(self) -> None:
+        """Release the compiled state (MPI_Request_free analog). Refused
+        while an instance is active — wait() it first."""
+        if self._active:
+            raise RuntimeError("free() on an active persistent collective "
+                               "(wait() it first)")
+        self._lowering = None
+        self._freed = True
+
+
+# -- init surfaces ------------------------------------------------------------
+
+
+def alltoallv_init(comm: Communicator, sendbuf: DistBuffer, sendcounts,
+                   sdispls, recvbuf: DistBuffer, recvcounts, rdispls,
+                   datatype: Datatype = dtypes.BYTE,
+                   method: Optional[AlltoallvMethod] = None
+                   ) -> PersistentColl:
+    """MPI_Alltoallv_init analog: validate and compile once, replay with
+    ``start()``/``wait()``. Arguments exactly as the one-shot
+    :func:`parallel.alltoallv.alltoallv` (full (size, size) matrices in
+    elements of a dense ``datatype``)."""
+    from ..parallel.alltoallv import _as_matrix, _elem_size
+    es = _elem_size(datatype)
+    sc = _as_matrix(comm, sendcounts) * es
+    rc = _as_matrix(comm, recvcounts) * es
+    sd = _as_matrix(comm, sdispls) * es
+    rd = _as_matrix(comm, rdispls) * es
+    if not np.array_equal(sc, rc.T):
+        raise ValueError("recvcounts must be the transpose of sendcounts")
+    return PersistentColl(comm, sendbuf, recvbuf, sc, sd, rd, method=method)
+
+
+def neighbor_alltoallv_init(comm: Communicator, sendbuf: DistBuffer,
+                            sendcounts, sdispls, recvbuf: DistBuffer,
+                            recvcounts, rdispls,
+                            datatype: Datatype = dtypes.BYTE,
+                            method: Optional[AlltoallvMethod] = None
+                            ) -> PersistentColl:
+    """MPI_Neighbor_alltoallv_init analog: per-rank neighbor-ordered lists
+    over the communicator's dist-graph adjacency, compiled to the same
+    persistent schedule (the dense-matrix pass-through equivalence the
+    one-shot neighbor path uses). Graphs with duplicate neighbors are not
+    matrix-expressible and are refused."""
+    from ..parallel.neighbor import _graph, _neighbor_matrices
+    graph = _graph(comm)
+    es = datatype.size
+    assert datatype.size == datatype.extent, \
+        "neighbor_alltoallv_init requires a dense datatype"
+    mats = _neighbor_matrices(comm, graph, sendcounts, sdispls,
+                              recvcounts, rdispls)
+    if mats is None:
+        raise ValueError(
+            "neighbor_alltoallv_init: adjacency lists a neighbor twice — "
+            "not expressible as a counts matrix; use the one-shot "
+            "neighbor_alltoallv")
+    sc, sd, rc, rd = mats
+    if not np.array_equal(sc, rc.T):
+        raise ValueError(
+            "neighbor_alltoallv_init: receive counts do not transpose-"
+            "match the send counts (asymmetric graph edge sizes)")
+    return PersistentColl(comm, sendbuf, recvbuf, sc * es, sd * es, rd * es,
+                          method=method)

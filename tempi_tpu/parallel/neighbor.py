@@ -33,6 +33,55 @@ def _graph(comm: Communicator):
     return comm.graph
 
 
+def _match_edges(comm: Communicator, graph, sendcounts, sendtypes,
+                 recvcounts, recvtypes) -> list:
+    """Validate the FULL send/recv edge matching BEFORE any state is built
+    and return the matched pairing: ``[(src_ar, src_j, dst_ar, dst_j)]``
+    — every nonzero send edge paired with its nonzero receive edge of the
+    same byte size (FIFO per pair, neighbor order), no receive edge left
+    over. The old code raised these errors mid-build, after datatypes had
+    been committed and partial message state assembled; a bad graph must
+    fail before any message is committed. The returned pairing is the ONE
+    source of truth the message build consumes — validation and build can
+    never desynchronize."""
+    send_q: dict = {}
+    for ar in range(comm.size):
+        _, dsts = graph[ar]
+        for j, dst in enumerate(dsts):
+            if int(sendcounts[ar][j]):
+                send_q.setdefault((ar, dst), []).append((ar, j))
+    recv_q: dict = {}
+    for ar in range(comm.size):
+        srcs, _ = graph[ar]
+        for j, src in enumerate(srcs):
+            if int(recvcounts[ar][j]):
+                recv_q.setdefault((src, ar), []).append((ar, j))
+    pairs = []
+    for key, sends in send_q.items():
+        recvs = recv_q.get(key, [])
+        for i, (sar, sj) in enumerate(sends):
+            if i >= len(recvs):
+                raise ValueError(
+                    f"neighbor_alltoallw: send {key[0]}->{key[1]} has no "
+                    "matching receive edge (asymmetric graph?)")
+            rar, rj = recvs[i]
+            snb = int(sendcounts[sar][sj]) * sendtypes[sar][sj].size
+            rnb = int(recvcounts[rar][rj]) * recvtypes[rar][rj].size
+            if snb != rnb:
+                raise ValueError(
+                    f"neighbor_alltoallw: size mismatch on edge "
+                    f"{(comm.library_rank(key[0]), comm.library_rank(key[1]))}"
+                    f": {snb} vs {rnb}")
+            pairs.append((sar, sj, rar, rj))
+    leftover = sum(max(0, len(recv_q[k]) - len(send_q.get(k, [])))
+                   for k in recv_q)
+    if leftover:
+        raise ValueError(
+            f"neighbor_alltoallw: {leftover} receive edge(s) with no matching "
+            "send")
+    return pairs
+
+
 def neighbor_alltoallw(comm: Communicator, sendbuf: DistBuffer,
                        sendcounts, sdispls, sendtypes,
                        recvbuf: DistBuffer, recvcounts, rdispls, recvtypes,
@@ -42,56 +91,25 @@ def neighbor_alltoallw(comm: Communicator, sendbuf: DistBuffer,
     neighbor at the reserved tag). ``strategy=None`` asks the measured
     model, like the Isend/Irecv fan-out the reference lowers to."""
     graph = _graph(comm)
-    msgs = []
-    for ar in range(comm.size):
-        srcs, dsts = graph[ar]
-        for j, dst in enumerate(dsts):
-            ty: Datatype = sendtypes[ar][j]
-            n = int(sendcounts[ar][j])
-            if n == 0:
-                continue
-            packer = type_cache.get_or_commit(ty).best_packer()
-            msgs.append(dict(
-                src=comm.library_rank(ar), dst=comm.library_rank(dst),
-                nbytes=n * ty.size, sbuf=sendbuf, spacker=packer, scount=n,
-                soffset=int(sdispls[ar][j])))
-    # matching recvs, in neighbor order per rank (FIFO per pair)
-    recv_q = {}
-    for ar in range(comm.size):
-        srcs, dsts = graph[ar]
-        for j, src in enumerate(srcs):
-            ty = recvtypes[ar][j]
-            n = int(recvcounts[ar][j])
-            if n == 0:
-                continue
-            packer = type_cache.get_or_commit(ty).best_packer()
-            key = (comm.library_rank(src), comm.library_rank(ar))
-            recv_q.setdefault(key, []).append(
-                dict(rbuf=recvbuf, rpacker=packer, rcount=n,
-                     roffset=int(rdispls[ar][j]), nbytes=n * ty.size))
+    # full edge matching validated up front (ISSUE 5 satellite): a bad
+    # graph fails here, before any datatype commit or message build; the
+    # pairing it returns is what the build below lowers, pair by pair
+    pairs = _match_edges(comm, graph, sendcounts, sendtypes,
+                         recvcounts, recvtypes)
     out = []
-    for s in msgs:
-        key = (s["src"], s["dst"])
-        q = recv_q.get(key)
-        if not q:
-            raise ValueError(
-                f"neighbor_alltoallw: send {key[0]}->{key[1]} has no matching "
-                "receive edge (asymmetric graph?)")
-        r = q.pop(0)
-        if r["nbytes"] != s["nbytes"]:
-            raise ValueError(
-                f"neighbor_alltoallw: size mismatch on edge {key}: "
-                f"{s['nbytes']} vs {r['nbytes']}")
+    for sar, sj, rar, rj in pairs:
+        sty: Datatype = sendtypes[sar][sj]
+        rty: Datatype = recvtypes[rar][rj]
+        n_s = int(sendcounts[sar][sj])
+        dst = graph[sar][1][sj]
         out.append(Message(
-            src=s["src"], dst=s["dst"], tag=tags.NEIGHBOR_ALLTOALLW,
-            nbytes=s["nbytes"], sbuf=s["sbuf"], spacker=s["spacker"],
-            scount=s["scount"], soffset=s["soffset"], rbuf=r["rbuf"],
-            rpacker=r["rpacker"], rcount=r["rcount"], roffset=r["roffset"]))
-    leftover = sum(len(q) for q in recv_q.values())
-    if leftover:
-        raise ValueError(
-            f"neighbor_alltoallw: {leftover} receive edge(s) with no matching "
-            "send")
+            src=comm.library_rank(sar), dst=comm.library_rank(dst),
+            tag=tags.NEIGHBOR_ALLTOALLW, nbytes=n_s * sty.size,
+            sbuf=sendbuf,
+            spacker=type_cache.get_or_commit(sty).best_packer(),
+            scount=n_s, soffset=int(sdispls[sar][sj]), rbuf=recvbuf,
+            rpacker=type_cache.get_or_commit(rty).best_packer(),
+            rcount=int(recvcounts[rar][rj]), roffset=int(rdispls[rar][rj])))
     if out:
         if strategy is None:
             from .p2p import choose_strategy

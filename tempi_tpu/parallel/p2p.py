@@ -197,10 +197,15 @@ def _packer_for(datatype: Datatype):
 
 def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
           peer_app: int, datatype: Datatype, count: int, tag: int,
-          offset: int) -> Request:
+          offset: int, internal: bool = False) -> Request:
     if faults.ENABLED:
         faults.check("p2p.post")  # send/recv launch injection site
-    _check_tag(kind, tag)
+    if not internal:
+        # internal framework traffic (persistent-collective rounds) posts
+        # at RESERVED tags by design — the reservation check applies only
+        # to application posts, like the direct Message construction the
+        # neighbor collectives use
+        _check_tag(kind, tag)
     _check_rank(comm, app_rank, "local", kind)
     _check_rank(comm, peer_app, "peer", kind)
     packer, rec = _packer_for(datatype)
@@ -1088,9 +1093,13 @@ class PersistentRequest:
     offset: int
     active: Optional[Request] = None
     batch: Optional["_PersistentBatch"] = None
+    # framework-owned requests (persistent-collective rounds) may use
+    # reserved internal tags; application send_init/recv_init never set it
+    internal: bool = False
 
     def __post_init__(self) -> None:
-        _check_tag(self.kind, self.tag)
+        if not self.internal:
+            _check_tag(self.kind, self.tag)
         _check_rank(self.comm, self.app_rank, "local", self.kind)
         _check_rank(self.comm, self.peer, "peer", self.kind)
 
@@ -1246,7 +1255,7 @@ def startall(preqs: Sequence[PersistentRequest],
                 for p in preqs:
                     reqs.append(_post(comm, p.kind, p.app_rank, p.buf,
                                       p.peer, p.datatype, p.count, p.tag,
-                                      p.offset))
+                                      p.offset, internal=p.internal))
                 messages, consumed, leftover = _match(comm._pending)
                 if ({id(c.request) for c in consumed}
                         != {id(r) for r in reqs}):
@@ -1295,7 +1304,8 @@ def _start_eager(comm: Communicator, preqs: Sequence[PersistentRequest],
     try:
         for p in preqs:
             reqs.append(_post(comm, p.kind, p.app_rank, p.buf, p.peer,
-                              p.datatype, p.count, p.tag, p.offset))
+                              p.datatype, p.count, p.tag, p.offset,
+                              internal=p.internal))
         for p, r in zip(preqs, reqs):
             p.active = r
         try_progress(comm, strategy)

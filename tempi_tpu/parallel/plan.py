@@ -675,10 +675,16 @@ _PLAN_CACHE_MAX = 128
 
 
 def cache_get(comm: Communicator, key):
-    """LRU-aware read of the communicator's plan/program cache."""
+    """LRU-aware read of the communicator's plan/program cache. Hit/miss
+    counters ride the public snapshot (``api.counters_snapshot()``) so a
+    bench run can show how much compile work the cache amortized (ISSUE 5
+    satellite; benches/_common.report_counters prints nonzero groups)."""
     hit = comm._plan_cache.get(key)
     if hit is not None:
         comm._plan_cache.move_to_end(key)
+        ctr.counters.plan.cache_hit += 1
+    else:
+        ctr.counters.plan.cache_miss += 1
     return hit
 
 
@@ -689,6 +695,7 @@ def cache_put(comm: Communicator, key, value) -> None:
     cache.move_to_end(key)
     while len(cache) > _PLAN_CACHE_MAX:
         _, old = cache.popitem(last=False)
+        ctr.counters.plan.evictions += 1
         release = getattr(old, "release_staging", None)
         if release is not None:  # cache also holds bare jitted fns/markers
             release()

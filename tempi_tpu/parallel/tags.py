@@ -6,3 +6,7 @@ collide with application tags."""
 RESERVED_BASE = 1 << 30
 
 NEIGHBOR_ALLTOALLW = RESERVED_BASE + 1
+# persistent-collective schedule rounds (coll/persistent.py): every round's
+# isend/irecv lowering rides this tag, so replayed collective traffic can
+# never FIFO-match application p2p ops interleaved on the same communicator
+COLL_SCHEDULE = RESERVED_BASE + 2

@@ -90,6 +90,13 @@ SITES = (
                           # observes; delay slows the completing waiter,
                           # the slow-but-alive simulation; wedge is
                           # refused like every non-engine site)
+    "coll.round",         # each persistent-collective schedule round
+                          # (coll/persistent.py — fires BEFORE the
+                          # round dispatches, so a raise never leaves a
+                          # round half-applied; rounds write disjoint
+                          # regions, so the per-round retry loop can
+                          # re-dispatch idempotently; wedge refused —
+                          # the round runs under the progress lock)
 )
 
 KINDS = ("raise", "delay", "wedge")

@@ -76,6 +76,24 @@ class LibCallCounters:
 
 
 @dataclass
+class CollCounters:
+    # persistent-collective schedule compiler (ISSUE 5; coll/persistent.py)
+    num_compiles: int = 0    # schedules compiled (incl. recompiles)
+    num_recompiles: int = 0  # health-driven recompiles (breaker opened)
+    num_replays: int = 0     # start() calls that replayed a compiled plan
+    num_rounds: int = 0      # schedule rounds dispatched
+
+
+@dataclass
+class PlanCacheCounters:
+    # per-communicator plan/program cache (parallel/plan.cache_get/put):
+    # the compile-amortization evidence benches print per run (ISSUE 5)
+    cache_hit: int = 0
+    cache_miss: int = 0
+    evictions: int = 0
+
+
+@dataclass
 class Counters:
     allocator: AllocatorCounters = field(default_factory=AllocatorCounters)
     device: DeviceCounters = field(default_factory=DeviceCounters)
@@ -88,6 +106,8 @@ class Counters:
     isend: P2PCounters = field(default_factory=P2PCounters)
     irecv: P2PCounters = field(default_factory=P2PCounters)
     lib: LibCallCounters = field(default_factory=LibCallCounters)
+    coll: CollCounters = field(default_factory=CollCounters)
+    plan: PlanCacheCounters = field(default_factory=PlanCacheCounters)
 
     def as_dict(self) -> dict:
         out = {}

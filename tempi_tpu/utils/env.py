@@ -95,8 +95,25 @@ tune/model.py and the README "Adaptive tuning" section):
                          healthy strategy to keep its estimator fed
                          (default 0 = never explore)
 
-All resilience, observability, and tuning knobs parse LOUDLY (a typo
-raises at init rather than silently reverting to the
+Persistent-collective knobs (ISSUE 5; see coll/schedule.py,
+coll/persistent.py and the README "Persistent collectives" section):
+  TEMPI_COLL_CHUNK_BYTES  chunk threshold of the collective schedule
+                         compiler: a (src,dst) message larger than this
+                         is split across consecutive rounds so one huge
+                         pair cannot serialize a whole round behind it
+                         (default 4 MiB; 0 disables splitting; negative
+                         rejected loudly)
+  TEMPI_A2AV_SPLIT_OVERHEAD  per-message dispatch overhead, in BYTES of
+                         equivalent wire time, that the skew-split
+                         threshold (`alltoallv._split_threshold`) charges
+                         each p2p tail message it would peel off the
+                         fused collective. Unset = derive from the swept
+                         sheet (device_launch seconds / measured per-byte
+                         wire time) when measured, else the historical
+                         1<<14 guess; negative rejected loudly.
+
+All resilience, observability, tuning, and persistent-collective knobs
+parse LOUDLY (a typo raises at init rather than silently reverting to the
 hang/die/fly-blind/frozen-model behavior the knob exists to prevent).
 """
 
@@ -220,6 +237,13 @@ class Environment:
     tune_drift: float = 0.5        # sustained relative error marking drift
     tune_min_samples: int = 10     # samples before a drift verdict
     tune_explore: float = 0.0      # adapt-mode epsilon exploration in [0,1]
+    # persistent collectives (MPI 4.0 MPI_Alltoallv_init direction; ISSUE
+    # 5) — see coll/schedule.py (round compiler) and coll/persistent.py
+    coll_chunk_bytes: int = 1 << 22   # schedule chunk threshold (0 = off)
+    # per-message dispatch overhead, in byte-equivalents, charged to each
+    # skew-split tail message; -1 = unset (derive from the swept sheet
+    # when measured, else the historical 1<<14 guess)
+    a2av_split_overhead: int = -1
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -375,6 +399,28 @@ class Environment:
             raise ValueError(
                 f"bad TEMPI_TUNE_EXPLORE={e.tune_explore!r}: want a "
                 "probability in [0, 1]")
+
+        # persistent-collective knobs parse loudly too: a typo'd chunk
+        # threshold silently reverting to the default would quietly change
+        # which schedule a production collective compiled
+        e.coll_chunk_bytes = _pos_int_env("TEMPI_COLL_CHUNK_BYTES", 1 << 22)
+        v = getenv("TEMPI_A2AV_SPLIT_OVERHEAD")
+        if v is None or v == "":
+            e.a2av_split_overhead = -1  # unset: derive from the sheet
+        else:
+            try:
+                i = int(v)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad TEMPI_A2AV_SPLIT_OVERHEAD={v!r}: want a "
+                    "non-negative integer (bytes)") from exc
+            if i < 0:
+                # no silent clamp: a negative overhead would make the
+                # split model prefer infinitely many tail messages
+                raise ValueError(
+                    f"bad TEMPI_A2AV_SPLIT_OVERHEAD={v!r}: want a "
+                    "non-negative integer (bytes)")
+            e.a2av_split_overhead = i
 
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every

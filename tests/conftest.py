@@ -31,6 +31,10 @@ def pytest_configure(config):
         "markers",
         "tune: online performance-model adaptation tests (the <30s "
         "smoke is `pytest -m tune`)")
+    config.addinivalue_line(
+        "markers",
+        "coll: persistent-collective schedule tests (the <30s smoke is "
+        "`pytest -m coll`)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,614 @@
+"""Persistent collective schedules (ISSUE 5): the compile-once/run-many
+alltoallv runtime (tempi_tpu/coll/) and its satellites.
+
+Marker ``coll`` is the tier-1-compatible <30s smoke (`pytest -m coll`),
+like the faults/obs/tune markers.
+"""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.coll.schedule import SMsg, Schedule, compile_schedule
+from tempi_tpu.runtime import faults, health
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+from tempi_tpu.utils.env import AlltoallvMethod
+
+pytestmark = pytest.mark.coll
+
+
+# -- schedule compiler (pure; no mesh) ----------------------------------------
+
+
+def _random_mats(size, seed, density=0.4, hi=64, skew=None):
+    rng = np.random.default_rng(seed)
+    sc = rng.integers(1, hi, (size, size)).astype(np.int64)
+    sc[rng.random((size, size)) > density] = 0
+    if skew:
+        s, d, n = skew
+        sc[s, d] = n
+    sd = np.zeros_like(sc)
+    rd = np.zeros_like(sc)
+    for r in range(size):
+        sd[r] = np.concatenate([[0], np.cumsum(sc[r])[:-1]])
+        rd[r] = np.concatenate([[0], np.cumsum(sc.T[r])[:-1]])
+    return sc, sd, rd
+
+
+def _two_node_remote(size):
+    remote = np.zeros((size, size), bool)
+    h = size // 2
+    remote[:h, h:] = True
+    remote[h:, :h] = True
+    return remote
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+@pytest.mark.parametrize("chunk", [0, 37])
+def test_schedule_rounds_are_matchings_and_deliver_exactly(seed, chunk):
+    """Acceptance property: every round is a valid matching (no rank
+    appears twice as sender or as receiver) and the union of rounds
+    delivers exactly the input matrix — counts AND offsets."""
+    size = 8
+    sc, sd, rd = _random_mats(size, seed)
+    sched = compile_schedule(sc, sd, rd, _two_node_remote(size), chunk)
+    sched.check_matchings()
+    assert (sched.delivered_matrix() == sc).all()
+    # offset-exact coverage: each pair's chunks tile [displ, displ+count)
+    # on both sides, in order, without overlap or gap
+    cover = {}
+    for rnd in sched.rounds:
+        for m in rnd:
+            cover.setdefault((m.src, m.dst), []).append(m)
+    for (s, d), parts in cover.items():
+        so, ro = int(sd[s, d]), int(rd[d, s])
+        for p in parts:  # placement preserves per-pair chunk order
+            assert p.soffset == so and p.roffset == ro
+            so += p.nbytes
+            ro += p.nbytes
+        assert so == int(sd[s, d]) + int(sc[s, d])
+
+
+def test_schedule_remote_rounds_first():
+    """The remote_first rule generalized per-round: every round carrying
+    an off-node message precedes every purely-local round."""
+    size = 8
+    sc, sd, rd = _random_mats(size, 3, density=0.6)
+    sched = compile_schedule(sc, sd, rd, _two_node_remote(size), 0)
+    has_remote = [any(m.remote for m in rnd) for rnd in sched.rounds]
+    assert all(has_remote[:sched.remote_rounds])
+    assert not any(has_remote[sched.remote_rounds:])
+    # something actually crossed nodes in this fixture
+    assert sched.remote_rounds > 0
+
+
+def test_schedule_chunk_split_consecutive_rounds():
+    """A message past the chunk threshold splits across strictly
+    increasing rounds in offset order."""
+    size = 4
+    sc = np.zeros((size, size), np.int64)
+    sc[0, 1] = 100
+    sd = np.zeros_like(sc)
+    rd = np.zeros_like(sc)
+    sched = compile_schedule(sc, sd, rd, np.zeros((size, size), bool), 32)
+    chunks = [(ri, m) for ri, rnd in enumerate(sched.rounds)
+              for m in rnd if (m.src, m.dst) == (0, 1)]
+    assert [m.nbytes for _, m in chunks] == [32, 32, 32, 4]
+    rids = [ri for ri, _ in chunks]
+    assert rids == sorted(rids) and len(set(rids)) == len(rids)
+    assert [m.soffset for _, m in chunks] == [0, 32, 64, 96]
+    assert (sched.delivered_matrix() == sc).all()
+
+
+def test_schedule_empty_matrix():
+    size = 4
+    z = np.zeros((size, size), np.int64)
+    sched = compile_schedule(z, z, z, np.zeros((size, size), bool), 0)
+    assert sched.rounds == [] and sched.remote_rounds == 0
+
+
+def test_schedule_deterministic():
+    size = 8
+    sc, sd, rd = _random_mats(size, 11)
+    a = compile_schedule(sc, sd, rd, _two_node_remote(size), 16)
+    b = compile_schedule(sc, sd, rd, _two_node_remote(size), 16)
+    assert a.rounds == b.rounds
+
+
+# -- persistent runtime on the 8-device CPU mesh ------------------------------
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def make_case(comm, seed=0, hi=32, density=0.7, outlier=None):
+    """Random sparse counts + packed buffers + python oracle (the same
+    shape test_collectives uses)."""
+    size = comm.size
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, hi, (size, size))
+    counts[rng.random((size, size)) > density] = 0
+    if outlier:
+        s, d, n = outlier
+        counts[s, d] = n
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    recvcounts = counts.T.copy()
+    for r in range(size):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(recvcounts[r])[:-1]])
+    nb_s = max(1, int(counts.sum(1).max()))
+    nb_r = max(1, int(recvcounts.sum(1).max()))
+    rows = [rng.integers(0, 256, nb_s, np.uint8) for _ in range(size)]
+    sendbuf = comm.buffer_from_host(rows)
+    recvbuf = comm.alloc(nb_r)
+    want = [np.zeros(nb_r, np.uint8) for _ in range(size)]
+    for s in range(size):
+        for d in range(size):
+            n = counts[s, d]
+            if n:
+                want[d][rdispls[d, s]: rdispls[d, s] + n] = \
+                    rows[s][sdispls[s, d]: sdispls[s, d] + n]
+    return counts, sdispls, recvcounts, rdispls, sendbuf, recvbuf, want
+
+
+def _check(comm, recvbuf, want):
+    for r in range(comm.size):
+        np.testing.assert_array_equal(recvbuf.get_rank(r), want[r])
+
+
+def test_compile_once_replay_counters(world):
+    """Acceptance: a repeated identical alltoallv through alltoallv_init
+    compiles its schedule exactly once — the second start() increments
+    num_coll_replays with num_coll_compiles unchanged."""
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=1)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    compiles = ctr.counters.coll.num_compiles
+    replays = ctr.counters.coll.num_replays
+    assert compiles == 1  # the init compiled the schedule
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+    assert ctr.counters.coll.num_compiles == compiles
+    pc.start()  # the second start: replay, no recompile
+    pc.wait()
+    assert ctr.counters.coll.num_compiles == compiles
+    assert ctr.counters.coll.num_replays == replays + 1
+    _check(world, rbuf, want)
+
+
+@pytest.mark.parametrize("method", [
+    None, AlltoallvMethod.STAGED, AlltoallvMethod.REMOTE_FIRST,
+    AlltoallvMethod.ISIR_STAGED, AlltoallvMethod.ISIR_REMOTE_STAGED,
+])
+def test_persistent_matches_oneshot(world, method, monkeypatch):
+    """Byte-identical to the one-shot alltoallv across randomized sparse
+    matrices, for the model-driven choice and every forced method."""
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    envmod.read_environment()
+    seed = 5 if method is None else 10 + list(AlltoallvMethod).index(method)
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=seed)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=method)
+    for _ in range(2):  # first start and a replay both deliver
+        pc.start()
+        pc.wait()
+        _check(world, rbuf, want)
+    # one-shot oracle cross-check (fresh recv buffer, same method)
+    rbuf2 = world.alloc(rbuf.nbytes)
+    api.alltoallv(world, sbuf, counts, sd, rbuf2, rc, rd, method=method)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf2.get_rank(r), rbuf.get_rank(r))
+
+
+def test_persistent_skewed_outlier(world):
+    """The skewed shape (one large pair in a sparse matrix) splits across
+    rounds under a small chunk threshold and still delivers exactly."""
+    envmod.env.coll_chunk_bytes = 64
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(
+        world, seed=4, hi=8, density=0.3, outlier=(1, 6, 300))
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=AlltoallvMethod.REMOTE_FIRST)
+    assert any(len([m for m in rnd if (m.src, m.dst) == (1, 6)]) == 1
+               for rnd in pc.schedule.rounds)
+    assert sum(m.nbytes for rnd in pc.schedule.rounds
+               for m in rnd if (m.src, m.dst) == (1, 6)) == 300
+    assert len(pc.schedule.rounds) >= 300 // 64
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+
+
+def test_persistent_under_coll_round_fault_with_retries(world, monkeypatch):
+    """Acceptance: byte-identical delivery under a coll.round fault with
+    retries armed — the per-round retry loop re-draws the site and
+    re-dispatches idempotently."""
+    monkeypatch.setenv("TEMPI_FAULTS", "coll.round:raise:0.4:7")
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "8")
+    envmod.read_environment()
+    faults.configure()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=6)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=AlltoallvMethod.REMOTE_FIRST)
+    for _ in range(2):
+        pc.start()
+        pc.wait()
+        _check(world, rbuf, want)
+
+
+def test_coll_round_fault_exhaustion_is_restartable(world, monkeypatch):
+    """With retries unarmed a coll.round raise surfaces immediately; the
+    handle returns to the inactive state and a later healthy start
+    delivers the full exchange (rounds are idempotent)."""
+    monkeypatch.setenv("TEMPI_FAULTS", "coll.round:raise:1:3")
+    envmod.read_environment()
+    faults.configure()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=8)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=AlltoallvMethod.ISIR_STAGED)
+    with pytest.raises(faults.InjectedFault):
+        pc.start()
+    faults.reset()  # the chaos clears; the handle must still work
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+
+
+def test_recompile_on_breaker_open(world):
+    """Health-driven demotion inside compiled schedules: a breaker opening
+    for the compiled transport on a scheduled link forces a recompile onto
+    a healthy method — never a stale replay of the quarantined plan."""
+    from tempi_tpu.coll.persistent import _UNDERLYING
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=9)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    us = _UNDERLYING[pc.method]
+    pc.start()
+    pc.wait()
+    lk = next(iter(sorted(pc.links)))
+    for _ in range(envmod.env.breaker_threshold):
+        health.record_failure(lk, us, error="synthetic")
+    assert health.TRIPPED
+    recompiles = ctr.counters.coll.num_recompiles
+    pc.start()
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles == recompiles + 1
+    assert _UNDERLYING[pc.method] != us
+    _check(world, rbuf, want)
+
+
+def test_forced_method_never_recompiled(world):
+    """Env-forced/explicit methods are never overridden by the health
+    overlay (the p2p chooser's contract, held at the collective layer)."""
+    from tempi_tpu.coll.persistent import _UNDERLYING
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=12)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=AlltoallvMethod.REMOTE_FIRST)
+    pc.start()
+    pc.wait()
+    lk = next(iter(sorted(pc.links)))
+    for _ in range(envmod.env.breaker_threshold):
+        health.record_failure(lk, _UNDERLYING[pc.method], error="synthetic")
+    recompiles = ctr.counters.coll.num_recompiles
+    pc.start()
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles == recompiles
+    assert pc.method == "isir_remote_first"
+    _check(world, rbuf, want)
+
+
+def test_none_method_forces_device_path(world, monkeypatch):
+    """TEMPI_NO_ALLTOALLV/TEMPI_DISABLE set alltoallv=NONE — the bail-out
+    ('native all_to_all, no strategy modeling'): the persistent path must
+    force the device lowering like the one-shot dispatcher, never run the
+    chooser, and never recompile off it."""
+    monkeypatch.setenv("TEMPI_NO_ALLTOALLV", "1")
+    envmod.read_environment()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=20)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    assert pc.method == "device_fused"
+    lk = next(iter(sorted(pc.links)))
+    for _ in range(envmod.env.breaker_threshold):
+        health.record_failure(lk, "device", error="synthetic")
+    recompiles = ctr.counters.coll.num_recompiles
+    pc.start()
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles == recompiles  # forced: stays
+    assert pc.method == "device_fused"
+    _check(world, rbuf, want)
+
+
+def test_all_transports_quarantined_replays_not_recompile_loop(world):
+    """When EVERY transport's breaker is open, re-choosing cannot improve
+    the plan: the conservative fallback keeps REPLAYING its compiled
+    batches instead of rebuilding an identical lowering on every start."""
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=21)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    for lk in pc.links:
+        for us in ("device", "staged"):
+            for _ in range(envmod.env.breaker_threshold):
+                health.record_failure(lk, us, error="synthetic")
+    assert health.TRIPPED
+    recompiles = ctr.counters.coll.num_recompiles
+    pc.start()  # first degraded start may recompile onto the fallback...
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles <= recompiles + 1
+    recompiles = ctr.counters.coll.num_recompiles
+    replays = ctr.counters.coll.num_replays
+    pc.start()  # ...but later starts replay, not rebuild
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles == recompiles
+    assert ctr.counters.coll.num_replays == replays + 1
+    _check(world, rbuf, want)
+
+
+def test_state_machine_errors(world):
+    counts, sd, rc, rd, sbuf, rbuf, _ = make_case(world, seed=13)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    with pytest.raises(RuntimeError, match="inactive"):
+        pc.wait()
+    pc.start()
+    with pytest.raises(RuntimeError, match="already-active"):
+        pc.start()
+    with pytest.raises(RuntimeError, match="active"):
+        pc.free()
+    while not pc.test():
+        pass
+    with pytest.raises(RuntimeError, match="inactive"):
+        pc.wait()
+    pc.free()
+    with pytest.raises(RuntimeError, match="freed"):
+        pc.start()
+
+
+def test_neighbor_alltoallv_init_ring(world):
+    size = world.size
+    g = api.dist_graph_create_adjacent(
+        world,
+        [[(r - 1) % size] for r in range(size)],
+        [[(r + 1) % size] for r in range(size)], reorder=False)
+    scn = [[4] for _ in range(size)]
+    disp = [[0] for _ in range(size)]
+    sb = g.buffer_from_host([np.full(4, r + 1, np.uint8)
+                             for r in range(size)])
+    rb = g.alloc(4)
+    pn = api.neighbor_alltoallv_init(g, sb, scn, disp, rb, scn, disp)
+    for _ in range(2):
+        pn.start()
+        pn.wait()
+        for r in range(size):
+            np.testing.assert_array_equal(
+                rb.get_rank(r), np.full(4, (r - 1) % size + 1, np.uint8))
+
+
+def test_neighbor_init_duplicate_neighbor_refused(world):
+    size = world.size
+    g = api.dist_graph_create_adjacent(
+        world,
+        [[1, 1]] + [[0, 0]] + [[] for _ in range(size - 2)],
+        [[1, 1]] + [[0, 0]] + [[] for _ in range(size - 2)], reorder=False)
+    sb = g.alloc(8)
+    rb = g.alloc(8)
+    scn = [[2, 2]] * 2 + [[] for _ in range(size - 2)]
+    disp = [[0, 4]] * 2 + [[] for _ in range(size - 2)]
+    with pytest.raises(ValueError, match="twice"):
+        api.neighbor_alltoallv_init(g, sb, scn, disp, rb, scn, disp)
+
+
+def test_coll_choice_trace_event(world, monkeypatch):
+    """Model-driven AUTO emits a coll.choice event carrying the
+    per-method estimates (tentpole item 3's observability hook)."""
+    from tempi_tpu.obs import trace as obstrace
+    obstrace.configure("flight")
+    counts, sd, rc, rd, sbuf, rbuf, _ = make_case(world, seed=14)
+    api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    evs = [e for e in obstrace.snapshot() if e["name"] == "coll.choice"]
+    assert evs and evs[-1]["forced"] is False
+    assert set(evs[-1]["estimates"]) == {
+        "device_fused", "staged", "isir_remote_first", "isir_staged"}
+    obstrace.configure("off")
+
+
+def test_coll_round_trace_spans(world):
+    from tempi_tpu.obs import trace as obstrace
+    obstrace.configure("flight")
+    counts, sd, rc, rd, sbuf, rbuf, _ = make_case(world, seed=15)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd,
+                            method=AlltoallvMethod.ISIR_STAGED)
+    pc.start()
+    pc.wait()
+    spans = [e for e in obstrace.snapshot() if e["name"] == "coll.round"]
+    assert len(spans) == len(pc.schedule.rounds)
+    assert all(s["method"] == "isir_staged" for s in spans)
+    obstrace.configure("off")
+
+
+def test_plan_cache_counters_exposed(world):
+    """ISSUE 5 satellite: plan-cache hit/miss counters ride the public
+    counters snapshot; a second identical alltoallv_init hits the cached
+    schedule instead of recompiling it."""
+    counts, sd, rc, rd, sbuf, rbuf, _ = make_case(world, seed=16)
+    snap0 = api.counters_snapshot()
+    pc1 = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    snap1 = api.counters_snapshot()
+    assert snap1["plan"]["cache_miss"] > snap0["plan"]["cache_miss"]
+    pc2 = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    snap2 = api.counters_snapshot()
+    assert snap2["plan"]["cache_hit"] > snap1["plan"]["cache_hit"]
+    assert pc2.schedule is pc1.schedule  # one compiled schedule serves both
+
+
+def test_oneshot_paths_untouched_by_init(world):
+    """One-shot alltoallv(method=...) must remain byte-for-byte unchanged
+    when the persistent API is unused: no coll counters move."""
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=17)
+    api.alltoallv(world, sbuf, counts, sd, rbuf, rc, rd,
+                  method=AlltoallvMethod.STAGED)
+    _check(world, rbuf, want)
+    assert ctr.counters.coll.num_compiles == 0
+    assert ctr.counters.coll.num_replays == 0
+
+
+# -- satellite: _split_threshold edge cases -----------------------------------
+
+
+def _brute_threshold_cost(sc, size, oh):
+    flat = sc[sc > 0].ravel()
+    best = None
+    for T in np.unique(flat):
+        cost = (size * size * int(T)
+                + int(np.maximum(flat - T, 0).sum())
+                + oh * int((flat > T).sum()))
+        best = cost if best is None else min(best, cost)
+    return best
+
+
+def _threshold_cost(sc, size, oh, T):
+    flat = sc[sc > 0].ravel()
+    return (size * size * int(T) + int(np.maximum(flat - T, 0).sum())
+            + oh * int((flat > T).sum()))
+
+
+def test_split_threshold_all_zero():
+    from tempi_tpu.parallel.alltoallv import _split_threshold
+    assert _split_threshold(np.zeros((8, 8), np.int64), 8, 1 << 14) == 0
+
+
+def test_split_threshold_uniform_keeps_fast_path():
+    from tempi_tpu.parallel.alltoallv import _split_threshold
+    sc = np.full((8, 8), 1024, np.int64)
+    assert _split_threshold(sc, 8, 1 << 14) == 1024  # T == max: no split
+
+
+def test_split_threshold_outlier_splits():
+    from tempi_tpu.parallel.alltoallv import _split_threshold
+    rng = np.random.default_rng(0)
+    size = 32
+    sc = rng.integers(0, 256, (size, size)).astype(np.int64)
+    sc[rng.random((size, size)) < 0.8] = 0
+    sc[3, 7] = 4 << 20  # a single 4 MiB outlier
+    T = _split_threshold(sc, size, 1 << 14)
+    assert T < 4 << 20  # the outlier is split off the fused collective
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("oh", [0, 1 << 10, 1 << 14])
+def test_split_threshold_matches_bruteforce(seed, oh):
+    """The vectorized argmin picks a T whose cost equals the brute-force
+    minimum over all candidate thresholds."""
+    from tempi_tpu.parallel.alltoallv import _split_threshold
+    rng = np.random.default_rng(seed)
+    size = 16
+    sc = rng.integers(0, 1 << 16, (size, size)).astype(np.int64)
+    sc[rng.random((size, size)) < 0.6] = 0
+    T = _split_threshold(sc, size, oh)
+    assert _threshold_cost(sc, size, oh, T) == \
+        _brute_threshold_cost(sc, size, oh)
+
+
+def test_split_overhead_knob_and_sheet_default(monkeypatch):
+    """TEMPI_A2AV_SPLIT_OVERHEAD wins outright; unset, the measured
+    sheet's device_launch converts through the measured per-byte wire
+    time; neither -> the historical 1<<14."""
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.parallel import alltoallv as a2a
+
+    monkeypatch.setenv("TEMPI_A2AV_SPLIT_OVERHEAD", "4096")
+    envmod.read_environment()
+    assert a2a._split_overhead_bytes() == 4096
+    monkeypatch.delenv("TEMPI_A2AV_SPLIT_OVERHEAD")
+    envmod.read_environment()
+
+    prior = msys.get()
+    try:
+        sp = msys.SystemPerformance()
+        sp.device_launch = 1e-4
+        # knots at the derivation's own query points (64 KiB / 4 MiB) so
+        # the log-space interpolation is exact: 1 ns/byte wire time ->
+        # overhead = 1e-4 / 1e-9 = 100 KB
+        sp.intra_node_pingpong = [(1 << 16, 1e-6 + (1 << 16) * 1e-9),
+                                  (1 << 22, 1e-6 + (1 << 22) * 1e-9)]
+        msys.set_system(sp)
+        got = a2a._split_overhead_bytes()
+        assert got == pytest.approx(100_000, rel=0.05)
+        # unmeasured sheet -> the historical guess
+        msys.set_system(msys.SystemPerformance())
+        assert a2a._split_overhead_bytes() == 1 << 14
+    finally:
+        msys.set_system(prior)
+
+
+def test_coll_knobs_parse_loudly(monkeypatch):
+    for name, bad in (("TEMPI_A2AV_SPLIT_OVERHEAD", "-5"),
+                      ("TEMPI_A2AV_SPLIT_OVERHEAD", "abc"),
+                      ("TEMPI_COLL_CHUNK_BYTES", "-1"),
+                      ("TEMPI_COLL_CHUNK_BYTES", "big")):
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ValueError, match="non-negative"):
+            envmod.read_environment()
+        monkeypatch.delenv(name)
+    monkeypatch.setenv("TEMPI_COLL_CHUNK_BYTES", "65536")
+    envmod.read_environment()
+    assert envmod.env.coll_chunk_bytes == 65536
+    monkeypatch.delenv("TEMPI_COLL_CHUNK_BYTES")
+    envmod.read_environment()
+    assert envmod.env.coll_chunk_bytes == 1 << 22
+    assert envmod.env.a2av_split_overhead == -1  # unset sentinel
+
+
+# -- satellite: neighbor_alltoallw fails fast on a bad graph ------------------
+
+
+def test_neighbor_alltoallw_asymmetric_graph_fails_before_any_commit(world):
+    """The full edge matching is validated up front: a bad graph raises
+    BEFORE any message is committed — no pending ops, no dispatch."""
+    from tempi_tpu.ops import dtypes as dt
+    size = world.size
+    # rank 0 sends to 1, but rank 1 does NOT list 0 as a source — and the
+    # matching edges 2<->3 come FIRST, so the old mid-build raise would
+    # have already committed state for them
+    sources = [[], [], [3], [2]] + [[] for _ in range(size - 4)]
+    dests = [[1], [], [3], [2]] + [[] for _ in range(size - 4)]
+    g = api.dist_graph_create_adjacent(world, sources, dests, reorder=False)
+    sb = g.alloc(8)
+    rb = g.alloc(8)
+    scounts = [[8], [], [8], [8]] + [[] for _ in range(size - 4)]
+    sdisp = [[0], [], [0], [0]] + [[] for _ in range(size - 4)]
+    stypes = [[dt.BYTE], [], [dt.BYTE], [dt.BYTE]] \
+        + [[] for _ in range(size - 4)]
+    rcounts = [[], [], [8], [8]] + [[] for _ in range(size - 4)]
+    rdisp = [[], [], [0], [0]] + [[] for _ in range(size - 4)]
+    rtypes = [[], [], [dt.BYTE], [dt.BYTE]] + [[] for _ in range(size - 4)]
+    lib0 = ctr.counters.lib.num_calls
+    with pytest.raises(ValueError, match="no matching"):
+        api.neighbor_alltoallw(g, sb, scounts, sdisp, stypes,
+                               rb, rcounts, rdisp, rtypes)
+    assert ctr.counters.lib.num_calls == lib0  # nothing dispatched
+    assert not g._pending  # nothing posted
+    with g._progress_lock:
+        pass  # lock healthy (no half-built state holding it)
+
+
+def test_neighbor_alltoallw_leftover_recv_fails_fast(world):
+    from tempi_tpu.ops import dtypes as dt
+    size = world.size
+    # rank 1 expects from 0, but 0 sends nothing
+    sources = [[], [0]] + [[] for _ in range(size - 2)]
+    dests = [[], []] + [[] for _ in range(size - 2)]
+    g = api.dist_graph_create_adjacent(world, sources, dests, reorder=False)
+    sb = g.alloc(8)
+    rb = g.alloc(8)
+    empty = [[] for _ in range(size)]
+    rcounts = [[], [8]] + [[] for _ in range(size - 2)]
+    rdisp = [[], [0]] + [[] for _ in range(size - 2)]
+    rtypes = [[], [dt.BYTE]] + [[] for _ in range(size - 2)]
+    with pytest.raises(ValueError, match="no matching send"):
+        api.neighbor_alltoallw(g, sb, empty, empty, empty,
+                               rb, rcounts, rdisp, rtypes)
+    assert not g._pending
