@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Multi-tenant QoS fairness: small-tenant completion latency under a bulk
+flood (ISSUE 7; runtime/qos.py).
+
+No reference analog (TEMPI serves one application). The scenario is the
+ROADMAP's "millions of users" contention in miniature: several bulk-class
+tenants flood large messages through the background progress pump while
+one latency-class tenant posts small pairs and waits for BACKGROUND
+completion (polled, never wait()-driven — the pump's service order is the
+thing under test). Reported per class: completions, p50/p99 wall-clock
+from post to background completion, plus the qos.* counters
+(served/deferred/backpressure), so the weighted-fair claim has a
+trackable number.
+
+Run it twice to see the effect:
+
+    python benches/bench_qos.py --cpu             # QoS off: one FIFO
+    python benches/bench_qos.py --cpu --qos       # latency weighted 4:1
+
+With --qos the latency tenant's p99 should sit well below the off run's
+(which serializes behind whole flood waves), while bulk throughput stays
+within the weight ratio.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from _common import base_parser, emit_csv, devices_or_die, setup_platform
+
+
+def _percentiles(xs):
+    if not xs:
+        return 0.0, 0.0
+    v = np.sort(np.asarray(xs))
+    return float(np.percentile(v, 50)), float(np.percentile(v, 99))
+
+
+def main() -> int:
+    p = base_parser("QoS fairness: bulk flood vs latency tenant",
+                    multirank=True)
+    p.add_argument("--qos", action="store_true",
+                   help="arm the class scheduler (default: off, one FIFO)")
+    p.add_argument("--bulk-tenants", type=int, default=8)
+    p.add_argument("--bulk-bytes", type=int, default=1 << 18)
+    p.add_argument("--small-bytes", type=int, default=64)
+    p.add_argument("--iters", type=int, default=16)
+    args = p.parse_args()
+    if args.quick:
+        args.iters = 4
+        args.bulk_tenants = 4
+    setup_platform(args)
+
+    import os
+    os.environ["TEMPI_PROGRESS_THREAD"] = "1"
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.parallel.communicator import Communicator
+
+    devices_or_die(2)
+    world = api.init()
+
+    def post_pair(comm, nbytes, tag):
+        ty = dt.contiguous(nbytes, dt.BYTE)
+        sbuf = comm.alloc(nbytes)
+        rbuf = comm.alloc(nbytes)
+        return [p2p.isend(comm, 0, sbuf, 1, ty, tag=tag),
+                p2p.irecv(comm, 1, rbuf, 0, ty, tag=tag)]
+
+    def await_done(reqs, deadline_s=120.0):
+        t0 = time.monotonic()
+        while not all(r.done for r in reqs):
+            if time.monotonic() - t0 > deadline_s:
+                raise SystemExit("background completion deadline exceeded "
+                                 "(pump starved?)")
+            time.sleep(0.0005)
+        return time.monotonic() - t0
+
+    latency_comm = Communicator(world.devices)
+    bulk_comms = [Communicator(world.devices)
+                  for _ in range(args.bulk_tenants)]
+    if args.qos:
+        api.comm_set_qos(latency_comm, "latency")
+        for bc in bulk_comms:
+            api.comm_set_qos(bc, "bulk")
+
+    # warm the exchange plans so compile time stays out of the numbers
+    p2p.waitall(post_pair(latency_comm, args.small_bytes, 999))
+    p2p.waitall(post_pair(bulk_comms[0], args.bulk_bytes, 999))
+
+    flood, bulk_times, small_times = [], [], []
+
+    def reap_waves():
+        # stamp each wave's completion AS it happens (detection granularity
+        # = one iteration): deferring all await_done calls past the posting
+        # loop would inflate early waves' times to ~the whole run
+        for entry in flood:
+            wave, t0, done_at = entry
+            if done_at is None and all(r.done for r in wave):
+                entry[2] = time.monotonic()
+
+    t_run0 = time.monotonic()
+    for it in range(args.iters):
+        wave = []
+        for bc in bulk_comms:
+            wave.extend(post_pair(bc, args.bulk_bytes, 100 + it))
+        flood.append([wave, time.monotonic(), None])
+        small_times.append(
+            await_done(post_pair(latency_comm, args.small_bytes, it)))
+        reap_waves()
+    for wave, t0, _ in flood:
+        await_done(wave)
+        reap_waves()
+    bulk_times = [done_at - t0 for _, t0, done_at in flood]
+    wall = time.monotonic() - t_run0
+
+    qc = api.counters_snapshot()["qos"]
+    sp50, sp99 = _percentiles(small_times)
+    bp50, bp99 = _percentiles(bulk_times)
+    emit_csv(
+        ("qos", "class", "completions", "p50_s", "p99_s",
+         "served", "deferred", "backpressure", "wall_s"),
+        [(int(args.qos), "latency", len(small_times), sp50, sp99,
+          qc["served_latency"], qc["deferred_latency"],
+          qc["backpressure_latency"], wall),
+         (int(args.qos), "bulk",
+          len(bulk_times) * 2 * args.bulk_tenants, bp50, bp99,
+          qc["served_bulk"], qc["deferred_bulk"],
+          qc["backpressure_bulk"], wall)])
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,9 @@ def init(devices=None) -> Communicator:
     from .tune import online as tune_online
     tune_online.configure()  # arm TEMPI_TUNE (knobs already loud-parsed
     # by read_environment; this clears any prior session's learned state)
+    from .runtime import qos
+    qos.configure()  # arm TEMPI_QOS_DEFAULT (knobs loud-parsed above);
+    # clears any prior session's api-armed state and verdict ledger
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -175,8 +178,10 @@ def finalize() -> None:
         from .tune import online as tune_online
         tune_online.finalize()
         type_cache.clear()
-        from .runtime import health
+        from .runtime import health, qos
         health.reset()  # breaker history is per-session, like counters
+        qos.configure()  # api-armed QoS and the verdict ledger are
+        # per-session too (env-armed QoS survives: configure re-reads it)
         _world = None
 
 
@@ -212,6 +217,33 @@ def tune_snapshot() -> dict:
     (everything simply reads empty)."""
     from .tune import online as tune_online
     return tune_online.snapshot()
+
+
+def comm_set_qos(comm: Communicator, qos_class: Optional[str]) -> None:
+    """Assign a communicator's QoS service class (ISSUE 7): ``"latency"``
+    (small, deadline-sensitive exchanges — weighted ahead of the pack),
+    ``"bulk"`` (large, throughput-bound bursts — weighted behind, never
+    starved), or ``None`` (back to the default class). Setting a class
+    ARMS the class scheduler for the session; until the first class is
+    assigned (and without ``TEMPI_QOS_DEFAULT``), the progress pump's
+    behavior is byte-for-byte the single-FIFO one. See the README
+    "Multi-tenant QoS" section for the knob/degradation table."""
+    from .runtime import qos
+    cls = qos.validate_class(qos_class)
+    comm.qos = cls
+    if cls is not None:
+        qos.arm()
+
+
+def qos_snapshot() -> dict:
+    """Diagnostic snapshot of the multi-tenant QoS scheduler (ISSUE 7):
+    arming state, effective knobs, per-class served/deferred/backpressure
+    counters, the live pump's lane depths and deficit credits, and the
+    lane-quarantine verdict ledger — the starvation-visibility companion
+    to the ``qos.*`` trace events. Pure data — safe to serialize.
+    Callable before init and after finalize (reads empty/zeroed)."""
+    from .runtime import qos
+    return qos.snapshot()
 
 
 def counters_snapshot(reset: bool = False) -> dict:

@@ -87,6 +87,11 @@ class Communicator:
         # thread may hold this comm's progress lock forever, so background
         # service skips it — waiters still drive its progress synchronously
         self.quarantined = False
+        # QoS service class (ISSUE 7; runtime/qos.py): "latency" | "bulk"
+        # | None (the default class, reclassifiable via TEMPI_QOS_DEFAULT).
+        # Set via api.comm_set_qos, which also arms the class scheduler;
+        # with QoS unset the attribute is inert
+        self.qos = None
         _all_comms.add(self)
 
     # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------

@@ -97,6 +97,14 @@ SITES = (
                           # regions, so the per-round retry loop can
                           # re-dispatch idempotently; wedge refused —
                           # the round runs under the progress lock)
+    "qos.admit",          # each QoS admission decision at op-post notify
+                          # (runtime/progress.notify, armed only while
+                          # qos.ENABLED — a raise forces the refusal
+                          # path: the wakeup degrades to backpressure's
+                          # caller-drives-synchronously fallback, the
+                          # exchange is never dropped; delay slows the
+                          # posting producer; wedge refused like every
+                          # non-engine site)
 )
 
 KINDS = ("raise", "delay", "wedge")

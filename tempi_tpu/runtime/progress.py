@@ -44,12 +44,19 @@ from typing import List, Optional
 from ..obs import trace as obstrace
 from ..utils import env as envmod
 from ..utils import logging as log
-from .queue import Queue, ShutDown
+from . import faults, qos
+from .queue import ShutDown
 
 
 class ProgressPump:
     def __init__(self):
-        self._queue: Queue = Queue()
+        # the wakeup channel is ALWAYS the class scheduler (ISSUE 7): with
+        # QoS unset every communicator routes to its single default lane,
+        # which drains plain FIFO — byte-for-byte the old Queue behavior,
+        # pinned by the qos.* counters staying zero. Keeping one shape
+        # also lets api.comm_set_qos arm QoS mid-session without swapping
+        # a live pump: lanes exist from birth; only routing turns on.
+        self._queue: qos.ClassScheduler = qos.ClassScheduler()
         # supervision state: heartbeat is stamped around every iteration;
         # _serving names the communicator a stuck iteration was driving
         # (None while idle on pop — an idle pump is never "wedged")
@@ -59,23 +66,25 @@ class ProgressPump:
                                         name="tempi-progress", daemon=True)
         self._thread.start()
 
-    def notify(self, comm) -> None:
+    def notify(self, comm, force: bool = False) -> bool:
         """Called at op-post time (the isend/irecv entry, like the
         reference's try_progress call sites). Coalesced: a communicator
         already awaiting the pump is not enqueued again, so a bulk posting
-        loop costs one matching scan, not one per op."""
+        loop costs one matching scan, not one per op. Returns False when
+        the communicator's class lane refused the wakeup (QoS admission
+        control) — the module-level notify() then applies backpressure.
+        ``force`` bypasses the lane bound (supervisor backlog handoff)."""
         try:
-            self._queue.push_unique(comm)
+            return self._queue.push_unique(comm, force=force)
         except ShutDown:
-            pass
+            return True  # pump is shutting down; not a QoS refusal
 
     def _run(self) -> None:
         from ..parallel import p2p
-        from . import faults
         while True:
             self._serving = None
             try:
-                comm = self._queue.pop()
+                comm, qos_class = self._queue.pop()
             except ShutDown:
                 return
             # heartbeat BEFORE naming the comm: the supervisor must never
@@ -94,6 +103,9 @@ class ProgressPump:
                     log.error(f"background progress failed: {e}")
                     continue
             t0 = time.monotonic() if obstrace.ENABLED else 0.0
+            # qos_class threads through the span only when QoS is armed:
+            # with QoS unset the trace stream stays byte-identical
+            span_fields = {"qos_class": qos_class} if qos.ENABLED else {}
             served = 0
             try:
                 if not comm.freed and comm._pending and not comm.quarantined:
@@ -107,11 +119,12 @@ class ProgressPump:
                 # try_progress call reproduces them directly
                 if obstrace.ENABLED:
                     obstrace.emit_span("pump.step", t0, outcome="error",
-                                       error=repr(e)[:200])
+                                       error=repr(e)[:200], **span_fields)
                 log.error(f"background progress failed: {e}")
             else:
                 if obstrace.ENABLED and served:
-                    obstrace.emit_span("pump.step", t0, outcome="ok")
+                    obstrace.emit_span("pump.step", t0, outcome="ok",
+                                       **span_fields)
 
     def stop(self, deadline: Optional[float] = None) -> bool:
         """Returns False if the thread failed to stop — the caller must then
@@ -156,12 +169,52 @@ def start() -> ProgressPump:
 def notify(comm) -> None:
     # quarantined comms get no background service (waiters still drive
     # their progress synchronously — the in-call guarantee is untouched)
-    if _pump is not None and not comm.quarantined:
-        _pump.notify(comm)
+    if _pump is None or comm.quarantined:
+        return
+    if qos.ENABLED and faults.ENABLED:
+        # qos.admit: the admission-control chaos site — a raise-kind
+        # fault forces the refusal path (the exchange itself is never
+        # dropped: backpressure degrades it to synchronous service)
+        try:
+            faults.check("qos.admit")
+        except faults.InjectedFault as e:
+            log.warn(f"qos admission faulted: {e}")
+            _backpressure(comm, reason="fault")
+            return
+    if not _pump.notify(comm):
+        _backpressure(comm, reason="full")
+
+
+def _backpressure(comm, reason: str) -> None:
+    """A class lane refused the wakeup: the POSTING caller drives the
+    communicator's progress synchronously instead — the cost of a flood
+    lands on the flooding producer, never on the pump's other tenants,
+    and the operation is never silently dropped (its waiters would also
+    still complete it, as for any unserved wakeup). Errors are stashed
+    on the requests for wait() exactly as on the pump path."""
+    cls = qos.class_of(comm)
+    qos.count_backpressure(cls)
+    if obstrace.ENABLED:
+        obstrace.emit("qos.backpressure", qos_class=cls, reason=reason)
+    from ..parallel import p2p
+    try:
+        if not comm.freed and comm._pending:
+            p2p.try_progress(comm)
+    except Exception as e:
+        # same contract as the pump loop: try_progress attached the root
+        # cause to the failed batch's requests for wait() to re-raise
+        log.error(f"backpressure-driven progress failed: {e}")
 
 
 def running() -> bool:
     return _pump is not None
+
+
+def scheduler():
+    """The live pump's class scheduler, or None (qos.snapshot reads lane
+    depths/credits through this)."""
+    pump = _pump
+    return pump._queue if pump is not None else None
 
 
 def quarantined() -> List:
@@ -236,7 +289,8 @@ def _lift_dead_quarantines_locked() -> None:
         log.warn("abandoned pump thread exited; lifting its "
                  "communicator's background-service quarantine")
         if _pump is not None and not comm.freed and comm._pending:
-            _pump.notify(comm)
+            _pump.notify(comm, force=True)  # internal re-admit: a full
+            # lane must not strand a just-unquarantined communicator
 
 
 def _replace_pump_locked(pump: ProgressPump, stuck_comm, reason: str) -> None:
@@ -248,20 +302,27 @@ def _replace_pump_locked(pump: ProgressPump, stuck_comm, reason: str) -> None:
     if stuck_comm is not None:
         stuck_comm.quarantined = True
         _quarantined.add(stuck_comm)
+        if qos.ENABLED:
+            # the verdict's blast radius is the TENANT, recorded against
+            # its class lane for visibility — innocent same-class tenants
+            # keep background service through the replacement pump
+            cls = qos.class_of(stuck_comm)
+            qos.note_lane_quarantine(cls)
+            if obstrace.ENABLED:
+                obstrace.emit("qos.quarantine", qos_class=cls)
     _abandoned.append((pump._thread, stuck_comm))
     # close the old queue so the old thread exits if it ever revives, then
-    # drain its backlog into the replacement (minus the quarantined comm)
+    # hand its backlog to the replacement (minus the quarantined comm).
+    # drain() is non-blocking — the old pop(timeout=0.001) loop cost up to
+    # ~1 ms per backlogged communicator while holding the module lock
     pump._queue.close()
-    backlog = []
-    while True:
-        try:
-            backlog.append(pump._queue.pop(timeout=0.001))
-        except (ShutDown, TimeoutError):
-            break
+    backlog = pump._queue.drain()
     _pump = ProgressPump()
     for comm in backlog:
         if not comm.quarantined:
-            _pump.notify(comm)
+            # already-admitted wakeups transfer without re-admission: the
+            # handoff must not convert a full lane into lost service
+            _pump.notify(comm, force=True)
     if obstrace.ENABLED:
         # the supervisor's verdict, on the record: which failure mode it
         # saw and whether a communicator lost background service for it

@@ -1,0 +1,291 @@
+"""Multi-tenant QoS for the progress runtime: class lanes, weighted-fair
+draining, bounded queues.
+
+No reference analog: TEMPI serves one application, so its async engine can
+progress a plain FIFO (async_operation.cpp try_progress). The ROADMAP
+north-star — many concurrent independent exchange streams sharing one
+device's links — breaks that: a single tenant's multi-MiB burst
+head-of-line-blocks every other tenant's latency-sensitive small messages,
+and an unbounded backlog turns one misbehaving producer into runtime-wide
+memory growth. This module gives the progress pump (runtime/progress.py)
+per-class service lanes:
+
+  * every communicator carries a ``qos`` attribute — ``"latency"``,
+    ``"bulk"``, or ``None`` (the ``default`` class; ``TEMPI_QOS_DEFAULT``
+    reclassifies unset comms globally, ``api.comm_set_qos`` per comm);
+  * the pump's wakeup channel is a :class:`ClassScheduler`: one bounded
+    :class:`~.queue.Queue` lane per class, drained deficit-round-robin by
+    ``TEMPI_QOS_WEIGHTS`` — a backlogged lane is served ``weight`` slots
+    per round and EVERY backlogged lane gets at least one slot per round,
+    so neither direction can starve (bulk always advances under a latency
+    storm; latency is never pinned behind a bulk flood);
+  * admission control: a full lane REFUSES the wakeup and the caller
+    (progress.notify) degrades to driving that communicator's progress
+    synchronously — backpressure lands on the flooding producer, the
+    operation is never silently dropped;
+  * visibility: per-class ``qos.served/deferred/backpressure`` counters,
+    ``qos.backpressure``/``qos.quarantine`` trace instants, and a
+    ``qos_class`` attribute on ``pump.step`` spans, so starvation shows
+    up in Perfetto instead of in a user complaint.
+
+Byte-for-byte contract (the standing constraint from coll/ and tune/):
+with QoS unset — no ``TEMPI_QOS_DEFAULT``, no ``api.comm_set_qos`` call —
+:func:`class_of` maps every communicator to the single ``default`` lane,
+no bound is enforced, no counter moves, and the scheduler drains plain
+FIFO: single-tenant behavior is unchanged, pinned by counter-based tests
+(tests/test_qos.py).
+
+The module-flag pattern matches faults/obstrace: ``qos.ENABLED`` is the
+one truth test hot paths pay when QoS is off. Unlike those, arming is
+dynamic (``api.comm_set_qos`` mid-session), which the always-installed
+scheduler absorbs: lanes exist from pump construction; only routing,
+bounds, and bookkeeping consult the flag.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from .queue import Queue, ShutDown  # noqa: F401  (re-export for the pump)
+
+#: Service classes, in drain-priority order within a scheduling round.
+CLASSES = ("latency", "default", "bulk")
+
+#: Module-level fast-path flag: True iff QoS is armed (TEMPI_QOS_DEFAULT
+#: set, or any communicator classed via api.comm_set_qos this session).
+ENABLED = False
+
+# lane-quarantine verdicts this session (class -> count): the supervisor's
+# wedge verdicts attributed to the tenant's class, for qos_snapshot()
+_quarantine_verdicts: Dict[str, int] = {}
+_verdict_lock = threading.Lock()
+
+
+def configure() -> None:
+    """(Re)arm from the parsed env (call after ``read_environment``): QoS
+    is on iff ``TEMPI_QOS_DEFAULT`` names a class. Clears the session's
+    api-armed state and lane-quarantine verdicts — QoS arming is
+    per-session, like counters."""
+    global ENABLED
+    ENABLED = bool(getattr(envmod.env, "qos_default", ""))
+    with _verdict_lock:
+        _quarantine_verdicts.clear()
+    if ENABLED:
+        log.debug(f"QoS armed: default class {envmod.env.qos_default!r}, "
+                  f"weights {envmod.env.qos_weights}, "
+                  f"lane depth {envmod.env.qos_queue_depth}")
+
+
+def disarm() -> None:
+    """Turn QoS off regardless of the parsed env (test isolation — the
+    analog of ``obstrace.configure("off")``). Clears the verdict
+    ledger."""
+    global ENABLED
+    ENABLED = False
+    with _verdict_lock:
+        _quarantine_verdicts.clear()
+
+
+def arm() -> None:
+    """Arm QoS mid-session (``api.comm_set_qos`` on the first classed
+    communicator). The scheduler is already installed in the pump — only
+    routing/bounds/bookkeeping turn on."""
+    global ENABLED
+    if not ENABLED:
+        ENABLED = True
+        log.debug("QoS armed by api.comm_set_qos")
+
+
+def validate_class(cls: Optional[str]) -> Optional[str]:
+    """The application-facing class vocabulary: latency | bulk | None
+    (unset). ``default`` is internal — unset comms land there; letting
+    apps claim it explicitly would just alias None."""
+    if cls is None:
+        return None
+    c = str(cls).lower()
+    if c not in ("latency", "bulk"):
+        raise ValueError(
+            f"bad qos class {cls!r}: want 'latency', 'bulk', or None")
+    return c
+
+
+def class_of(comm) -> str:
+    """Resolve a communicator's service class. With QoS off everything is
+    ``default`` (the byte-for-byte single-lane path); armed, an unset
+    ``qos`` attribute falls back to ``TEMPI_QOS_DEFAULT``."""
+    if not ENABLED:
+        return "default"
+    cls = getattr(comm, "qos", None)
+    if cls:
+        return cls
+    return getattr(envmod.env, "qos_default", "") or "default"
+
+
+def _bump(counter: str, cls: str, n: int = 1) -> None:
+    g = ctr.counters.qos
+    attr = f"{counter}_{cls}"
+    setattr(g, attr, getattr(g, attr) + n)
+
+
+def count_backpressure(cls: str) -> None:
+    _bump("backpressure", cls)
+
+
+def note_lane_quarantine(cls: str) -> None:
+    """Record a supervisor wedge verdict against a tenant of ``cls`` (the
+    quarantine itself stays per-communicator — runtime/progress.py — so
+    innocent same-class tenants keep background service; this is the
+    starvation-visibility ledger)."""
+    with _verdict_lock:
+        _quarantine_verdicts[cls] = _quarantine_verdicts.get(cls, 0) + 1
+
+
+class ClassScheduler:
+    """The pump's wakeup channel: one bounded FIFO lane per class, drained
+    by deficit round-robin. Exposes the same surface the pump used on the
+    plain Queue (``push_unique``/``pop``/``close``/``drain``/``len``), so
+    the supervisor's replace/stop machinery is class-agnostic.
+
+    Deficit round-robin: each lane holds a credit counter. A pop serves
+    the first class (in ``CLASSES`` order) that is backlogged and has
+    credit, spending one. When no backlogged lane has credit, every
+    backlogged lane's credit is replenished to its configured weight (an
+    idle lane's credit resets to zero — credit is a share of contended
+    service, not a bankable asset). Per round, a backlogged lane is
+    therefore served exactly min(weight, backlog) slots: the weighted
+    ratio under contention, at least one slot always — no starvation in
+    either direction. With QoS off only the ``default`` lane is ever
+    populated and pops reduce to its plain FIFO order."""
+
+    def __init__(self):
+        # RLock: pop()/push_unique() hold the shared condition while
+        # calling lane methods that re-enter it
+        self._cv = threading.Condition(threading.RLock())
+        self._lanes: Dict[str, Queue] = {
+            cls: Queue(cond=self._cv) for cls in CLASSES}
+        self._credits: Dict[str, int] = {cls: 0 for cls in CLASSES}
+        self._closed = False
+
+    def push_unique(self, item, cls: Optional[str] = None,
+                    force: bool = False) -> bool:
+        """Admit a wakeup into its class lane (coalesced, like
+        Queue.push_unique). Returns False — admission REFUSED — when QoS
+        is armed, the lane is full, and the item is not already queued;
+        the caller must then apply backpressure (never drop silently).
+        ``force`` bypasses the bound (supervisor backlog handoff: those
+        wakeups were already admitted once). Raises ShutDown after
+        close()."""
+        if cls is None:
+            cls = class_of(item)
+        lane = self._lanes[cls]
+        with self._cv:
+            if (ENABLED and not force and item not in lane
+                    and len(lane) >= envmod.env.qos_queue_depth):
+                return False
+            lane.push_unique(item)
+            return True
+
+    def pop(self, timeout: Optional[float] = None):
+        """Blocking weighted-fair pop across the lanes. Raises
+        TimeoutError on timeout, ShutDown when closed and fully drained.
+        Returns ``(item, class)`` — the pump stamps the class on its
+        ``pump.step`` span."""
+        with self._cv:
+            while True:
+                backlogged = [c for c in CLASSES if len(self._lanes[c])]
+                if backlogged:
+                    cls = self._select_locked(backlogged)
+                    return self._lanes[cls].pop_nowait(), cls
+                if self._closed:
+                    raise ShutDown()
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError()
+
+    def _select_locked(self, backlogged: List[str]) -> str:
+        """One deficit-round-robin decision. Caller holds the condition
+        and guarantees ``backlogged`` is non-empty."""
+        chosen = None
+        for cls in CLASSES:
+            if cls in backlogged and self._credits[cls] > 0:
+                chosen = cls
+                break
+        if chosen is None:
+            # round boundary: replenish backlogged lanes, zero idle ones
+            weights = envmod.env.qos_weights
+            for cls in CLASSES:
+                self._credits[cls] = (weights.get(cls, 1)
+                                      if cls in backlogged else 0)
+            chosen = next(c for c in CLASSES if c in backlogged)
+        self._credits[chosen] -= 1
+        if ENABLED:
+            _bump("served", chosen)
+            for other in backlogged:
+                if other != chosen:
+                    _bump("deferred", other)
+        return chosen
+
+    def drain(self) -> List:
+        """Every queued item, latency lane first, without blocking (the
+        supervisor hands a replaced pump's backlog over under the module
+        lock — satellite fix: the old per-item pop(timeout=0.001) loop
+        cost up to ~1 ms × backlog inside that lock)."""
+        with self._cv:
+            return [item for cls in CLASSES
+                    for item in self._lanes[cls].drain()]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            for lane in self._lanes.values():
+                lane.close()
+            self._cv.notify_all()
+
+    def depths(self) -> Dict[str, int]:
+        with self._cv:
+            return {cls: len(lane) for cls, lane in self._lanes.items()}
+
+    def credits(self) -> Dict[str, int]:
+        with self._cv:
+            return dict(self._credits)
+
+    def __len__(self) -> int:
+        with self._cv:
+            return sum(len(lane) for lane in self._lanes.values())
+
+
+def snapshot() -> dict:
+    """Pure-data QoS report for ``api.qos_snapshot()``: arming state, the
+    effective knobs, per-class counters, the live scheduler's lane depths
+    and credits, and the lane-quarantine verdict ledger. Callable before
+    init and after finalize (reads empty)."""
+    from . import progress
+    qc = ctr.counters.qos
+    classes = {}
+    for cls in CLASSES:
+        classes[cls] = dict(
+            weight=envmod.env.qos_weights.get(cls, 1),
+            served=getattr(qc, f"served_{cls}"),
+            deferred=getattr(qc, f"deferred_{cls}"),
+            backpressure=getattr(qc, f"backpressure_{cls}"),
+        )
+    with _verdict_lock:
+        verdicts = dict(_quarantine_verdicts)
+    sched = progress.scheduler()
+    if sched is not None:
+        depths, credits = sched.depths(), sched.credits()
+        for cls in CLASSES:
+            classes[cls]["queued"] = depths[cls]
+            classes[cls]["credits"] = credits[cls]
+    return dict(
+        enabled=ENABLED,
+        default_class=envmod.env.qos_default or "default",
+        queue_depth=envmod.env.qos_queue_depth,
+        classes=classes,
+        quarantine_verdicts=verdicts,
+        quarantined_comms=[
+            dict(qos_class=class_of(c)) for c in progress.quarantined()],
+    )

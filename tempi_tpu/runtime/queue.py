@@ -5,14 +5,16 @@ The reference ships a mutex-guarded queue with no users
 headed toward a dedicated progress thread that never landed (SURVEY.md §2
 component 32). Here the queue is load-bearing: the progress pump
 (runtime/progress.py) blocks on it for communicators with freshly posted
-operations.
+operations — one queue per QoS class lane since the multi-tenant scheduler
+landed (runtime/qos.py), which is why a queue can share its condition
+variable with sibling lanes (one pump thread blocks across all of them).
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Generic, Optional, TypeVar
+from typing import Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -23,12 +25,20 @@ class ShutDown(Exception):
 
 class Queue(Generic[T]):
     """Unbounded MPSC-safe queue: push never blocks; pop blocks until an
-    item, a timeout, or close()."""
+    item, a timeout, or close().
 
-    def __init__(self):
+    ``cond`` lets several queues share one condition variable (the QoS
+    class lanes: a consumer blocked in the scheduler must wake on a push
+    to ANY lane). A shared condition must wrap an RLock, because the
+    scheduler holds it while calling back into lane methods."""
+
+    def __init__(self, cond: Optional[threading.Condition] = None):
         self._items: collections.deque = collections.deque()
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        # identity set beside the deque: push_unique's already-queued test
+        # must not scan the deque, or a large multi-tenant backlog makes
+        # every op-post notify linear in queued communicators
+        self._ids: set = set()
+        self._cv = cond if cond is not None else threading.Condition()
         self._closed = False
 
     def push(self, item: T) -> None:
@@ -36,6 +46,7 @@ class Queue(Generic[T]):
             if self._closed:
                 raise ShutDown("push() after close()")
             self._items.append(item)
+            self._ids.add(id(item))
             self._cv.notify()
 
     def push_unique(self, item: T) -> bool:
@@ -46,9 +57,10 @@ class Queue(Generic[T]):
         with self._cv:
             if self._closed:
                 raise ShutDown("push() after close()")
-            if any(x is item for x in self._items):
+            if id(item) in self._ids:
                 return False
             self._items.append(item)
+            self._ids.add(id(item))
             self._cv.notify()
             return True
 
@@ -61,7 +73,35 @@ class Queue(Generic[T]):
                     raise ShutDown()
                 if not self._cv.wait(timeout=timeout):
                     raise TimeoutError()
-            return self._items.popleft()
+            return self._pop_locked()
+
+    def pop_nowait(self) -> T:
+        """Non-blocking pop; raises LookupError when empty (never blocks,
+        never raises ShutDown — a closed queue still drains). The QoS
+        scheduler uses this under its shared condition."""
+        with self._cv:
+            if not self._items:
+                raise LookupError("queue empty")
+            return self._pop_locked()
+
+    def _pop_locked(self) -> T:
+        item = self._items.popleft()
+        # discard, not remove: push() (non-unique) may have queued the same
+        # identity twice, in which case the set undercounts — harmless for
+        # push_unique (an extra wakeup, never a missed one)
+        self._ids.discard(id(item))
+        return item
+
+    def drain(self) -> List[T]:
+        """Remove and return every queued item, oldest first, WITHOUT
+        blocking — unlike a pop(timeout=...) loop, which costs up to one
+        timeout per item. Works on a closed queue (the supervisor drains a
+        replaced pump's backlog after closing it)."""
+        with self._cv:
+            items = list(self._items)
+            self._items.clear()
+            self._ids.clear()
+            return items
 
     def close(self) -> None:
         """Wake all waiters; subsequent pops drain then raise ShutDown."""
@@ -69,6 +109,10 @@ class Queue(Generic[T]):
             self._closed = True
             self._cv.notify_all()
 
+    def __contains__(self, item: T) -> bool:
+        with self._cv:
+            return id(item) in self._ids
+
     def __len__(self) -> int:
-        with self._mu:
+        with self._cv:
             return len(self._items)

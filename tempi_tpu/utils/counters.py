@@ -85,6 +85,21 @@ class CollCounters:
 
 
 @dataclass
+class QosCounters:
+    # multi-tenant class scheduler (ISSUE 7; runtime/qos.py): pinned at
+    # zero with QoS unset — the counter-based byte-for-byte guard
+    served_latency: int = 0        # pump services drained from the lane
+    served_default: int = 0
+    served_bulk: int = 0
+    deferred_latency: int = 0      # backlogged lane passed over while
+    deferred_default: int = 0      # another lane was served (starvation
+    deferred_bulk: int = 0         # visibility: who waited, how often)
+    backpressure_latency: int = 0  # admissions refused by a full lane or
+    backpressure_default: int = 0  # a qos.admit fault — the caller drove
+    backpressure_bulk: int = 0     # progress synchronously instead
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -108,6 +123,7 @@ class Counters:
     lib: LibCallCounters = field(default_factory=LibCallCounters)
     coll: CollCounters = field(default_factory=CollCounters)
     plan: PlanCacheCounters = field(default_factory=PlanCacheCounters)
+    qos: QosCounters = field(default_factory=QosCounters)
 
     def as_dict(self) -> dict:
         out = {}

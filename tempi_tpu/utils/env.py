@@ -112,9 +112,36 @@ coll/persistent.py and the README "Persistent collectives" section):
                          wire time) when measured, else the historical
                          1<<14 guess; negative rejected loudly.
 
-All resilience, observability, tuning, and persistent-collective knobs
-parse LOUDLY (a typo raises at init rather than silently reverting to the
-hang/die/fly-blind/frozen-model behavior the knob exists to prevent).
+Multi-tenant QoS knobs (ISSUE 7; see runtime/qos.py, runtime/progress.py
+and the README "Multi-tenant QoS" section):
+  TEMPI_QOS_DEFAULT    = latency | bulk — the QoS class of communicators
+                         whose ``qos`` attribute is unset, and the switch
+                         that arms the class scheduler from the
+                         environment (unset = QoS off: the pump drains
+                         one FIFO, byte-for-byte the pre-QoS behavior;
+                         ``api.comm_set_qos`` also arms it per-comm)
+  TEMPI_QOS_QUEUE_DEPTH  bound of each class lane's pump-wakeup queue,
+                         in distinct communicators awaiting background
+                         service (default 256; zero/negative rejected —
+                         a zero-depth lane would refuse every wakeup).
+                         A full lane applies BACKPRESSURE: the posting
+                         caller drives progress synchronously instead
+                         (never a silent drop; see qos.backpressure
+                         counters/trace events)
+  TEMPI_QOS_WEIGHTS    = class:weight[,class:weight...] over latency /
+                         default / bulk — the weighted-fair drain ratio
+                         of the pump's class scheduler (default
+                         ``latency:4,default:2,bulk:1``; unknown class
+                         names and non-positive weights rejected).
+                         Every class with queued work is served at least
+                         one slot per scheduling round (deficit
+                         round-robin), so no weight choice can starve a
+                         class in either direction
+
+All resilience, observability, tuning, persistent-collective, and QoS
+knobs parse LOUDLY (a typo raises at init rather than silently reverting
+to the hang/die/fly-blind/frozen-model/head-of-line-blocked behavior the
+knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -244,6 +271,12 @@ class Environment:
     # skew-split tail message; -1 = unset (derive from the swept sheet
     # when measured, else the historical 1<<14 guess)
     a2av_split_overhead: int = -1
+    # multi-tenant QoS (no reference analog; ISSUE 7) — see runtime/qos.py
+    # (class scheduler) and runtime/progress.py (pump integration)
+    qos_default: str = ""          # "" = QoS off | latency | bulk
+    qos_queue_depth: int = 256     # per-class pump-wakeup lane bound
+    qos_weights: dict = field(
+        default_factory=lambda: {"latency": 4, "default": 2, "bulk": 1})
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -422,6 +455,61 @@ class Environment:
                     "non-negative integer (bytes)")
             e.a2av_split_overhead = i
 
+        # QoS knobs parse loudly too: a typo'd class name silently leaving
+        # QoS off would hand the one multi-tenant deployment that asked
+        # for isolation the exact head-of-line blocking it configured
+        # against
+        qd = (getenv("TEMPI_QOS_DEFAULT") or "").lower()
+        if qd not in ("", "latency", "bulk"):
+            raise ValueError(
+                f"bad TEMPI_QOS_DEFAULT={qd!r}: want latency | bulk "
+                "(or unset for QoS off)")
+        e.qos_default = qd
+        v = getenv("TEMPI_QOS_QUEUE_DEPTH")
+        try:
+            depth = int(v) if v else 256
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_QOS_QUEUE_DEPTH={v!r}: want a positive "
+                "integer (communicators per class lane)") from exc
+        if depth <= 0:
+            # no silent clamp: a zero-depth lane would reject every pump
+            # wakeup, silently degrading the whole class to synchronous
+            # service — loud refusal, like TEMPI_TRACE_EVENTS
+            raise ValueError(
+                f"bad TEMPI_QOS_QUEUE_DEPTH={v!r}: want a positive "
+                "integer (communicators per class lane)")
+        e.qos_queue_depth = depth
+        v = getenv("TEMPI_QOS_WEIGHTS")
+        weights = {"latency": 4, "default": 2, "bulk": 1}
+        if v:
+            for part in filter(None, (p.strip() for p in v.split(","))):
+                cw = part.split(":")
+                if len(cw) != 2:
+                    raise ValueError(
+                        f"bad TEMPI_QOS_WEIGHTS entry {part!r}: want "
+                        "class:weight")
+                cls, w_s = cw[0].strip().lower(), cw[1].strip()
+                if cls not in weights:
+                    raise ValueError(
+                        f"bad TEMPI_QOS_WEIGHTS class {cls!r}: want one "
+                        f"of {tuple(weights)}")
+                try:
+                    w = int(w_s)
+                except ValueError as exc:
+                    raise ValueError(
+                        f"bad TEMPI_QOS_WEIGHTS weight {w_s!r} for "
+                        f"{cls!r}: want a positive integer") from exc
+                if w <= 0:
+                    # a zero weight is a starvation sentence, not a low
+                    # priority — the deficit round-robin contract is that
+                    # every backlogged class gets >= 1 slot per round
+                    raise ValueError(
+                        f"bad TEMPI_QOS_WEIGHTS weight {w_s!r} for "
+                        f"{cls!r}: want a positive integer")
+                weights[cls] = w
+        e.qos_weights = weights
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -446,6 +534,8 @@ class Environment:
             # ...and the adaptive layer: no strategy modeling means
             # nothing to observe or re-rank
             e.tune_mode = "off"
+            # ...and the class scheduler: the bail-out runs no pump
+            e.qos_default = ""
         return e
 
 

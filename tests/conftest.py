@@ -35,6 +35,10 @@ def pytest_configure(config):
         "markers",
         "coll: persistent-collective schedule tests (the <30s smoke is "
         "`pytest -m coll`)")
+    config.addinivalue_line(
+        "markers",
+        "qos: multi-tenant QoS scheduler tests (the <30s smoke is "
+        "`pytest -m qos`)")
 
 
 @pytest.fixture(autouse=True)
@@ -44,7 +48,7 @@ def _reset_globals():
     into the next test — release() also frees any still-blocked
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
-    from tempi_tpu.runtime import faults, health
+    from tempi_tpu.runtime import faults, health, qos
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env
 
@@ -52,13 +56,16 @@ def _reset_globals():
     faults.configure()
     obstrace.configure()
     tune_online.configure()
+    qos.configure()
     counters.init()
     health.reset()
     yield
     faults.reset()
     # breaker state and quarantine history must not leak across tests any
     # more than an armed fault spec may — nor may a test's recorded trace
-    # events, its armed recorder mode, or its learned tune estimators
+    # events, its armed recorder mode, its learned tune estimators, or an
+    # api-armed QoS scheduler
     health.reset()
     obstrace.configure("off")
     tune_online.configure("off")
+    qos.disarm()
