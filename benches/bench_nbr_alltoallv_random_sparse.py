@@ -4,7 +4,17 @@
 Re-design of /root/reference/bin/bench_nbr_alltoallv_random_sparse.cpp: a
 random sparse neighborhood graph, dist_graph_create_adjacent with reorder, and
 neighbor_alltoallv over the resulting communicator; reports trimean time and
-off-node traffic with and without the remap.
+off-node traffic with and without the remap, plus each placement's hop
+objective and live-cost objective (parallel/replacement.py).
+
+``--degrade A:B`` adds the ISSUE 8 frozen-vs-replaced A/B: the lib-rank
+link A:B is degraded (its device breaker opened, exactly the evidence the
+health registry would accumulate from real failures), the remapped
+communicator is re-benched FROZEN on its stale mapping, then
+``api.replace_ranks()`` installs the live-cost mapping and the bench runs
+again — the hop/live objective columns show what the re-placement bought.
+On a physically uniform CPU mesh the time_s column cannot feel the
+degradation; the live_obj column is the modeled cost the remap optimizes.
 """
 
 import sys
@@ -20,15 +30,24 @@ def main() -> int:
     p.add_argument("--density", type=float, default=0.25)
     p.add_argument("--scale", type=int, default=1 << 14)
     p.add_argument("--ranks-per-node", type=int, default=2)
+    p.add_argument("--degrade", metavar="A:B|auto",
+                   help="lib-rank link to degrade (opens its breaker) for "
+                        "a frozen-vs-replaced re-placement A/B; 'auto' "
+                        "degrades the remapped placement's busiest link; "
+                        "implies TEMPI_REPLACE=apply")
     args = p.parse_args()
     setup_platform(args)
 
     import numpy as np
     import os
     os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+    if args.degrade:
+        os.environ.setdefault("TEMPI_REPLACE", "apply")
+        os.environ.setdefault("TEMPI_REPLACE_MIN_GAIN", "0.01")
 
     from tempi_tpu import api
     from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.parallel import replacement
     from tempi_tpu.utils.env import PlacementMethod
 
     devices_or_die(1)
@@ -39,11 +58,7 @@ def main() -> int:
 
     sources, dests, sw, dw = make_adjacency(counts)
 
-    rows = []
-    for label, reorder in (("original", False), ("remapped", True)):
-        g = api.dist_graph_create_adjacent(
-            comm, sources, dests, sweights=sw, dweights=dw, reorder=reorder,
-            method=PlacementMethod.KAHIP if reorder else None)
+    def run_config(label, g):
         nb_s = max(1, int(counts.sum(1).max()))
         nb_r = max(1, int(counts.sum(0).max()))
         sb = g.alloc(nb_s)
@@ -66,9 +81,49 @@ def main() -> int:
 
         run()  # compile
         res = benchmark(run, **kw)
-        rows.append((label, int(counts.sum()), offnode_bytes(g, counts),
-                     res.trimean))
-    emit_csv(("placement", "total_B", "offnode_B", "time_s"), rows)
+        obj = replacement.objectives(g)
+        return (label, int(counts.sum()), offnode_bytes(g, counts),
+                obj["hop"], obj["live"], res.trimean)
+
+    rows = []
+    comms = {}
+    for label, reorder in (("original", False), ("remapped", True)):
+        g = api.dist_graph_create_adjacent(
+            comm, sources, dests, sweights=sw, dweights=dw, reorder=reorder,
+            method=PlacementMethod.KAHIP if reorder else None)
+        comms[label] = g
+        rows.append(run_config(label, g))
+
+    if args.degrade:
+        from tempi_tpu.runtime import health
+        from tempi_tpu.utils import env as envmod
+        g = comms["remapped"]
+        if args.degrade == "auto":
+            # the busiest physical link of the remapped placement — the
+            # degradation that actually hurts, so the A/B has a story
+            W = counts + counts.T
+            lib = [g.library_rank(r) for r in range(size)]
+            best, a, b = -1, 0, 1
+            for u in range(size):
+                for v in range(u + 1, size):
+                    if W[u, v] > best:
+                        best, a, b = int(W[u, v]), lib[u], lib[v]
+        else:
+            a, b = (int(x) for x in args.degrade.split(":"))
+        print(f"degrading lib link {a}:{b}", file=sys.stderr)
+        link = health.link(a, b)
+        for _ in range(max(1, envmod.env.breaker_threshold)):
+            health.record_failure(link, "device",
+                                  error="bench --degrade")
+        rows.append(run_config("frozen-degraded", g))
+        dec = api.replace_ranks(g)
+        print(f"replace decision: outcome={dec.get('outcome')} "
+              f"gain={dec.get('gain', 0.0):.3f} "
+              f"epoch={dec.get('epoch', 0)}", file=sys.stderr)
+        rows.append(run_config("replaced", g))
+
+    emit_csv(("placement", "total_B", "offnode_B", "hop_obj", "live_obj",
+              "time_s"), rows)
     api.finalize()
     return 0
 

@@ -42,6 +42,9 @@ def init(devices=None) -> Communicator:
     from .runtime import qos
     qos.configure()  # arm TEMPI_QOS_DEFAULT (knobs loud-parsed above);
     # clears any prior session's api-armed state and verdict ledger
+    from .parallel import replacement
+    replacement.configure()  # arm TEMPI_REPLACE (knobs loud-parsed
+    # above; this clears any prior session's decision ledger)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -182,6 +185,8 @@ def finalize() -> None:
         health.reset()  # breaker history is per-session, like counters
         qos.configure()  # api-armed QoS and the verdict ledger are
         # per-session too (env-armed QoS survives: configure re-reads it)
+        from .parallel import replacement
+        replacement.configure()  # decision ledger is per-session too
         _world = None
 
 
@@ -233,6 +238,35 @@ def comm_set_qos(comm: Communicator, qos_class: Optional[str]) -> None:
     comm.qos = cls
     if cls is not None:
         qos.arm()
+
+
+def replace_ranks(comm: Communicator) -> dict:
+    """Epoch-boundary topology re-placement (ISSUE 8): re-run the
+    placement partitioner on the LIVE cost of each link — the static
+    topology distances scaled by tune's observed per-link cost and by
+    ``TEMPI_REPLACE_PENALTY`` on links with open breakers or an active
+    pump quarantine — and, under ``TEMPI_REPLACE=apply``, install the
+    improved app->library permutation when it beats the frozen mapping
+    by at least ``TEMPI_REPLACE_MIN_GAIN``. Persistent collective
+    handles recompile before their next ``start()``. Requires a
+    dist-graph communicator with no operations in flight; buffers filled
+    before the remap must be refilled after it. Inert (and counter-
+    pinned) with ``TEMPI_REPLACE`` unset; ``observe`` records the
+    decision without acting. Returns the decision record; see the README
+    "Online re-placement" section."""
+    from .parallel import replacement
+    return replacement.replace_ranks(comm)
+
+
+def replace_snapshot() -> dict:
+    """Diagnostic snapshot of the online re-placement subsystem (ISSUE
+    8): mode and knobs, the bounded decision ledger (objectives, gains,
+    outcomes), the latest live-cost provenance (which links were
+    ratio-scaled or penalized, and why), and the latest applied mapping
+    epoch. Pure data — safe to serialize. Callable before init and
+    after finalize (reads empty)."""
+    from .parallel import replacement
+    return replacement.snapshot()
 
 
 def qos_snapshot() -> dict:

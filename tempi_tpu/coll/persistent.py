@@ -396,7 +396,10 @@ class PersistentColl:
     then the next ``start()`` RECOMPILES (re-choosing the method against
     the current breaker/tune state) instead of replaying a quarantined
     plan. Env-forced methods are never overridden, mirroring the p2p
-    chooser's contract."""
+    chooser's contract. An applied rank re-placement
+    (``api.replace_ranks``; parallel/replacement.py) likewise recompiles
+    before the next ``start()`` — the communicator's ``mapping_epoch``
+    stamps which permutation the compiled lowering is valid for."""
 
     def __init__(self, comm: Communicator, sendbuf: DistBuffer,
                  recvbuf: DistBuffer, sc: np.ndarray, sd: np.ndarray,
@@ -431,6 +434,10 @@ class PersistentColl:
         self._active = False
         self._started = False
         self._freed = False
+        # the app->library permutation this compile is valid for: an
+        # applied rank re-placement (parallel/replacement.py) bumps the
+        # communicator's epoch and start() recompiles before replaying
+        self._mapping_epoch = comm.mapping_epoch
         self._compile()
 
     # -- compile / recompile --------------------------------------------------
@@ -474,6 +481,44 @@ class PersistentColl:
         return _IsirLowering(self.comm, self.sendbuf, self.recvbuf,
                              self.schedule, mode)
 
+    def _refresh_mapping(self) -> None:
+        """An applied rank re-placement changed the app->library
+        permutation: the compiled schedule's remote flags, the
+        breaker-key link set, and every lowering's rank translation are
+        stale. Rebuild them all against the live mapping — the
+        re-placement analog of the recompile-on-breaker-open contract
+        (and unlike that path, the lowering rebuilds even when the
+        method choice is unchanged: the lowering itself embeds the old
+        permutation). Env-forced METHODS are still honored — only the
+        mapping-derived state refreshes."""
+        comm = self.comm
+        lib = [comm.library_rank(a) for a in range(comm.size)]
+        self._remote = np.zeros_like(self.sc, dtype=bool)
+        for a, p in zip(*np.nonzero(self.sc)):
+            self._remote[a, p] = not comm.is_colocated(lib[int(a)],
+                                                       lib[int(p)])
+        self.links = {health.link(lib[int(a)], lib[int(p)])
+                      for a, p in zip(*np.nonzero(self.sc))}
+        key = ("coll-sched", self._chunk, self.sc.tobytes(),
+               self.sd.tobytes(), self.rd.tobytes())
+        with comm._progress_lock:
+            # the apply step dropped the plan cache, so this compiles
+            # fresh (and re-caches for sibling handles on the same comm)
+            sched = planmod.cache_get(comm, key)
+            if not isinstance(sched, Schedule):
+                sched = compile_schedule(self.sc, self.sd, self.rd,
+                                         self._remote, self._chunk)
+                planmod.cache_put(comm, key, sched)
+        self.schedule = sched
+        self.method = _choose_method(comm, self.schedule, self.sc,
+                                     self._remote, self.links, self._forced)
+        self._lowering = self._build_lowering(self.method)
+        self._mapping_epoch = comm.mapping_epoch
+        ctr.counters.coll.num_compiles += 1
+        ctr.counters.coll.num_recompiles += 1
+        log.info(f"persistent collective recompiled onto {self.method!r} "
+                 f"(rank re-placement epoch {comm.mapping_epoch})")
+
     def _needs_recompile(self) -> bool:
         """True when the compiled plan's transport has been quarantined on
         one of the schedule's links — replaying it would ride exactly the
@@ -498,6 +543,11 @@ class PersistentColl:
         if self._active:
             raise RuntimeError("start() on an already-active persistent "
                                "collective (MPI: operation error)")
+        if self._mapping_epoch != self.comm.mapping_epoch:
+            # an applied re-placement invalidated everything mapping-
+            # derived; refresh BEFORE the health check so the breaker
+            # scan below consults the new link set
+            self._refresh_mapping()
         if self._needs_recompile():
             self._compile(recompile=True)
         if self._started:

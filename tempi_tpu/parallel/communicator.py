@@ -73,6 +73,17 @@ class Communicator:
         self.placement: Optional[topo_mod.Placement] = placement
         # dist-graph adjacency per application rank: (sources, destinations)
         self.graph = graph
+        # symmetrized weighted edges {(u, v): bytes} of the dist-graph
+        # adjacency (u < v, application ranks), stashed by
+        # dist_graph_create_adjacent so online re-placement (ISSUE 8;
+        # parallel/replacement.py) can re-run process_mapping without the
+        # application re-declaring its neighborhoods
+        self.graph_edges = None
+        # bumped by each APPLIED rank re-placement; compiled artifacts
+        # that embed the app->library permutation (persistent collective
+        # lowerings) stamp the epoch at compile and recompile when it
+        # moves (the re-placement analog of recompile-on-breaker-open)
+        self.mapping_epoch = 0
         self.parent = parent
         # LRU-bounded by plan.cache_put/_PLAN_CACHE_MAX — insertion order IS
         # the recency order, so it must stay an OrderedDict
@@ -151,17 +162,26 @@ class Communicator:
         data = self._put_global(np.stack(lib_rows))
         return DistBuffer(self, nbytes, data)
 
-    def free(self) -> None:
-        """MPI_Comm_free analog (reference: src/comm_free.cpp) — drops cached
-        plans/topology state and returns staging memory to the slab pool.
-        Takes the progress lock so teardown cannot race a background pump
-        thread still executing a cached plan."""
+    def invalidate_plans(self) -> None:
+        """Drop every cached compiled plan/program and return their staging
+        memory. A rank re-placement epoch calls this (the cached lowerings
+        and exchange plans embed the OLD app->library permutation); safe
+        under the progress RLock the apply path already holds — plans
+        recompile lazily on the next use."""
         with self._progress_lock:
             for plan in self._plan_cache.values():
                 release = getattr(plan, "release_staging", None)
                 if release is not None:  # cache also holds bare jitted fns
                     release()
             self._plan_cache.clear()
+
+    def free(self) -> None:
+        """MPI_Comm_free analog (reference: src/comm_free.cpp) — drops cached
+        plans/topology state and returns staging memory to the slab pool.
+        Takes the progress lock so teardown cannot race a background pump
+        thread still executing a cached plan."""
+        with self._progress_lock:
+            self.invalidate_plans()
             self.freed = True
 
 

@@ -91,6 +91,19 @@ def dist_graph_create_adjacent(comm: Communicator, sources, destinations,
     size = comm.size
     graph = {r: (list(map(int, sources[r])), list(map(int, destinations[r])))
              for r in range(size)}
+    # the symmetrized weighted edge set is built UNCONDITIONALLY (cheap —
+    # one pass over the declared adjacency) and stashed on every returned
+    # communicator: online re-placement (parallel/replacement.py) re-runs
+    # process_mapping on it at epoch boundaries, including for graphs
+    # whose creation-time gate skipped reordering entirely
+    sym = _build_edges(sources, sweights, destinations, dweights, size)
+
+    def _derived(placement) -> Communicator:
+        g = Communicator(comm.devices, placement=placement, graph=graph,
+                         parent=comm)
+        g.graph_edges = dict(sym)
+        return g
+
     method = method if method is not None else envmod.env.placement
 
     # gates mirrored from the reference: env method NONE (:62-69), or a
@@ -104,8 +117,7 @@ def dist_graph_create_adjacent(comm: Communicator, sources, destinations,
                       and method is PlacementMethod.KAHIP)
     if (not reorder or method is PlacementMethod.NONE
             or not (node_movement or torus_movement)):
-        return Communicator(comm.devices, placement=comm.placement,
-                            graph=graph, parent=comm)
+        return _derived(comm.placement)
 
     if method is PlacementMethod.RANDOM:
         res = part_mod.random_partition(comm.num_nodes, size)
@@ -114,20 +126,12 @@ def dist_graph_create_adjacent(comm: Communicator, sources, destinations,
         # hardware hierarchy (partition_kahip_process_mapping.cpp:95-135);
         # here a full rank->slot permutation against the ICI/DCN distance
         # matrix, so the result is a Placement directly
-        sym = _build_edges(sources, sweights, destinations, dweights, size)
         csr = _to_csr(sym, size)
         slot_of, obj = part_mod.process_mapping(
             csr, comm.topology.distance_matrix())
         log.debug(f"dist_graph process mapping objective = {obj}")
-        lib_rank = [int(s) for s in slot_of]
-        app_rank = [0] * size
-        for ar, lib in enumerate(lib_rank):
-            app_rank[lib] = ar
-        placement = Placement(app_rank=app_rank, lib_rank=lib_rank)
-        return Communicator(comm.devices, placement=placement, graph=graph,
-                            parent=comm)
+        return _derived(Placement.from_slot_of(slot_of))
     else:
-        sym = _build_edges(sources, sweights, destinations, dweights, size)
         csr = _to_csr(sym, size)
         res = part_mod.partition(comm.num_nodes, csr)
         log.debug(f"dist_graph partition edge cut = {res.objective}")
@@ -141,12 +145,10 @@ def dist_graph_create_adjacent(comm: Communicator, sources, destinations,
             any(counts[n] > caps[n] for n in range(comm.num_nodes)):
         log.error("partition is unbalanced for the node capacities; "
                   "keeping original placement")
-        return Communicator(comm.devices, placement=comm.placement,
-                            graph=graph, parent=comm)
+        return _derived(comm.placement)
 
-    placement = make_placement(comm.topology, [int(p) for p in res.part])
-    return Communicator(comm.devices, placement=placement, graph=graph,
-                        parent=comm)
+    return _derived(make_placement(comm.topology,
+                                   [int(p) for p in res.part]))
 
 
 def dist_graph_neighbors(comm: Communicator, app_rank: int):

@@ -473,8 +473,19 @@ def _swap_refine(W: np.ndarray, dist: np.ndarray, slot_of: np.ndarray,
     return slot_of, int((W * D).sum() // 2)
 
 
+def _kick_rng(seed: int) -> np.random.Generator:
+    """The iterated-local-search kick stream, derived INDEPENDENTLY of
+    the greedy-start streams: the historical ``seed + 1000`` collides
+    with greedy seed ``seed + s`` whenever a caller passes
+    ``nseeds > 1000``, replaying start #1000's draw sequence as the kick
+    sequence. A spawned SeedSequence child occupies a different region
+    of the seed space than any plain-integer-seeded stream, and is still
+    a pure function of ``seed`` (results stay deterministic per seed)."""
+    return np.random.default_rng(np.random.SeedSequence(seed).spawn(1)[0])
+
+
 def process_mapping(csr: Csr, dist: np.ndarray, seed: int = 0,
-                    nseeds: int = 8):
+                    nseeds: int = 8, extra_starts: Sequence = ()):
     """Hardware-aware rank->slot permutation minimizing
     sum(weight(u,v) * dist[slot(u), slot(v)]) — the analog of the
     reference's strongest placement mode, KaHIP process mapping with
@@ -483,7 +494,12 @@ def process_mapping(csr: Csr, dist: np.ndarray, seed: int = 0,
     with the distance model refined to per-pair ICI torus hops + DCN
     (topology.distance_matrix). Greedy construction + best-improvement swap
     refinement, best of ``nseeds`` starts; a permutation is inherently
-    balanced, so no is_balanced gate is needed.
+    balanced, so no is_balanced gate is needed. ``extra_starts`` adds
+    caller-supplied permutations to the candidate set (the re-placement
+    path seeds the search with the CURRENT mapping, so the returned
+    objective can never be worse than refining what is already
+    installed). ``dist`` may be float (the re-placement live-cost
+    matrix); objectives are truncated to int.
 
     Returns (slot_of, objective): slot_of[app_rank] = library rank."""
     n = csr.n
@@ -493,6 +509,8 @@ def process_mapping(csr: Csr, dist: np.ndarray, seed: int = 0,
     # the identity permutation is always a candidate start, so the returned
     # mapping can never be worse than not reordering at all
     starts = [np.arange(n, dtype=np.int64)]
+    for s0 in extra_starts:
+        starts.append(np.asarray(s0, dtype=np.int64).copy())
     for s in range(nseeds):
         rng = np.random.default_rng(seed + s)
         starts.append(_greedy_place(W, dist, rng))
@@ -507,7 +525,7 @@ def process_mapping(csr: Csr, dist: np.ndarray, seed: int = 0,
     # greedy starts plateau where these kicks still find ~1% on the
     # 32-rank sparse config)
     if n >= 4:
-        r = np.random.default_rng(seed + 1000)
+        r = _kick_rng(seed)
         for _ in range(30):
             s2 = best_slot.copy()
             idx = r.choice(n, 4, replace=False)

@@ -1,0 +1,358 @@
+"""Online topology re-placement: degraded-link-aware rank remapping.
+
+ISSUE 8 tentpole. TEMPI's fourth feature partitions the application's
+communication graph ONCE, at ``reorder=1`` communicator creation
+(``dist_graph_create_adjacent`` -> ``process_mapping``), and never
+revisits the decision — while the rest of this runtime keeps measuring
+reality: per-(link, strategy) EWMA cost (tune/online.py), breaker and
+quarantine state (runtime/health.py). This module closes that loop:
+
+  * :func:`live_cost` composes the static topology distances
+    (``topology.distance_matrix``) with the live evidence — tune's
+    per-link observed-cost ratio as a multiplier, plus a loud-parsed
+    ``TEMPI_REPLACE_PENALTY`` multiplier on links with an OPEN circuit
+    breaker or an active pump quarantine — into the EFFECTIVE cost
+    matrix placement should be minimizing today.
+  * :func:`replace_ranks` (exported as ``api.replace_ranks``) is the
+    explicit epoch-boundary step: re-run ``process_mapping`` on the
+    live-cost matrix (seeded with the CURRENT mapping, so the candidate
+    can never be worse than refining what is installed), and install
+    the new app->library permutation only when the modeled objective
+    improves by at least ``TEMPI_REPLACE_MIN_GAIN`` — hysteresis, so
+    estimator noise cannot thrash the mapping.
+
+Modes (``TEMPI_REPLACE``, loud-parsed in utils/env.py; the tune/
+pattern):
+
+  off     — ``replace_ranks`` is an inert no-op: no evaluation, no
+            counter, no ledger entry. Byte-for-byte the frozen one-shot
+            placement (counter-pinned under test).
+  observe — evaluate and record would-have-remapped decisions (the
+            ledger in :func:`snapshot`, ``replace.decision`` trace
+            events, ``replace.num_observed``) without ever acting.
+  apply   — observe, plus install improving permutations.
+
+The apply step is a ``replace.apply`` fault site firing BEFORE any
+mutation: a raise keeps the frozen mapping — a degraded placement is
+never worse than no placement, mirroring ``process_mapping``'s
+identity-start guarantee. An applied remap bumps the communicator's
+``mapping_epoch`` and drops its compiled-plan cache; persistent
+collective handles stamp the epoch at compile and recompile before
+their next ``start()`` (coll/persistent.py), exactly as the existing
+recompile-on-breaker-open contract replaces quarantined plans.
+
+Epoch-boundary contract (what "epoch boundary" means for the caller):
+no operations in flight on the communicator (``waitall`` everything
+first — an in-flight exchange posted under the old permutation cannot
+be re-addressed), and buffers filled before the remap must be refilled
+after it (``set_rank``/``buffer_from_host`` translate through the
+CURRENT placement). Application-held persistent p2p requests
+(``send_init``) likewise must be re-created across an epoch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs import trace as obstrace
+from ..runtime import faults, health
+from ..tune import online as tune_online
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from . import partition as part_mod
+from .communicator import Communicator
+from .topology import Placement
+
+MODES = ("off", "observe", "apply")
+
+#: Module-level fast-path flag: True iff mode != off. ``replace_ranks``
+#: returns an inert stub without touching counters or state when clear.
+ENABLED = False
+MODE = "off"
+
+_LEDGER_KEEP = 100  # bounded decision ledger (diagnostics, not logs)
+
+_lock = threading.Lock()
+_decisions: list = []
+_decision_count = 0
+_applied_total = 0
+_last_provenance: dict = {}
+_latest_epoch = 0
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the re-placement subsystem. ``mode=None`` reads the parsed
+    env's ``replace_mode`` (so call after ``read_environment``); an
+    explicit mode overrides (test convenience). Clears the decision
+    ledger and provenance — re-placement history is per-session state,
+    like counters."""
+    global ENABLED, MODE, _decision_count, _applied_total
+    global _last_provenance, _latest_epoch
+    if mode is None:
+        mode = getattr(envmod.env, "replace_mode", "off")
+    if mode not in MODES:
+        raise ValueError(f"bad replace mode {mode!r}: want one of {MODES}")
+    with _lock:
+        MODE = mode
+        ENABLED = mode != "off"
+        _decisions.clear()
+        _decision_count = 0
+        _applied_total = 0
+        _last_provenance = {}
+        _latest_epoch = 0
+    if ENABLED:
+        log.debug(f"online re-placement armed: mode={mode} "
+                  f"min_gain={getattr(envmod.env, 'replace_min_gain', 0.05)}"
+                  f" penalty={getattr(envmod.env, 'replace_penalty', 10.0)}")
+
+
+# -- the effective-cost builder ------------------------------------------------
+
+
+def effective_matrix(dist: np.ndarray, ratios: Dict[tuple, float],
+                     penalized, penalty: float) -> np.ndarray:
+    """Pure core: compose the static distance matrix with live evidence.
+    ``ratios`` multiplies each link's distance by its observed cost
+    ratio (tune evidence; >1 repels traffic, <1 attracts it);
+    ``penalized`` links additionally multiply by ``penalty`` (breaker /
+    quarantine evidence — a link can carry both). With NO evidence the
+    STATIC matrix is returned unchanged (the same object — the
+    reduces-exactly property tests/test_replace.py pins)."""
+    if not ratios and not penalized:
+        return dist
+    D = dist.astype(np.float64, copy=True)
+    for (a, b), r in ratios.items():
+        D[a, b] *= r
+        D[b, a] *= r
+    for (a, b) in penalized:
+        D[a, b] *= penalty
+        D[b, a] *= penalty
+    return D
+
+
+def live_cost(comm: Communicator) -> Tuple[np.ndarray, dict]:
+    """The communicator's effective cost matrix and its provenance:
+    which links carry a tune-observed ratio (and from how many samples),
+    which are penalized by an open breaker (with the breaker's age, the
+    ISSUE 8 health satellite) or an active pump quarantine, and the
+    penalty in force. A pump quarantine is COMMUNICATOR-scoped evidence
+    (the wedged serve names no link), so it penalizes every link
+    uniformly — inert for the relative objective the mapping minimizes,
+    but visible here and in the absolute objectives the ledger
+    records."""
+    n = comm.size
+    dist = comm.topology.distance_matrix()
+    penalty = float(getattr(envmod.env, "replace_penalty", 10.0))
+    ratios: Dict[tuple, float] = {}
+    samples: Dict[tuple, int] = {}
+    if tune_online.ENABLED:
+        for lk, (r, cnt) in tune_online.link_cost_ratios().items():
+            if lk[0] < n and lk[1] < n:
+                ratios[lk] = r
+                samples[lk] = cnt
+    open_ages: Dict[tuple, float] = {}
+    if health.TRIPPED:
+        open_ages = {lk: age for lk, age in health.open_links().items()
+                     if lk[0] < n and lk[1] < n}
+    pump_quarantined = bool(getattr(comm, "quarantined", False))
+    penalized = set(open_ages)
+    if pump_quarantined:
+        penalized |= {(a, b) for a in range(n) for b in range(a + 1, n)}
+    D = effective_matrix(dist, ratios, penalized, penalty)
+    prov = dict(
+        penalty=penalty,
+        ratios=[dict(link=list(lk), ratio=float(r),
+                     samples=int(samples[lk]))
+                for lk, r in sorted(ratios.items())],
+        penalized=[dict(link=list(lk), breaker_age_s=float(age))
+                   for lk, age in sorted(open_ages.items())],
+        pump_quarantined=pump_quarantined,
+        static=D is dist,  # no evidence: live == static, byte-for-byte
+    )
+    return D, prov
+
+
+# -- decision + apply ----------------------------------------------------------
+
+
+def _current_slots(comm: Communicator) -> np.ndarray:
+    return np.asarray([comm.library_rank(a) for a in range(comm.size)],
+                      dtype=np.int64)
+
+
+def objectives(comm: Communicator) -> dict:
+    """The CURRENT mapping's objective under the static hop matrix and
+    under the live-cost matrix (benches report both sides of the A/B)."""
+    _require_graph(comm)
+    W = part_mod._dense_weights(_csr(comm))
+    cur = _current_slots(comm)
+    dist = comm.topology.distance_matrix()
+    D, _ = live_cost(comm)
+    return dict(hop=_objective(W, dist, cur), live=_objective(W, D, cur))
+
+
+def _require_graph(comm: Communicator) -> None:
+    if comm.graph is None or comm.graph_edges is None:
+        raise RuntimeError(
+            "replace_ranks: not a dist-graph communicator (no declared "
+            "communication graph to re-place; create one with "
+            "api.dist_graph_create_adjacent)")
+
+
+def _csr(comm: Communicator):
+    from .dist_graph import _to_csr
+    return _to_csr(comm.graph_edges, comm.size)
+
+
+def _objective(W: np.ndarray, D: np.ndarray, slot_of: np.ndarray) -> float:
+    Dm = D[np.ix_(slot_of, slot_of)]
+    return float((W * Dm).sum() / 2.0)
+
+
+def evaluate(comm: Communicator) -> dict:
+    """Build one re-placement decision (pure — nothing installed): the
+    live-cost matrix and provenance, the frozen mapping's objectives,
+    the best candidate ``process_mapping`` finds on the live costs
+    (seeded with the frozen mapping), and the hysteresis verdict."""
+    _require_graph(comm)
+    n = comm.size
+    dist = comm.topology.distance_matrix()
+    D, prov = live_cost(comm)
+    csr = _csr(comm)
+    W = part_mod._dense_weights(csr)
+    cur = _current_slots(comm)
+    frozen_live = _objective(W, D, cur)
+    frozen_hop = _objective(W, dist, cur)
+    slot_of, _ = part_mod.process_mapping(csr, D, extra_starts=(cur,))
+    new = np.asarray(slot_of, dtype=np.int64)
+    new_live = _objective(W, D, new)
+    new_hop = _objective(W, dist, new)
+    min_gain = float(getattr(envmod.env, "replace_min_gain", 0.05))
+    gain = ((frozen_live - new_live) / frozen_live
+            if frozen_live > 0.0 else 0.0)
+    changed = not np.array_equal(new, cur)
+    return dict(
+        mode=MODE, size=n, epoch=int(comm.mapping_epoch),
+        frozen_live=frozen_live, new_live=new_live,
+        frozen_hop=frozen_hop, new_hop=new_hop,
+        gain=float(gain), min_gain=min_gain,
+        mapping_changed=changed,
+        would_apply=bool(changed and gain >= min_gain),
+        slot_of=[int(s) for s in new],
+        provenance=prov,
+    )
+
+
+def _apply_locked_steps(comm: Communicator, slot_of) -> None:
+    """Install ``slot_of`` as the communicator's placement. Caller
+    context: inside ``replace_ranks``'s try block — every raise here
+    (the fault site, the in-flight refusal) keeps the frozen mapping,
+    because nothing mutates until both checks pass."""
+    with comm._progress_lock:
+        if comm._pending:
+            raise RuntimeError(
+                f"replace_ranks: {len(comm._pending)} operation(s) in "
+                "flight on the communicator — re-place at an epoch "
+                "boundary (waitall everything first)")
+        if faults.ENABLED:
+            # BEFORE any mutation: a raise keeps the frozen mapping
+            faults.check("replace.apply")
+        comm.placement = Placement.from_slot_of(slot_of)
+        comm.mapping_epoch += 1
+        # cached exchange plans / schedules / programs embed the old
+        # permutation; persistent-collective handles notice the epoch
+        # bump on their next start() and recompile
+        comm.invalidate_plans()
+
+
+def replace_ranks(comm: Communicator) -> dict:
+    """Epoch-boundary re-placement step (``api.replace_ranks``). Returns
+    the decision record (also appended to the ledger
+    ``api.replace_snapshot`` exposes). Inert with ``TEMPI_REPLACE``
+    unset/off: no evaluation, no counters, no state — the frozen
+    placement is byte-for-byte untouched."""
+    global _decision_count, _applied_total, _last_provenance, _latest_epoch
+    if not ENABLED:
+        return dict(mode="off", applied=False, outcome="off")
+    ctr.counters.replace.num_evaluations += 1
+    dec = evaluate(comm)
+    if obstrace.ENABLED:
+        obstrace.emit("replace.decision", mode=MODE,
+                      gain=dec["gain"], min_gain=dec["min_gain"],
+                      frozen_live=dec["frozen_live"],
+                      new_live=dec["new_live"],
+                      frozen_hop=dec["frozen_hop"],
+                      new_hop=dec["new_hop"],
+                      would_apply=dec["would_apply"],
+                      epoch=dec["epoch"])
+    dec["applied"] = False
+    if not dec["would_apply"]:
+        dec["outcome"] = "held"
+        ctr.counters.replace.num_held += 1
+    elif MODE == "observe":
+        dec["outcome"] = "observed"
+        ctr.counters.replace.num_observed += 1
+        log.info(f"replace (observe): would remap "
+                 f"{dec['size']} ranks — live objective "
+                 f"{dec['frozen_live']:.6g} -> {dec['new_live']:.6g} "
+                 f"(gain {dec['gain']:.1%})")
+    else:
+        try:
+            _apply_locked_steps(comm, dec["slot_of"])
+            dec["applied"] = True
+            dec["outcome"] = "applied"
+            dec["epoch"] = int(comm.mapping_epoch)
+            ctr.counters.replace.num_applied += 1
+            log.info(f"replace: installed new mapping (epoch "
+                     f"{comm.mapping_epoch}) — live objective "
+                     f"{dec['frozen_live']:.6g} -> {dec['new_live']:.6g} "
+                     f"(gain {dec['gain']:.1%}), hop objective "
+                     f"{dec['frozen_hop']:.6g} -> {dec['new_hop']:.6g}")
+            if obstrace.ENABLED:
+                obstrace.emit("replace.applied", epoch=dec["epoch"],
+                              gain=dec["gain"],
+                              new_live=dec["new_live"],
+                              new_hop=dec["new_hop"])
+        except Exception as e:  # noqa: BLE001 — degrade, never worsen
+            # the frozen mapping survives every apply failure (the fault
+            # site and the in-flight refusal both fire before mutation):
+            # a degraded placement is never worse than no placement
+            dec["outcome"] = "failed"
+            dec["error"] = repr(e)[:200]
+            ctr.counters.replace.num_failed += 1
+            log.warn(f"replace: apply failed, frozen mapping kept: {e!r}")
+    with _lock:
+        _decision_count += 1
+        entry = {k: v for k, v in dec.items() if k != "slot_of"}
+        entry["at_monotonic"] = time.monotonic()
+        _decisions.append(entry)
+        del _decisions[:-_LEDGER_KEEP]
+        _last_provenance = dec["provenance"]
+        if dec["applied"]:
+            _applied_total += 1
+            _latest_epoch = max(_latest_epoch, dec["epoch"])
+    return dec
+
+
+def snapshot() -> dict:
+    """Diagnostic snapshot (exported via ``api.replace_snapshot``): mode
+    and knobs, the bounded decision ledger, the latest live-cost
+    provenance, and the latest applied mapping epoch. Pure data — safe
+    to serialize. Callable before init and after finalize (reads
+    empty)."""
+    with _lock:
+        return dict(
+            mode=MODE,
+            min_gain=float(getattr(envmod.env, "replace_min_gain", 0.05)),
+            penalty=float(getattr(envmod.env, "replace_penalty", 10.0)),
+            decisions=_decision_count,
+            applied=_applied_total,
+            mapping_epoch=_latest_epoch,
+            ledger=[dict(d) for d in _decisions],
+            provenance=dict(_last_provenance),
+        )

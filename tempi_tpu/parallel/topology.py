@@ -161,6 +161,18 @@ class Placement:
     app_rank: List[int]
     lib_rank: List[int]
 
+    @classmethod
+    def from_slot_of(cls, slot_of: Sequence[int]) -> "Placement":
+        """Build both translation tables from a ``process_mapping``
+        result (``slot_of[app_rank] = library rank``) — the one shared
+        inversion for the creation-time reorder path (dist_graph) and
+        the online re-placement path (replacement)."""
+        lib_rank = [int(s) for s in slot_of]
+        app_rank = [0] * len(lib_rank)
+        for ar, lib in enumerate(lib_rank):
+            app_rank[lib] = ar
+        return cls(app_rank=app_rank, lib_rank=lib_rank)
+
 
 def make_placement(topo: Topology, node_of_app_rank: Sequence[int]) -> Placement:
     """Greedy node-slot assignment (topology.cpp:97-144): application rank

@@ -97,6 +97,14 @@ SITES = (
                           # regions, so the per-round retry loop can
                           # re-dispatch idempotently; wedge refused —
                           # the round runs under the progress lock)
+    "replace.apply",      # each rank re-placement apply step
+                          # (parallel/replacement.py — fires BEFORE the
+                          # new permutation is installed, so a raise
+                          # keeps the frozen mapping intact: a degraded
+                          # placement is never worse than no placement,
+                          # mirroring process_mapping's identity-start
+                          # guarantee; wedge refused — the apply runs
+                          # under the communicator's progress lock)
     "qos.admit",          # each QoS admission decision at op-post notify
                           # (runtime/progress.notify, armed only while
                           # qos.ENABLED — a raise forces the refusal

@@ -71,6 +71,11 @@ class _Breaker:
     successes: int = 0         # total successes recorded
     state: str = CLOSED
     opened_at: float = 0.0     # monotonic stamp of the last open transition
+    # monotonic stamp of the last state TRANSITION (open/half-open/close);
+    # 0.0 = never transitioned. Snapshot derives age_s from it — the
+    # re-placement hysteresis and quarantine debugging both need "how long
+    # has this breaker been in its current state" (ISSUE 8 satellite)
+    last_transition_at: float = 0.0
     times_opened: int = 0
     last_error: str = ""
     probes: int = 0            # half-open passes granted
@@ -120,6 +125,7 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
             b.opened_at = time.monotonic()
             if opened:
                 b.times_opened += 1
+                b.last_transition_at = b.opened_at
         _recompute_flags_locked()
         consecutive = b.consecutive
     if opened and obstrace.ENABLED:
@@ -152,6 +158,7 @@ def record_success(peer: tuple, strategy: str) -> None:
         if b.state == HALF_OPEN:
             b.state = CLOSED
             closed = True
+            b.last_transition_at = time.monotonic()
             _recompute_flags_locked()
     if closed and obstrace.ENABLED:
         obstrace.emit("breaker.close", link=list(peer), strategy=strategy)
@@ -175,6 +182,7 @@ def allowed(peer: tuple, strategy: str) -> bool:
         if time.monotonic() - b.opened_at >= cooldown:
             b.state = HALF_OPEN
             b.probes += 1
+            b.last_transition_at = time.monotonic()
             _recompute_flags_locked()
             if obstrace.ENABLED:
                 obstrace.emit("breaker.half_open", link=list(peer),
@@ -189,6 +197,24 @@ def state(peer: tuple, strategy: str) -> str:
     with _lock:
         b = _table.get((peer, strategy))
         return b.state if b is not None else CLOSED
+
+
+def open_links() -> Dict[tuple, float]:
+    """Links with at least one OPEN breaker, mapped to the age (monotonic
+    seconds since that breaker opened; the max across strategies when
+    several are open on one link). The re-placement builder's penalty set
+    (parallel/replacement.py): a half-open link is probing, not
+    quarantined, so it is NOT penalized. Callers guard with
+    ``health.TRIPPED`` — a healthy registry has nothing open."""
+    now = time.monotonic()
+    with _lock:
+        out: Dict[tuple, float] = {}
+        for (peer, _s), b in _table.items():
+            if b.state == OPEN:
+                age = now - b.last_transition_at \
+                    if b.last_transition_at else 0.0
+                out[peer] = max(out.get(peer, 0.0), age)
+        return out
 
 
 def note_demotion(peer: tuple, from_strategy: str, to_strategy: str) -> None:
@@ -220,6 +246,12 @@ def snapshot() -> dict:
                 consecutive_failures=b.consecutive, failures=b.failures,
                 successes=b.successes, times_opened=b.times_opened,
                 probes=b.probes, last_error=b.last_error,
+                # monotonic age of the CURRENT state (seconds since the
+                # last transition; 0 for a closed breaker that never
+                # transitioned) — open/half-open duration is what the
+                # re-placement hysteresis and quarantine debugging read
+                age_s=(now - b.last_transition_at
+                       if b.last_transition_at else 0.0),
                 cooldown_remaining_s=(
                     max(0.0, cooldown - (now - b.opened_at))
                     if b.state == OPEN else 0.0)))

@@ -99,6 +99,10 @@ class BinStats:
     rel_err: float = 0.0  # latest |mean - pred| / pred (0 until judged)
     drift_events: int = 0  # stale transitions (flapping is visible)
     last_nbytes: int = 0  # most recent message size in the bin
+    colocated: bool = False  # the link's locality class (same node?) —
+    # topological, so constant per link; the peer-relative ratio basis
+    # (link_cost_ratios) compares only within a class, or a healthy
+    # off-node link would read as degraded next to its ICI peers
 
 
 _lock = threading.Lock()
@@ -258,6 +262,7 @@ def record(link: tuple, strategy: str, nbytes: int, block: int,
             st.var_s2 = (1.0 - _ALPHA) * (st.var_s2 + _ALPHA * d * d)
         st.count += 1
         st.last_nbytes = int(nbytes)
+        st.colocated = bool(colocated)
         if pred < math.inf:
             st.pred_s = (pred if st.pred_n == 0
                          else st.pred_s + _ALPHA * (pred - st.pred_s))
@@ -348,6 +353,54 @@ def bin_stats(link: tuple, b: int, strategies) -> Dict[str, Optional[tuple]]:
         return out
 
 
+def link_cost_ratios() -> Dict[tuple, Tuple[float, int]]:
+    """Per-link live-cost multipliers for the re-placement builder
+    (ISSUE 8; parallel/replacement.py): ``{link: (ratio, samples)}``.
+
+    Basis per (link, strategy, size-bin) estimator: the observed EWMA
+    divided by the swept prediction EWMA when the sweep measured one
+    (the same observed-vs-predicted axis the drift verdict judges);
+    otherwise divided by the MEDIAN observed mean of the OTHER links of
+    the same LOCALITY CLASS in the same (strategy, bin) — the
+    peer-relative form keeps the builder usable on unmeasured systems
+    (CPU meshes, where every prediction is +inf), pricing a link
+    relative to the fleet it competes with. Peer groups never mix
+    colocated and off-node links: DCN is legitimately slower than ICI,
+    and a class-blind median would read every healthy off-node link as
+    degraded (the distance matrix already prices the locality gap —
+    the ratio must carry only the anomaly). Estimators with neither
+    basis are skipped. Per link, the per-bin ratios aggregate by
+    count-weighted mean; links with fewer than TEMPI_TUNE_MIN_SAMPLES
+    total samples are omitted (the same noise floor the drift verdict
+    uses — a two-sample fluke must not move a rank mapping). Ratios
+    floor at 0.01 so a pathological estimator cannot zero a link's cost
+    out of the placement objective."""
+    with _lock:
+        groups: Dict[Tuple[str, int, bool], list] = {}
+        for (lk, s, b), st in _table.items():
+            if st.count > 0 and st.mean_s > 0.0:
+                groups.setdefault((s, b, st.colocated), []).append((lk, st))
+        num: Dict[tuple, float] = {}
+        den: Dict[tuple, int] = {}
+        for entries in groups.values():
+            for lk, st in entries:
+                if st.pred_n > 0 and st.pred_s > 0.0:
+                    base = st.pred_s
+                else:
+                    peers = sorted(m.mean_s for l2, m in entries
+                                   if l2 != lk)
+                    if not peers:
+                        continue
+                    base = peers[len(peers) // 2]
+                    if base <= 0.0:
+                        continue
+                ratio = st.mean_s / base
+                num[lk] = num.get(lk, 0.0) + ratio * st.count
+                den[lk] = den.get(lk, 0) + st.count
+        return {lk: (max(num[lk] / n, 0.01), n)
+                for lk, n in den.items() if n >= _min_samples}
+
+
 def note_adoption(entry: dict) -> None:
     """Record that an adapt-mode re-rank changed (or explored away from)
     the swept model's winner — the audit trail ``api.tune_snapshot``
@@ -436,7 +489,7 @@ def save() -> Optional[str]:
         bins = [dict(link=list(lk), strategy=s, bin=b, count=st.count,
                      mean_s=st.mean_s, var_s2=st.var_s2, pred_s=st.pred_s,
                      pred_n=st.pred_n, stale=st.stale,
-                     last_nbytes=st.last_nbytes)
+                     last_nbytes=st.last_nbytes, colocated=st.colocated)
                 for (lk, s, b), st in _table.items()]
         adoptions = _adopt_total
         # hash UNDER the same lock as the generation check: a concurrent
@@ -486,7 +539,8 @@ def load() -> bool:
                               pred_s=float(d["pred_s"]),
                               pred_n=int(d["pred_n"]),
                               stale=bool(d["stale"]),
-                              last_nbytes=int(d.get("last_nbytes", 0)))
+                              last_nbytes=int(d.get("last_nbytes", 0)),
+                              colocated=bool(d.get("colocated", False)))
                 if st.pred_s > 0 and st.pred_n:
                     st.rel_err = abs(st.mean_s - st.pred_s) / st.pred_s
                 key = (tuple(int(r) for r in d["link"]),

@@ -100,6 +100,19 @@ class QosCounters:
 
 
 @dataclass
+class ReplaceCounters:
+    # online topology re-placement (ISSUE 8; parallel/replacement.py):
+    # pinned at zero with TEMPI_REPLACE unset — the counter-based
+    # byte-for-byte guard that the off path decides nothing
+    num_evaluations: int = 0  # replace_ranks calls that built a decision
+    num_applied: int = 0      # decisions that installed a new mapping
+    num_observed: int = 0     # observe-mode would-have-applied decisions
+    num_held: int = 0         # hysteresis: gain below TEMPI_REPLACE_MIN_GAIN
+    num_failed: int = 0       # apply aborted (fault/in-flight ops);
+                              # the frozen mapping was kept
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -124,6 +137,7 @@ class Counters:
     coll: CollCounters = field(default_factory=CollCounters)
     plan: PlanCacheCounters = field(default_factory=PlanCacheCounters)
     qos: QosCounters = field(default_factory=QosCounters)
+    replace: ReplaceCounters = field(default_factory=ReplaceCounters)
 
     def as_dict(self) -> dict:
         out = {}

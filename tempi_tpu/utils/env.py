@@ -138,10 +138,36 @@ and the README "Multi-tenant QoS" section):
                          round-robin), so no weight choice can starve a
                          class in either direction
 
-All resilience, observability, tuning, persistent-collective, and QoS
-knobs parse LOUDLY (a typo raises at init rather than silently reverting
-to the hang/die/fly-blind/frozen-model/head-of-line-blocked behavior the
-knob exists to prevent).
+Online topology re-placement knobs (ISSUE 8; see parallel/replacement.py
+and the README "Online re-placement" section):
+  TEMPI_REPLACE        = off | observe | apply — epoch-boundary rank
+                         re-placement against the LIVE cost of each link
+                         (default off = api.replace_ranks() is an inert
+                         no-op; placement stays the one-shot decision
+                         frozen at dist_graph creation, counter-pinned).
+                         ``observe`` evaluates the live-cost mapping and
+                         records would-have-remapped decisions
+                         (api.replace_snapshot(), replace.decision trace
+                         events) without ever acting; ``apply``
+                         additionally installs the improved permutation
+                         and recompiles cached persistent-collective
+                         plans before their next start.
+  TEMPI_REPLACE_MIN_GAIN relative modeled improvement
+                         (frozen - candidate) / frozen the candidate
+                         mapping must reach before ``apply`` acts — the
+                         hysteresis that keeps estimator noise from
+                         thrashing the mapping (default 0.05)
+  TEMPI_REPLACE_PENALTY  live-cost multiplier on links with an OPEN
+                         circuit breaker or an active pump quarantine
+                         (default 10; values below 1 rejected — a
+                         sub-unit penalty would ATTRACT traffic onto
+                         the degraded link)
+
+All resilience, observability, tuning, persistent-collective, QoS, and
+re-placement knobs parse LOUDLY (a typo raises at init rather than
+silently reverting to the hang/die/fly-blind/frozen-model/
+head-of-line-blocked/frozen-placement behavior the knob exists to
+prevent).
 """
 
 from __future__ import annotations
@@ -277,6 +303,10 @@ class Environment:
     qos_queue_depth: int = 256     # per-class pump-wakeup lane bound
     qos_weights: dict = field(
         default_factory=lambda: {"latency": 4, "default": 2, "bulk": 1})
+    # online topology re-placement (ISSUE 8) — see parallel/replacement.py
+    replace_mode: str = "off"      # off | observe | apply
+    replace_min_gain: float = 0.05  # hysteresis: modeled relative gain
+    replace_penalty: float = 10.0   # live-cost multiplier on degraded links
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -510,6 +540,31 @@ class Environment:
                 weights[cls] = w
         e.qos_weights = weights
 
+        # re-placement knobs parse loudly too: a typo'd TEMPI_REPLACE
+        # silently staying off would freeze the placement in the one
+        # deployment that asked it to heal around a degraded link
+        rp = (getenv("TEMPI_REPLACE") or "off").lower()
+        if rp not in ("off", "observe", "apply"):
+            raise ValueError(
+                f"bad TEMPI_REPLACE={rp!r}: want off | observe | apply")
+        e.replace_mode = rp
+        e.replace_min_gain = _float_env("TEMPI_REPLACE_MIN_GAIN", 0.05,
+                                        unit="relative-gain ratio")
+        v = getenv("TEMPI_REPLACE_PENALTY")
+        try:
+            pen = float(v) if v else 10.0
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_REPLACE_PENALTY={v!r}: want a multiplier "
+                ">= 1") from exc
+        if pen < 1.0:
+            # a penalty below 1 DISCOUNTS degraded links, steering the
+            # re-placement toward the very hardware it should avoid
+            raise ValueError(
+                f"bad TEMPI_REPLACE_PENALTY={v!r}: want a multiplier "
+                ">= 1 (values below 1 reward degraded links)")
+        e.replace_penalty = pen
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -536,6 +591,9 @@ class Environment:
             e.tune_mode = "off"
             # ...and the class scheduler: the bail-out runs no pump
             e.qos_default = ""
+            # ...and re-placement: "no placement remap" is the bail-out's
+            # explicit contract, one-shot AND online
+            e.replace_mode = "off"
         return e
 
 

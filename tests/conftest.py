@@ -39,6 +39,10 @@ def pytest_configure(config):
         "markers",
         "qos: multi-tenant QoS scheduler tests (the <30s smoke is "
         "`pytest -m qos`)")
+    config.addinivalue_line(
+        "markers",
+        "replace: online topology re-placement tests (the <30s smoke is "
+        "`pytest -m replace`)")
 
 
 @pytest.fixture(autouse=True)
@@ -48,6 +52,7 @@ def _reset_globals():
     into the next test — release() also frees any still-blocked
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
+    from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import faults, health, qos
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env
@@ -57,15 +62,17 @@ def _reset_globals():
     obstrace.configure()
     tune_online.configure()
     qos.configure()
+    replacement.configure()
     counters.init()
     health.reset()
     yield
     faults.reset()
     # breaker state and quarantine history must not leak across tests any
     # more than an armed fault spec may — nor may a test's recorded trace
-    # events, its armed recorder mode, its learned tune estimators, or an
-    # api-armed QoS scheduler
+    # events, its armed recorder mode, its learned tune estimators, an
+    # api-armed QoS scheduler, or an armed re-placement mode's ledger
     health.reset()
     obstrace.configure("off")
     tune_online.configure("off")
     qos.disarm()
+    replacement.configure("off")

@@ -1,0 +1,543 @@
+"""Online topology re-placement tests (ISSUE 8; parallel/replacement.py).
+
+The `-m replace` selection is the <30s smoke the verify skill runs: loud
+knob parsing, the pure effective-cost builder (identity reduction and
+penalty monotonicity), the off/observe byte-for-byte pins
+(counter-pinned), the seeded chaos acceptance story — degrading one link
+shifts the mapping and improves both the hop objective and the measured
+exchange time versus the frozen mapping — the `replace.apply` fault site
+(dual-marked ``faults`` so it rides the chaos smoke), the
+persistent-collective recompile-on-epoch contract, and the ISSUE 8
+satellites (kick-rng independence, breaker age, tune link ratios).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import partition as pm
+from tempi_tpu.parallel import replacement
+from tempi_tpu.runtime import health
+from tempi_tpu.tune import online as tune_online
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.replace
+
+RING_ORDER = [0, 3, 5, 1, 7, 2, 6, 4]
+
+
+def _ring_graph(order, w):
+    """A weighted directed ring over ``order``: succ map plus the
+    adjacency-list arguments dist_graph_create_adjacent takes."""
+    n = len(order)
+    succ = {order[i]: order[(i + 1) % n] for i in range(n)}
+    sources = [[k for k, v in succ.items() if v == r] for r in range(n)]
+    dests = [[succ[r]] for r in range(n)]
+    ws = [[w] for _ in range(n)]
+    return succ, sources, dests, ws
+
+
+def _ring_csr(order, w=100):
+    n = len(order)
+    edges = {}
+    for i in range(n):
+        u, v = order[i], order[(i + 1) % n]
+        edges[(min(u, v), max(u, v))] = w
+    adj = [[] for _ in range(n)]
+    for (u, v), ww in edges.items():
+        adj[u].append((v, ww))
+        adj[v].append((u, ww))
+    xadj, adjncy, adjwgt = [0], [], []
+    for r in range(n):
+        for v, ww in sorted(adj[r]):
+            adjncy.append(v)
+            adjwgt.append(ww)
+        xadj.append(len(adjncy))
+    return pm.Csr(np.array(xadj, np.int64), np.array(adjncy, np.int64),
+                  np.array(adjwgt, np.int64))
+
+
+def _torus_dist(shape=(4, 2)):
+    from tempi_tpu.parallel.topology import Topology
+    n = int(np.prod(shape))
+    coords = [tuple(map(int, np.unravel_index(i, shape))) for i in range(n)]
+    return Topology([0] * n, [list(range(n))], coords=coords,
+                    torus_dims=shape).distance_matrix()
+
+
+def _traffic_across(csr, slot_of, link):
+    """Bytes the mapping places across the physical ``link`` slot pair."""
+    W = pm._dense_weights(csr)
+    t = 0
+    for u in range(csr.n):
+        for v in range(u + 1, csr.n):
+            if W[u, v] and {int(slot_of[u]), int(slot_of[v])} == set(link):
+                t += int(W[u, v])
+    return t
+
+
+def _open_breaker(link, strategy="device"):
+    for _ in range(max(1, envmod.env.breaker_threshold)):
+        health.record_failure(link, strategy, error="test degradation")
+
+
+def _degraded_ring_comm(monkeypatch, mode, extra_env=()):
+    """The shared chaos setup: simulated 4x2 ICI torus, a shuffled ring
+    graph frozen at the IDENTITY mapping (reorder=False — the stale
+    one-shot decision), and one degraded link (open breaker) that the
+    frozen mapping routes heavy traffic across."""
+    monkeypatch.setenv("TEMPI_TORUS", "4x2")
+    if mode:
+        monkeypatch.setenv("TEMPI_REPLACE", mode)
+    for k, v in extra_env:
+        monkeypatch.setenv(k, v)
+    envmod.read_environment()
+    comm = api.init()
+    nb = 4096
+    succ, sources, dests, ws = _ring_graph(RING_ORDER, nb)
+    g = api.dist_graph_create_adjacent(comm, sources, dests, sweights=ws,
+                                       dweights=ws, reorder=False)
+    assert g.placement is None and g.graph_edges  # frozen identity mapping
+    # ring edge (0, 3) rides lib link (0, 3) under the identity mapping
+    _open_breaker((0, 3))
+    return g, succ, nb
+
+
+# -- knobs ---------------------------------------------------------------------
+
+
+def test_replace_knob_parsing_loud(monkeypatch):
+    monkeypatch.setenv("TEMPI_REPLACE", "bogus")
+    with pytest.raises(ValueError, match="TEMPI_REPLACE"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_REPLACE", "observe")
+    monkeypatch.setenv("TEMPI_REPLACE_MIN_GAIN", "-0.5")
+    with pytest.raises(ValueError, match="TEMPI_REPLACE_MIN_GAIN"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_REPLACE_MIN_GAIN", "0.1")
+    monkeypatch.setenv("TEMPI_REPLACE_PENALTY", "0.5")
+    with pytest.raises(ValueError, match="TEMPI_REPLACE_PENALTY"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_REPLACE_PENALTY", "abc")
+    with pytest.raises(ValueError, match="TEMPI_REPLACE_PENALTY"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_REPLACE_PENALTY", "25")
+    e = envmod.read_environment()
+    assert (e.replace_mode, e.replace_min_gain, e.replace_penalty) == \
+        ("observe", 0.1, 25.0)
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    monkeypatch.setenv("TEMPI_REPLACE", "apply")
+    assert envmod.read_environment().replace_mode == "off"
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="replace mode"):
+        replacement.configure("bogus")
+
+
+# -- the effective-cost builder ------------------------------------------------
+
+
+def test_effective_matrix_identity_without_evidence():
+    dist = _torus_dist()
+    out = replacement.effective_matrix(dist, {}, set(), 10.0)
+    assert out is dist  # byte-for-byte: the SAME object
+
+
+def test_effective_matrix_composes_ratio_and_penalty():
+    dist = _torus_dist()
+    out = replacement.effective_matrix(dist, {(0, 1): 3.0}, {(0, 1), (2, 5)},
+                                       10.0)
+    assert out is not dist
+    assert out[0, 1] == dist[0, 1] * 30.0 == out[1, 0]  # ratio x penalty
+    assert out[2, 5] == dist[2, 5] * 10.0 == out[5, 2]
+    mask = np.ones_like(dist, dtype=bool)
+    for a, b in ((0, 1), (1, 0), (2, 5), (5, 2)):
+        mask[a, b] = False
+    np.testing.assert_array_equal(out[mask], dist[mask].astype(float))
+
+
+def test_penalty_monotonically_reduces_traffic_across_link():
+    """ISSUE 8 satellite property: raising the penalty on one link must
+    never INCREASE the traffic the optimized mapping places across it."""
+    dist = _torus_dist()
+    csr = _ring_csr(RING_ORDER, w=100)
+    # a link the unpenalized mapping actually uses, so there is traffic
+    # to push away
+    base, _ = pm.process_mapping(csr, dist)
+    link = None
+    for u in range(8):
+        for v in range(u + 1, 8):
+            if _traffic_across(csr, base, (u, v)):
+                link = (u, v)
+                break
+        if link:
+            break
+    assert link is not None
+    traffics = []
+    for pen in (1.0, 5.0, 50.0, 500.0):
+        D = replacement.effective_matrix(dist, {}, {link}, pen)
+        slot_of, _ = pm.process_mapping(csr, D)
+        traffics.append(_traffic_across(csr, slot_of, link))
+    assert traffics == sorted(traffics, reverse=True), traffics
+    assert traffics[-1] < traffics[0]  # the penalty actually repelled it
+
+
+def test_ratio_evidence_repels_traffic_like_penalty():
+    dist = _torus_dist()
+    csr = _ring_csr(RING_ORDER, w=100)
+    base, _ = pm.process_mapping(csr, dist)
+    link = next((u, v) for u in range(8) for v in range(u + 1, 8)
+                if _traffic_across(csr, base, (u, v)))
+    D = replacement.effective_matrix(dist, {link: 200.0}, set(), 10.0)
+    slot_of, _ = pm.process_mapping(csr, D)
+    assert _traffic_across(csr, slot_of, link) \
+        < _traffic_across(csr, base, link) or \
+        _traffic_across(csr, base, link) == 0
+
+
+def test_live_cost_reduces_to_static_and_holds_mapping(monkeypatch):
+    """With no tune observations and no open breakers the live-cost
+    matrix IS the static distance matrix, and replace_ranks holds the
+    creation-time mapping (hysteresis: nothing improved)."""
+    monkeypatch.setenv("TEMPI_TORUS", "4x2")
+    monkeypatch.setenv("TEMPI_PLACEMENT_KAHIP", "1")
+    monkeypatch.setenv("TEMPI_REPLACE", "apply")
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        _, sources, dests, ws = _ring_graph(RING_ORDER, 100)
+        g = api.dist_graph_create_adjacent(comm, sources, dests,
+                                           sweights=ws, dweights=ws,
+                                           reorder=True)
+        assert g.placement is not None
+        before = list(g.placement.lib_rank)
+        D, prov = replacement.live_cost(g)
+        assert prov["static"] and not prov["ratios"] \
+            and not prov["penalized"]
+        np.testing.assert_array_equal(D, g.topology.distance_matrix())
+        dec = api.replace_ranks(g)
+        assert not dec["applied"] and dec["outcome"] == "held"
+        assert g.placement.lib_rank == before and g.mapping_epoch == 0
+    finally:
+        api.finalize()
+
+
+# -- mode pins -----------------------------------------------------------------
+
+
+def test_off_mode_is_inert_and_counter_pinned(monkeypatch):
+    g, _, _ = _degraded_ring_comm(monkeypatch, mode=None)
+    try:
+        dec = api.replace_ranks(g)
+        assert dec == dict(mode="off", applied=False, outcome="off")
+        assert g.placement is None and g.mapping_epoch == 0
+        snap = api.counters_snapshot()["replace"]
+        assert all(v == 0 for v in snap.values()), snap
+        assert api.replace_snapshot()["decisions"] == 0
+    finally:
+        api.finalize()
+
+
+def test_observe_mode_records_without_acting(monkeypatch):
+    g, _, _ = _degraded_ring_comm(monkeypatch, mode="observe")
+    try:
+        dec = api.replace_ranks(g)
+        assert dec["would_apply"] and not dec["applied"]
+        assert dec["outcome"] == "observed"
+        assert g.placement is None and g.mapping_epoch == 0  # untouched
+        snap = api.counters_snapshot()["replace"]
+        assert snap["num_evaluations"] == 1 and snap["num_observed"] == 1
+        assert snap["num_applied"] == 0
+        rsnap = api.replace_snapshot()
+        assert rsnap["decisions"] == 1 and rsnap["applied"] == 0
+        assert rsnap["ledger"][0]["outcome"] == "observed"
+        assert rsnap["provenance"]["penalized"], "open breaker not in " \
+            "the live-cost provenance"
+        json.dumps(rsnap)  # the snapshot must stay serializable
+    finally:
+        api.finalize()
+
+
+# -- the acceptance story ------------------------------------------------------
+
+
+def _timed_ring_exchange(g, succ, nb, degraded_link, per_byte_s):
+    """One full ring exchange, wall-clocked, with the degradation
+    harness charging simulated wire time for every byte the CURRENT
+    mapping routes across the degraded link (a CPU mesh is physically
+    uniform, so the degraded link's cost is modeled by the same harness
+    that degraded it). Verifies delivery before returning."""
+    size = g.size
+    ty = dt.contiguous(nb, dt.BYTE)
+    sbuf = g.buffer_from_host([np.full(nb, r, np.uint8)
+                               for r in range(size)])
+    rbuf = g.alloc(nb)
+    t0 = time.perf_counter()
+    reqs = []
+    for r in range(size):
+        reqs.append(api.isend(g, r, sbuf, succ[r], ty))
+        reqs.append(api.irecv(g, succ[r], rbuf, r, ty))
+    api.waitall(reqs)
+    crossed = sum(w for (u, v), w in g.graph_edges.items()
+                  if {g.library_rank(u), g.library_rank(v)}
+                  == set(degraded_link))
+    time.sleep(crossed * per_byte_s)
+    elapsed = time.perf_counter() - t0
+    for r in range(size):
+        np.testing.assert_array_equal(rbuf.get_rank(succ[r]),
+                                      np.full(nb, r, np.uint8))
+    return elapsed
+
+
+def test_apply_shifts_mapping_and_improves_objectives(monkeypatch):
+    """ROADMAP item 3's acceptance demo: degrading one link makes
+    api.replace_ranks() shift the mapping, and both the hop objective
+    and the measured exchange time improve versus the frozen mapping."""
+    g, succ, nb = _degraded_ring_comm(monkeypatch, mode="apply")
+    try:
+        link = (0, 3)
+        csr = _ring_csr(RING_ORDER, w=nb)
+        frozen_traffic = _traffic_across(csr, np.arange(8), link)
+        assert frozen_traffic > 0  # the frozen mapping rides the bad link
+        # warm the exchange plans so compile time doesn't pollute the A/B
+        _timed_ring_exchange(g, succ, nb, link, 0.0)
+        t_frozen = _timed_ring_exchange(g, succ, nb, link, 1e-4)
+        dec = api.replace_ranks(g)
+        assert dec["applied"] and dec["outcome"] == "applied"
+        assert g.placement is not None and g.mapping_epoch == 1
+        assert sorted(g.placement.lib_rank) == list(range(8))
+        # both objectives improve vs the frozen (identity) mapping
+        assert dec["new_live"] < dec["frozen_live"]
+        assert dec["new_hop"] < dec["frozen_hop"]
+        new_slots = np.asarray([g.library_rank(a) for a in range(8)])
+        assert _traffic_across(csr, new_slots, link) < frozen_traffic
+        t_replaced = _timed_ring_exchange(g, succ, nb, link, 1e-4)
+        assert t_replaced < t_frozen, (t_replaced, t_frozen)
+        snap = api.counters_snapshot()["replace"]
+        assert snap["num_applied"] == 1
+        assert api.replace_snapshot()["mapping_epoch"] == 1
+    finally:
+        api.finalize()
+
+
+def test_apply_refuses_inflight_ops_and_keeps_mapping(monkeypatch):
+    g, succ, nb = _degraded_ring_comm(monkeypatch, mode="apply")
+    try:
+        ty = dt.contiguous(nb, dt.BYTE)
+        sbuf = g.buffer_from_host([np.full(nb, r, np.uint8)
+                                   for r in range(8)])
+        rbuf = g.alloc(nb)
+        rs = api.isend(g, 0, sbuf, succ[0], ty)  # unmatched: stays pending
+        dec = api.replace_ranks(g)
+        assert dec["outcome"] == "failed" and not dec["applied"]
+        assert "in flight" in dec["error"] and g.placement is None
+        assert api.counters_snapshot()["replace"]["num_failed"] == 1
+        rr = api.irecv(g, succ[0], rbuf, 0, ty)
+        api.waitall([rs, rr])
+        dec = api.replace_ranks(g)  # epoch boundary reached: now applies
+        assert dec["applied"] and g.mapping_epoch == 1
+    finally:
+        api.finalize()
+
+
+@pytest.mark.faults
+def test_apply_fault_keeps_frozen_mapping(monkeypatch):
+    """The replace.apply chaos variant: an injected raise at the apply
+    site fires BEFORE any mutation, so the frozen mapping survives and
+    traffic still routes; disarming the fault lets the next epoch
+    boundary apply cleanly."""
+    from tempi_tpu.runtime import faults
+    g, succ, nb = _degraded_ring_comm(
+        monkeypatch, mode="apply",
+        extra_env=(("TEMPI_FAULTS", "replace.apply:raise:1:7"),))
+    try:
+        dec = api.replace_ranks(g)
+        assert dec["outcome"] == "failed" and not dec["applied"]
+        assert "injected fault at replace.apply" in dec["error"]
+        assert g.placement is None and g.mapping_epoch == 0
+        assert api.counters_snapshot()["replace"]["num_failed"] == 1
+        # degraded placement, not a broken one: the exchange still works
+        _timed_ring_exchange(g, succ, nb, (0, 3), 0.0)
+        faults.configure("")
+        dec = api.replace_ranks(g)
+        assert dec["applied"] and g.mapping_epoch == 1
+    finally:
+        api.finalize()
+
+
+def test_wedge_refused_at_replace_apply():
+    from tempi_tpu.runtime import faults
+    with pytest.raises(faults.FaultSpecError, match="wedge"):
+        faults.configure("replace.apply:wedge:1:1")
+
+
+def test_applied_remap_recompiles_persistent_collective(monkeypatch):
+    """Acceptance: an applied remap recompiles persistent alltoallv
+    handles before their next start — and the replayed collective
+    delivers the right bytes under the NEW permutation."""
+    g, succ, nb = _degraded_ring_comm(monkeypatch, mode="apply")
+    try:
+        size = g.size
+        counts = np.zeros((size, size), np.int64)
+        for r in range(size):
+            counts[r, succ[r]] = nb
+        zeros = np.zeros((size, size), np.int64)
+
+        def fill(buf):
+            for r in range(size):
+                buf.set_rank(r, np.full(nb, r + 1, np.uint8))
+
+        sb = g.alloc(nb)
+        rb = g.alloc(nb)
+        fill(sb)
+        pc = api.alltoallv_init(g, sb, counts, zeros, rb, counts.T, zeros)
+        pc.start()
+        pc.wait()
+        for r in range(size):
+            np.testing.assert_array_equal(rb.get_rank(succ[r]),
+                                          np.full(nb, r + 1, np.uint8))
+        before = api.counters_snapshot()["coll"]
+        dec = api.replace_ranks(g)
+        assert dec["applied"] and g.mapping_epoch == 1
+        fill(sb)  # epoch-boundary contract: refill buffers after a remap
+        pc.start()  # must recompile against the new permutation first
+        pc.wait()
+        after = api.counters_snapshot()["coll"]
+        assert after["num_recompiles"] == before["num_recompiles"] + 1
+        assert after["num_compiles"] == before["num_compiles"] + 1
+        for r in range(size):
+            np.testing.assert_array_equal(rb.get_rank(succ[r]),
+                                          np.full(nb, r + 1, np.uint8))
+        pc.free()
+    finally:
+        api.finalize()
+
+
+# -- satellites ----------------------------------------------------------------
+
+
+def test_kick_rng_independent_and_deterministic():
+    """ISSUE 8 satellite: the iterated-local-search kick stream must not
+    collide with the greedy-start streams (`seed + 1000` did, for
+    nseeds > 1000) and must stay deterministic per seed."""
+    seq = pm._kick_rng(0).random(8)
+    np.testing.assert_array_equal(seq, pm._kick_rng(0).random(8))
+    # the OLD stream (the collision with greedy start #1000's seed)
+    assert not np.allclose(seq, np.random.default_rng(1000).random(8))
+    # and no collision with any plain greedy-start stream
+    assert not any(np.allclose(seq, np.random.default_rng(s).random(8))
+                   for s in range(64))
+    csr = _ring_csr(RING_ORDER)
+    dist = _torus_dist()
+    a_slot, a_obj = pm.process_mapping(csr, dist, seed=0, nseeds=1001)
+    b_slot, b_obj = pm.process_mapping(csr, dist, seed=0, nseeds=1001)
+    assert a_obj == b_obj and list(a_slot) == list(b_slot)
+    assert sorted(a_slot) == list(range(8))
+
+
+def test_breaker_snapshot_age_is_monotonic(monkeypatch):
+    """ISSUE 8 satellite: health_snapshot reports how long each breaker
+    has been in its current state (monotonic seconds since the last
+    transition), and a transition resets the clock."""
+    monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0.15")
+    envmod.read_environment()
+    _open_breaker((0, 1))
+
+    def entry():
+        (b,) = api.health_snapshot()["breakers"]
+        return b
+
+    b = entry()
+    assert b["state"] == "open" and b["age_s"] >= 0.0
+    age0 = b["age_s"]
+    time.sleep(0.05)
+    assert entry()["age_s"] > age0
+    time.sleep(0.15)  # past the cooldown: the next query half-opens
+    assert health.allowed((0, 1), "device")
+    b = entry()
+    assert b["state"] == "half-open"
+    assert b["age_s"] < 0.1  # the transition reset the age clock
+    health.record_success((0, 1), "device")
+    assert entry()["state"] == "closed"
+
+
+def test_link_cost_ratios_peer_relative_and_noise_floored():
+    """ISSUE 8 satellite coverage for the builder's tune leg: on an
+    unmeasured system (every swept prediction +inf) the per-link ratio
+    prices a link against its peers, and links under the sample floor
+    are omitted."""
+    tune_online.configure("observe")
+    slow, fasts = (0, 1), [(2, 3), (4, 5), (6, 7)]
+    for _ in range(12):
+        tune_online.record(slow, "device", 1024, 1024, True, True, 1e-2)
+        for lk in fasts:
+            tune_online.record(lk, "device", 1024, 1024, True, True, 1e-4)
+    for _ in range(3):  # below TEMPI_TUNE_MIN_SAMPLES (default 10)
+        tune_online.record((0, 7), "device", 1024, 1024, True, True, 1e-2)
+    ratios = tune_online.link_cost_ratios()
+    assert (0, 7) not in ratios  # noise floor
+    r_slow, n_slow = ratios[slow]
+    assert r_slow > 10 and n_slow == 12
+    for lk in fasts:
+        assert ratios[lk][0] <= 1.0
+
+
+def test_link_cost_ratios_never_mix_locality_classes():
+    """Peer baselines compare within a locality class: DCN is
+    legitimately slower than ICI (the distance matrix already prices
+    that), so uniformly-slower-but-healthy off-node links must NOT read
+    as degraded next to colocated peers — only a link anomalous within
+    its own class carries a ratio away from 1."""
+    tune_online.configure("observe")
+    for _ in range(12):
+        for lk in ((0, 1), (2, 3)):       # healthy ICI links
+            tune_online.record(lk, "device", 1024, 1024, True, True, 1e-4)
+        for lk in ((0, 4), (1, 5), (2, 6)):  # healthy (slower) DCN links
+            tune_online.record(lk, "device", 1024, 1024, True, False, 1e-3)
+    ratios = tune_online.link_cost_ratios()
+    for lk in ((0, 4), (1, 5), (2, 6)):
+        assert ratios[lk][0] == pytest.approx(1.0), \
+            f"healthy off-node link {lk} mispriced as {ratios[lk][0]}"
+    # an actually-degraded off-node link still stands out in its class
+    for _ in range(12):
+        tune_online.record((3, 7), "device", 1024, 1024, True, False, 1e-1)
+    assert tune_online.link_cost_ratios()[(3, 7)][0] > 10
+
+
+def test_live_cost_ratios_feed_the_decision(monkeypatch):
+    """tune evidence alone (no breaker) shifts the mapping: the degraded
+    link's observed cost repels its traffic at the next epoch."""
+    monkeypatch.setenv("TEMPI_TORUS", "4x2")
+    monkeypatch.setenv("TEMPI_REPLACE", "apply")
+    monkeypatch.setenv("TEMPI_TUNE", "observe")
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        nb = 4096
+        _, sources, dests, ws = _ring_graph(RING_ORDER, nb)
+        g = api.dist_graph_create_adjacent(comm, sources, dests,
+                                           sweights=ws, dweights=ws,
+                                           reorder=False)
+        link = (0, 3)  # carries ring edge (0,3) under the identity map
+        for _ in range(12):
+            tune_online.record(link, "device", nb, nb, True, True, 5e-2)
+            for other in ((1, 7), (2, 6), (4, 5)):
+                tune_online.record(other, "device", nb, nb, True, True,
+                                   1e-4)
+        D, prov = replacement.live_cost(g)
+        assert not prov["static"] and prov["ratios"]
+        assert D[0, 3] > g.topology.distance_matrix()[0, 3]
+        dec = api.replace_ranks(g)
+        assert dec["applied"]
+        csr = _ring_csr(RING_ORDER, w=nb)
+        new_slots = np.asarray([g.library_rank(a) for a in range(8)])
+        assert _traffic_across(csr, new_slots, link) \
+            < _traffic_across(csr, np.arange(8), link)
+    finally:
+        api.finalize()
