@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Rank-failure recovery latency: detect -> agree -> revoke -> shrink
+(ISSUE 9; runtime/liveness.py).
+
+No reference analog (TEMPI trusts a healthy MPI world). The scenario is
+the ULFM story in miniature: one victim rank wedges permanently (its ops
+never post), the survivors' bounded waits attribute the timeouts, the
+agreement vote lands a verdict, pending traffic to the victim is revoked
+with RankFailure, and ``api.shrink`` rebuilds the survivor communicator
+on which a byte-verified persistent alltoallv recompiles and runs.
+
+Reported (CSV): detection latency (first post to the victim -> verdict,
+dominated by TEMPI_WAIT_TIMEOUT_S x TEMPI_FT_SUSPECT_TIMEOUTS), the
+revoke latency of a bystander's pending request (should be ~0: it fails
+on the verdict, not on its own deadline), agreement time (from the
+verdict ledger), shrink time, and the post-shrink alltoallv's
+correctness + replay throughput over the survivor set.
+
+    python benches/bench_shrink.py --cpu --quick
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from _common import base_parser, devices_or_die, emit_csv, setup_platform
+
+
+def main() -> int:
+    p = base_parser("rank-failure detect/agree/revoke/shrink latency",
+                    multirank=True)
+    p.add_argument("--wait-timeout", type=float, default=0.3,
+                   help="TEMPI_WAIT_TIMEOUT_S for the detection waits")
+    p.add_argument("--suspect-timeouts", type=int, default=2,
+                   help="TEMPI_FT_SUSPECT_TIMEOUTS evidence threshold")
+    p.add_argument("--bytes", type=int, default=1 << 12,
+                   help="per-pair alltoallv payload on the survivor comm")
+    p.add_argument("--reps", type=int, default=20,
+                   help="post-shrink alltoallv replays to time")
+    args = p.parse_args()
+    if args.quick:
+        args.wait_timeout, args.reps = 0.15, 5
+    setup_platform(args)
+
+    import os
+    os.environ["TEMPI_FT"] = "shrink"
+    os.environ["TEMPI_WAIT_TIMEOUT_S"] = str(args.wait_timeout)
+    os.environ["TEMPI_FT_SUSPECT_TIMEOUTS"] = str(args.suspect_timeouts)
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    devices_or_die(min_devices=2)
+    comm = api.init()
+    size = comm.size
+    victim = size - 1
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf = comm.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(size)])
+
+    # seeded victim wedge: rank `victim` never posts. A bystander's
+    # pending request measures the REVOKE latency (it must fail on the
+    # verdict, not on its own deadline).
+    bystander = p2p.isend(comm, 1, sbuf, victim, ty, tag=1)
+    trigger = p2p.isend(comm, 0, sbuf, victim, ty)
+    t_post = time.monotonic()
+    t_verdict = None
+    while t_verdict is None:
+        try:
+            p2p.waitall([trigger])
+            print("victim completed?! detection never fired",
+                  file=sys.stderr)
+            return 1
+        except api.RankFailure:
+            t_verdict = time.monotonic()
+        except api.WaitTimeout:
+            continue  # suspicion accumulating toward the threshold
+    detect_s = t_verdict - t_post
+    t0 = time.monotonic()
+    try:
+        p2p.wait(bystander)
+        print("bystander completed?!", file=sys.stderr)
+        return 1
+    except api.RankFailure:
+        revoke_s = time.monotonic() - t0
+
+    snap = api.ft_snapshot()
+    verdict = next(e for e in snap["ledger"]
+                   if e.get("kind", "verdict") == "verdict")
+
+    t0 = time.monotonic()
+    new = api.shrink(comm)
+    shrink_s = time.monotonic() - t0
+    k = new.size
+
+    # post-shrink persistent alltoallv: compile over the survivor set,
+    # byte-verify once, then time replays
+    nb = args.bytes
+    counts = np.full((k, k), nb, np.int64)
+    np.fill_diagonal(counts, 0)
+    disp = np.tile(np.arange(k) * nb, (k, 1))
+    sb = new.buffer_from_host(
+        [np.full(k * nb, r + 1, np.uint8) for r in range(k)])
+    rb = new.alloc(k * nb)
+    pc = api.alltoallv_init(new, sb, counts, disp, rb, counts.T, disp)
+    pc.start(); pc.wait()
+    ok = True
+    for r in range(k):
+        expect = np.repeat(np.arange(1, k + 1), nb).astype(np.uint8)
+        expect[r * nb:(r + 1) * nb] = 0
+        ok = ok and bool((rb.get_rank(r) == expect).all())
+    t0 = time.monotonic()
+    for _ in range(args.reps):
+        pc.start(); pc.wait()
+    rep_s = (time.monotonic() - t0) / max(args.reps, 1)
+    moved = int(counts.sum())
+
+    emit_csv(
+        ["size", "survivors", "victim", "detect_s", "revoke_s",
+         "agree_method", "shrink_s", "a2av_ok", "a2av_replay_s",
+         "a2av_GBps"],
+        [[size, k, victim, detect_s, revoke_s,
+          verdict["provenance"].get("method", "?"), shrink_s, int(ok),
+          rep_s, moved / rep_s / 1e9 if rep_s > 0 else 0.0]])
+    api.finalize()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

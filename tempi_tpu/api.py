@@ -18,6 +18,7 @@ from .ops import dtypes, type_cache
 from .ops.dtypes import Datatype
 from .parallel import p2p
 from .parallel.communicator import Communicator, DistBuffer
+from .runtime.liveness import RankFailure  # noqa: F401 (public surface)
 from .utils import counters, env as envmod, logging as log
 
 _world: Optional[Communicator] = None
@@ -45,6 +46,9 @@ def init(devices=None) -> Communicator:
     from .parallel import replacement
     replacement.configure()  # arm TEMPI_REPLACE (knobs loud-parsed
     # above; this clears any prior session's decision ledger)
+    from .runtime import liveness
+    liveness.configure()  # arm TEMPI_FT (knobs loud-parsed above; this
+    # clears any prior session's dead sets, suspicion, and verdict ledger)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -187,6 +191,9 @@ def finalize() -> None:
         # per-session too (env-armed QoS survives: configure re-reads it)
         from .parallel import replacement
         replacement.configure()  # decision ledger is per-session too
+        from .runtime import liveness
+        liveness.configure()  # dead sets and the verdict ledger are
+        # per-session too (a new session's world has no dead ranks)
         _world = None
 
 
@@ -267,6 +274,44 @@ def replace_snapshot() -> dict:
     after finalize (reads empty)."""
     from .parallel import replacement
     return replacement.snapshot()
+
+
+def mark_failed(comm: Communicator, rank: int) -> dict:
+    """Operator/test hook of the fault-tolerance layer (ISSUE 9;
+    runtime/liveness.py): declare application rank ``rank`` of ``comm``
+    FAILED. Operator evidence still goes through the agreement step so
+    every survivor converges on the same dead set; the resulting verdict
+    revokes pending requests touching the rank (they complete with
+    :class:`RankFailure`), refuses new posts to it fast, and pins its
+    links' circuit breakers open. Requires ``TEMPI_FT=detect`` or
+    ``shrink``. Returns the verdict record; see the README "Fault
+    tolerance" section."""
+    from .runtime import liveness
+    return liveness.mark_failed(comm, rank)
+
+
+def shrink(comm: Communicator) -> Communicator:
+    """ULFM ``MPI_Comm_shrink`` analog (ISSUE 9): build a NEW communicator
+    over the ranks of ``comm`` that are not in its dead set, renumbering
+    application ranks densely and re-partitioning the placement over the
+    survivor topology (seeded from the current mapping). The parent stays
+    alive for survivor traffic but its plan caches drop and its
+    persistent collective handles refuse ``start()``; rebuild buffers and
+    handles on the returned communicator. Requires ``TEMPI_FT=shrink``
+    and an epoch boundary (no survivor operations in flight)."""
+    from .runtime import liveness
+    return liveness.shrink(comm)
+
+
+def ft_snapshot() -> dict:
+    """Diagnostic snapshot of the fault-tolerance layer (ISSUE 9): mode
+    and knobs, the verdict ledger with per-verdict agreement provenance
+    (method, round, voters), the last agreement, and per-communicator
+    liveness state — dead ranks, live suspect counts with their evidence
+    source, and heartbeat ages. Pure data — safe to serialize. Callable
+    before init and after finalize (reads empty)."""
+    from .runtime import liveness
+    return liveness.snapshot()
 
 
 def qos_snapshot() -> dict:

@@ -51,7 +51,7 @@ from ..measure import system as msys
 from ..obs import trace as obstrace
 from ..ops import dtypes
 from ..ops.dtypes import Datatype
-from ..runtime import faults, health
+from ..runtime import faults, health, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..utils import counters as ctr
@@ -543,6 +543,17 @@ class PersistentColl:
         if self._active:
             raise RuntimeError("start() on an already-active persistent "
                                "collective (MPI: operation error)")
+        if liveness.ENABLED and self.comm.dead_ranks:
+            # ULFM semantics (ISSUE 9): a collective over a communicator
+            # with dead members can never complete — refuse with the
+            # verdict instead of wedging a round. The recovery path is
+            # api.shrink(comm) + a fresh alltoallv_init on the survivor
+            # communicator, whose schedule compiles over the survivor set
+            raise liveness.RankFailure(
+                self.comm.dead_ranks,
+                detail="persistent collective start() on a communicator "
+                       "with failed ranks; api.shrink(comm) and rebuild "
+                       "the handle on the survivor communicator")
         if self._mapping_epoch != self.comm.mapping_epoch:
             # an applied re-placement invalidated everything mapping-
             # derived; refresh BEFORE the health check so the breaker

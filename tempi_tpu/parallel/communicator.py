@@ -16,6 +16,7 @@ mesh rows.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import weakref
 from collections import OrderedDict
@@ -56,6 +57,13 @@ def put_global(host: np.ndarray, sharding: NamedSharding) -> jax.Array:
 # derived (dist-graph) communicators the app never explicitly freed
 _all_comms: "weakref.WeakSet[Communicator]" = weakref.WeakSet()
 
+# creation ordinal: every process of an SPMD world constructs its
+# communicators in program order, so the ordinal names the SAME
+# communicator on every process — the liveness agreement (ISSUE 9;
+# runtime/liveness.py) scopes its cross-process vote keys on it so two
+# communicators' votes can never collide
+_comm_seq = itertools.count(1)
+
 
 def free_all() -> None:
     for comm in list(_all_comms):
@@ -65,11 +73,16 @@ def free_all() -> None:
 
 class Communicator:
     def __init__(self, devices: Sequence, placement=None, graph=None,
-                 parent=None):
+                 parent=None, topology=None):
         self.devices = list(devices)
         self.size = len(self.devices)
+        self.uid = next(_comm_seq)  # SPMD-aligned creation ordinal
         self.mesh = Mesh(np.array(self.devices), (AXIS,))
-        self.topology = topo_mod.discover(self.devices)
+        # callers that already discovered the topology over this exact
+        # device list (liveness.shrink re-partitions against it before
+        # construction) pass it in rather than discovering twice
+        self.topology = (topology if topology is not None
+                         else topo_mod.discover(self.devices))
         self.placement: Optional[topo_mod.Placement] = placement
         # dist-graph adjacency per application rank: (sources, destinations)
         self.graph = graph
@@ -103,6 +116,12 @@ class Communicator:
         # Set via api.comm_set_qos, which also arms the class scheduler;
         # with QoS unset the attribute is inert
         self.qos = None
+        # library ranks declared DEAD by the liveness agreement (ISSUE 9;
+        # runtime/liveness.py). Immutable snapshot replaced wholesale on a
+        # verdict so hot-path readers (p2p._post's refuse-fast gate,
+        # PersistentColl.start) never see a half-updated set; empty — and
+        # inert — with TEMPI_FT unset
+        self.dead_ranks: frozenset = frozenset()
         _all_comms.add(self)
 
     # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------

@@ -83,10 +83,29 @@ def init_distributed(coordinator_address: Optional[str] = None,
     addr = (coordinator_address
             or os.environ.get("TEMPI_COORDINATOR")
             or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if _initialized and (coordinator_address is not None
+                         or num_processes is not None
+                         or process_id is not None):
+        # loud, not silent: the jax.distributed world cannot be re-joined,
+        # so explicit arguments after the first init are dead letters — a
+        # caller passing a DIFFERENT process_id here believes something
+        # that is not true about the world it is in
+        log.warn("init_distributed called with explicit arguments after "
+                 "the multi-host world was already initialized; they are "
+                 "IGNORED (the jax.distributed world cannot be re-joined)")
     if addr and not _initialized:
-        def _int_env(name):
-            v = os.environ.get(name)
-            return int(v) if v else None
+        # loud single-knob parses (utils/env.int_env): a typo'd
+        # TEMPI_PROCESS_ID silently becoming None would auto-assign
+        # coordinates and join a world with mismatched ranks — parsed
+        # BEFORE the first connect attempt so a bad knob fails fast
+        nproc = (num_processes if num_processes is not None
+                 else envmod.int_env(
+                     "TEMPI_NUM_PROCESSES",
+                     what="the process count of the multi-host world"))
+        pid = (process_id if process_id is not None
+               else envmod.int_env(
+                   "TEMPI_PROCESS_ID",
+                   what="this process's id in [0, num_processes)"))
 
         # The CPU PJRT client is built WITHOUT a cross-process collectives
         # implementation unless one is selected before backend init — a
@@ -105,11 +124,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
         _initialize_with_retry(lambda: jax.distributed.initialize(
             coordinator_address=addr,
-            num_processes=(num_processes
-                           if num_processes is not None
-                           else _int_env("TEMPI_NUM_PROCESSES")),
-            process_id=(process_id if process_id is not None
-                        else _int_env("TEMPI_PROCESS_ID"))))
+            num_processes=nproc,
+            process_id=pid))
         _initialized = True
         log.debug(f"joined multi-host world at {addr}: "
                   f"process {jax.process_index()}/{jax.process_count()}")
@@ -128,10 +144,17 @@ def dryrun_dcn(ranks_per_node: int = 4) -> dict:
     from ..utils import env as envmod
     from . import p2p
 
+    # save/restore: the simulated node size must not leak into os.environ
+    # for the rest of the session (pre-fix, every later read_environment —
+    # any init(), any test — silently inherited this call's node split)
+    prev = os.environ.get("TEMPI_RANKS_PER_NODE")
     os.environ["TEMPI_RANKS_PER_NODE"] = str(ranks_per_node)
-    envmod.read_environment()
-    comm = api.init()
     try:
+        # INSIDE the try: a raise from the re-parse (some other bad
+        # TEMPI_* knob) or from init itself must restore the variable
+        # just like the happy path does
+        envmod.read_environment()
+        comm = api.init()
         if comm.num_nodes < 2:
             return dict(num_nodes=comm.num_nodes, pairs=0, ok=False,
                         reason=f"{comm.size} devices can't split into "
@@ -162,4 +185,74 @@ def dryrun_dcn(ranks_per_node: int = 4) -> dict:
                 comm.library_rank((r + ranks_per_node) % comm.size)))
         return dict(num_nodes=comm.num_nodes, pairs=pairs, ok=ok)
     finally:
-        api.finalize()
+        try:
+            api.finalize()
+        finally:
+            # the restore must survive a finalize raise (e.g. the leak
+            # check after a failed exchange) — nested finally, or the
+            # leak this fix removes comes back on exactly the error path
+            if prev is None:
+                os.environ.pop("TEMPI_RANKS_PER_NODE", None)
+            else:
+                os.environ["TEMPI_RANKS_PER_NODE"] = prev
+            envmod.read_environment()
+
+
+def allgather_suspects(bitmap: int, scope: str,
+                       timeout_s: float) -> Optional[dict]:
+    """DCN agreement seam for the liveness layer (ISSUE 9;
+    runtime/liveness._agree): publish this process's rank-suspect bitmap
+    and collect every other process's for one agreement vote.
+
+    The channel is the coordinator key-value store the
+    ``jax.distributed`` world already carries (the same service the Gloo
+    CPU collectives rendezvous through — the multi-host seam of this
+    module), keyed under the reserved ``tags.FT_AGREE`` id so agreement
+    traffic can never collide with application state. ``scope`` is the
+    caller's vote identity (session / communicator / round ordinals, all
+    SPMD-aligned) — keys must be unique per vote, since KV entries
+    outlive the vote. A process that does not publish within
+    ``timeout_s`` ABSTAINS — it may be the very failure being voted on,
+    and waiting for a dead process's vote would recreate the hang the
+    liveness layer exists to remove.
+
+    Returns ``{process_id: bitmap}`` for every vote collected (always
+    including our own), or None when no usable multi-process KV channel
+    exists — an older jax without the client, or a publish failure (the
+    caller DEFERS the verdict: a local verdict would diverge from the
+    other processes', and a crash here must not masquerade as an engine
+    failure on the waiter's thread)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return {0: int(bitmap)}
+    try:
+        from jax._src.distributed import global_state
+        client = global_state.client
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        log.warn(f"no distributed KV client for rank-death agreement: "
+                 f"{e!r}")
+        return None
+    if client is None:
+        return None
+    from . import tags
+
+    base = f"tempi/ft/{tags.FT_AGREE}/{scope}"
+    me = jax.process_index()
+    try:
+        client.key_value_set(f"{base}/{me}", str(int(bitmap)))
+    except Exception as e:
+        log.warn(f"rank-death agreement publish failed: {e!r}")
+        return None
+    votes = {me: int(bitmap)}
+    deadline = time.monotonic() + max(timeout_s, 0.001)
+    for p in range(jax.process_count()):
+        if p == me:
+            continue
+        budget_ms = max(1, int((deadline - time.monotonic()) * 1000))
+        try:
+            votes[p] = int(client.blocking_key_value_get(f"{base}/{p}",
+                                                         budget_ms))
+        except Exception:
+            continue  # abstention: no vote within the budget
+    return votes

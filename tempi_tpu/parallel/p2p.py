@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
 from ..obs import trace as obstrace
-from ..runtime import faults, health
+from ..runtime import faults, health, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..ops import type_cache
@@ -212,6 +212,11 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
     peer_lib = (ANY_SOURCE if peer_app == ANY_SOURCE
                 else comm.library_rank(peer_app))
     rank_lib = comm.library_rank(app_rank)
+    if liveness.ENABLED and comm.dead_ranks:
+        # ULFM revoke semantics (ISSUE 9): new traffic touching a dead
+        # rank refuses FAST with the verdict instead of pending forever
+        # and burning a wait deadline on an exchange that can never match
+        liveness.check_alive(comm, rank_lib, peer_lib)
     nbytes = count * datatype.size
     req = Request(next(_req_ids), comm, buf=buf, kind=kind, rank=rank_lib,
                   peer=peer_lib, tag=tag, nbytes=nbytes,
@@ -373,8 +378,10 @@ def _auto_choice(comm: Communicator, m: Message, key: tuple,
 
 #: Demotion preference when a chosen strategy's breaker is open: toward the
 #: conservative host-staged path first (ISSUE 2 "demote toward STAGED"),
-#: then whatever else is still healthy.
-_DEMOTION_ORDER = ("staged", "oneshot", "device")
+#: then whatever else is still healthy. The canonical tuple lives in
+#: health.py (already ordered conservative-first) so the liveness layer's
+#: verdict pinning covers exactly the strategies the chooser can ride.
+_DEMOTION_ORDER = health.STRATEGIES
 
 
 def _healthy_choice(comm: Communicator, m: Message, choice: str) -> str:
@@ -686,6 +693,12 @@ def _execute_matched(comm: Communicator, messages, consumed,
                 obstrace.emit("p2p.complete", req=op.request.id,
                               kind=op.kind, rank=op.rank, peer=op.peer,
                               tag=op.tag, strategy=strat)
+        if liveness.ENABLED:
+            # per-rank liveness heartbeats (ISSUE 9): a completed exchange
+            # is proof of life for both endpoints — and the background
+            # pump drives this very path, so a healthy pump keeps every
+            # active rank's heartbeat fresh
+            liveness.note_exchange(comm, ops)
 
 
 def _diag(req: Request, strategy: Optional[str]) -> dict:
@@ -706,6 +719,40 @@ def _deadline() -> Optional[float]:
     plain MPI semantics) when TEMPI_WAIT_TIMEOUT_S is unset."""
     t = envmod.env.wait_timeout_s
     return time.monotonic() + t if t > 0 else None
+
+
+def _raise_req_error(req: Request) -> None:
+    """Surface a request's stashed error. A :class:`liveness.RankFailure`
+    (a rank-death verdict revoked the request, ISSUE 9) is raised AS-IS —
+    the failure is the peer's, not the engine's, and the caller's recovery
+    path is ``api.shrink``, not a re-drive. Anything else keeps the
+    engine-failed wrapper with the root cause chained."""
+    if isinstance(req.error, liveness.RankFailure):
+        raise req.error
+    raise RuntimeError(
+        "progress engine failed while executing the exchange this "
+        "request was matched into") from req.error
+
+
+def _note_ft(comms, e: "WaitTimeout") -> None:
+    """Feed a WaitTimeout into the liveness registry (ISSUE 9): repeated
+    fully-unmatched timeouts attributed to ONE peer are the detection
+    signal for a dead rank. Raises RankFailure — chained from the timeout
+    — when a verdict (existing or just agreed) covers the stuck requests:
+    the timeout upgraded to the real diagnosis."""
+    if not liveness.ENABLED:
+        return
+    rf = None
+    for c in comms:
+        try:
+            liveness.note_wait_timeout(c, e.stuck)
+        except liveness.RankFailure as f:
+            # keep feeding the REMAINING comms' evidence before raising:
+            # a multi-comm batch's other peers must not need extra full
+            # deadline rounds because one comm's verdict fired first
+            rf = rf if rf is not None else f
+    if rf is not None:
+        raise rf from e
 
 
 def _record_success_reqs(reqs) -> None:
@@ -758,7 +805,8 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     configured (see :func:`_with_retry`)."""
     _with_retry(lambda absorb: _wait_attempt(req, strategy, absorb),
                 lambda e: _note_stuck(e, [req], strategy),
-                lambda: _repost([req]))
+                lambda: _repost([req]),
+                comms=(req.comm,))
 
 
 def _wait_attempt(req: Request, strategy: Optional[str] = None,
@@ -778,9 +826,7 @@ def _wait_attempt(req: Request, strategy: Optional[str] = None,
             _drive(req.comm, strategy, absorb, errbox)
     if not req.done:
         if req.error is not None:
-            raise RuntimeError(
-                "progress engine failed while executing the exchange this "
-                "request was matched into") from req.error
+            _raise_req_error(req)
         raise RuntimeError(
             "wait() on a request whose peer operation was never posted "
             "(deadlock in MPI terms)")
@@ -852,9 +898,7 @@ def test(req: Request, strategy: Optional[str] = None,
         _poll_progress(req.comm, strategy, progress)
     if not req.done:
         if req.error is not None:
-            raise RuntimeError(
-                "progress engine failed while executing the exchange this "
-                "request was matched into") from req.error
+            _raise_req_error(req)
         return False
     if req.buf is not None:
         if not _buf_ready(req.buf):
@@ -897,9 +941,7 @@ def testall(reqs, strategy: Optional[str] = None,
         # failure, not spin on False forever
         for r in reqs:
             if not r.done and r.error is not None:
-                raise RuntimeError(
-                    "progress engine failed while executing the exchange "
-                    "this request was matched into") from r.error
+                _raise_req_error(r)
         if not all(r.done for r in reqs):
             return False
     bufs = _distinct_bufs(reqs)
@@ -931,7 +973,8 @@ def waitall(reqs, strategy: Optional[str] = None) -> None:
     _with_retry(lambda absorb: _waitall_attempt(reqs, strategy, absorb),
                 lambda e: _note_stuck(e, reqs, strategy),
                 lambda: _repost([r for r in reqs
-                                 if not r.done and r.error is None]))
+                                 if not r.done and r.error is None]),
+                comms=_distinct_comms(reqs))
 
 
 def _waitall_attempt(reqs, strategy: Optional[str] = None,
@@ -1129,9 +1172,7 @@ class PersistentRequest:
                 with self.comm._progress_lock:
                     _withdraw_pending(self.comm, [act])
                 self.active = None
-                raise RuntimeError(
-                    "progress engine failed while executing the exchange "
-                    "this request was matched into") from act.error
+                _raise_req_error(act)
             return False
         if not _buf_ready(self.buf):
             return False
@@ -1359,14 +1400,18 @@ def cancel(reqs: Sequence[Request]) -> None:
 # the exchange toward the conservative host-staged strategy.
 
 
-def _with_retry(attempt, note, repost, retryable=None) -> None:
+def _with_retry(attempt, note, repost, retryable=None, comms=()) -> None:
     """Bounded retry for timed-out exchanges — the one policy loop both
     the eager and persistent wait paths share. ``attempt(absorb)`` runs
     one wait attempt (a fresh deadline each time); ``note(e)`` records
     the timeout's failures in the health registry and returns True if a
     breaker just opened; ``repost()`` re-arms the exchange for the next
     attempt (atomic cancel+repost for eager requests, startall for a
-    persistent batch).
+    persistent batch). ``comms`` (the batch's distinct communicators)
+    feeds every WaitTimeout — retried or not — to the liveness registry
+    (ISSUE 9): repeated one-peer timeouts are how a dead rank is
+    detected, and a timeout a fresh verdict covers is upgraded to
+    RankFailure here (unrecoverable by reposting: the peer is gone).
 
     Engaged only when BOTH a wait deadline (TEMPI_WAIT_TIMEOUT_S) and
     retries (TEMPI_RETRY_ATTEMPTS > 0) are armed — the default is ISSUE
@@ -1381,12 +1426,20 @@ def _with_retry(attempt, note, repost, retryable=None) -> None:
     overriding an explicitly-requested or env-forced strategy here."""
     retries = envmod.env.retry_attempts
     if retries <= 0 or envmod.env.wait_timeout_s <= 0:
-        return attempt(False)
+        if not liveness.ENABLED:
+            return attempt(False)
+        try:
+            return attempt(False)
+        except WaitTimeout as e:
+            _note_ft(comms, e)  # may upgrade to RankFailure
+            raise
     attempt_no = 0
     while True:
         try:
             return attempt(True)
         except WaitTimeout as e:
+            _note_ft(comms, e)  # may raise RankFailure: no repost can
+            # recover an exchange whose peer is dead
             opened = note(e)
             if (attempt_no >= retries
                     or any(d["state"] != "pending-unmatched"
@@ -1534,7 +1587,8 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
         # the repost restarts the WHOLE batch, so retry only when the
         # whole batch was stuck: restarting a partially-completed batch
         # would double-post instances whose data already delivered
-        retryable=lambda e: len(e.stuck) == len(preqs))
+        retryable=lambda e: len(e.stuck) == len(preqs),
+        comms=_distinct_comms(preqs))
 
 
 def _note_stuck_preqs(preqs: Sequence[PersistentRequest],

@@ -59,7 +59,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..obs import trace as obstrace
-from ..runtime import faults, health
+from ..runtime import faults, health, liveness
 from ..tune import online as tune_online
 from ..utils import counters as ctr
 from ..utils import env as envmod
@@ -163,6 +163,16 @@ def live_cost(comm: Communicator) -> Tuple[np.ndarray, dict]:
     penalized = set(open_ages)
     if pump_quarantined:
         penalized |= {(a, b) for a in range(n) for b in range(a + 1, n)}
+    dead = set()
+    if liveness.ENABLED:
+        # a dead rank's links are not degraded, they are GONE (ISSUE 9):
+        # the verdict's pinned breakers already land them in open_ages,
+        # but price them here too so the mapping repels traffic from a
+        # dead endpoint even for strategies no breaker was keyed on yet
+        dead = {int(r) for r in getattr(comm, "dead_ranks", ())
+                if int(r) < n}
+        penalized |= {(min(d, s), max(d, s)) for d in dead
+                      for s in range(n) if s != d}
     D = effective_matrix(dist, ratios, penalized, penalty)
     prov = dict(
         penalty=penalty,
@@ -172,6 +182,7 @@ def live_cost(comm: Communicator) -> Tuple[np.ndarray, dict]:
         penalized=[dict(link=list(lk), breaker_age_s=float(age))
                    for lk, age in sorted(open_ages.items())],
         pump_quarantined=pump_quarantined,
+        dead_ranks=sorted(dead),
         static=D is dist,  # no evidence: live == static, byte-for-byte
     )
     return D, prov

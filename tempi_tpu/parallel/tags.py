@@ -10,3 +10,9 @@ NEIGHBOR_ALLTOALLW = RESERVED_BASE + 1
 # isend/irecv lowering rides this tag, so replayed collective traffic can
 # never FIFO-match application p2p ops interleaved on the same communicator
 COLL_SCHEDULE = RESERVED_BASE + 2
+# rank-failure agreement control channel (runtime/liveness.py): the
+# suspect-bitmap allgather backing a death verdict rides this reserved id
+# — in-process meshes agree trivially, and the multi-process (DCN) seam
+# (multihost.allgather_suspects) namespaces its coordinator-KV keys under
+# it so agreement traffic can never collide with application state
+FT_AGREE = RESERVED_BASE + 3

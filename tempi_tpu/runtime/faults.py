@@ -105,6 +105,21 @@ SITES = (
                           # mirroring process_mapping's identity-start
                           # guarantee; wedge refused — the apply runs
                           # under the communicator's progress lock)
+    "ft.heartbeat",       # each liveness heartbeat-stamping pass
+                          # (runtime/liveness.note_exchange — a raise
+                          # drops the stamps, never the exchange that
+                          # produced them: the missed-heartbeat
+                          # simulation; delay slows the completing
+                          # thread; wedge refused like every non-engine
+                          # site — the hook runs under the progress lock)
+    "ft.agree",           # each rank-death agreement vote
+                          # (runtime/liveness._agree — fires BEFORE the
+                          # vote: a raise fails THIS vote, the verdict is
+                          # deferred and local suspicion retained for the
+                          # next timeout; wedge refused — a wedged vote
+                          # would deadlock every survivor's verdict, the
+                          # exact divergent-conclusions outcome agreement
+                          # exists to prevent)
     "qos.admit",          # each QoS admission decision at op-post notify
                           # (runtime/progress.notify, armed only while
                           # qos.ENABLED — a raise forces the refusal

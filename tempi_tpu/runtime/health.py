@@ -55,6 +55,15 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
 
+#: The concrete transport strategies the p2p chooser can ride — the
+#: breaker key space, shared so consumers cannot drift from it. Order
+#: matters: parallel/p2p's demotion walks it conservative-first (toward
+#: the host-staged path), and the liveness layer (runtime/liveness.py)
+#: pins a dead rank's breakers across exactly this set — a strategy
+#: missing here would keep probing a dead endpoint at a full wait
+#: deadline per probe.
+STRATEGIES = ("staged", "oneshot", "device")
+
 #: True iff any breaker is open/half-open. Hot paths guard on this before
 #: calling into the registry (one module-attribute truth test when healthy).
 TRIPPED = False
@@ -79,6 +88,11 @@ class _Breaker:
     times_opened: int = 0
     last_error: str = ""
     probes: int = 0            # half-open passes granted
+    # a PINNED breaker never half-opens: no cooldown probe, allowed() is
+    # False until reset(). Set by force_open() — the liveness layer's
+    # rank-failure verdict (ISSUE 9): a dead rank's links are not flaky,
+    # they are gone, and probing them would just burn wait deadlines
+    pinned: bool = False
 
 
 _lock = threading.Lock()
@@ -141,6 +155,34 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
     return opened
 
 
+def force_open(peer: tuple, strategy: str, reason: str = "forced") -> None:
+    """Open (and PIN) the breaker for ``strategy`` on ``peer``
+    unconditionally — no threshold, no cooldown probe, no half-open
+    until :func:`reset`. The liveness layer (runtime/liveness.py) calls
+    this on a rank-failure verdict with ``reason="rank_failed"``: unlike
+    an ordinary open, a dead rank's link can never heal, so the breaker
+    must not hand out probes that would each cost a full wait deadline.
+    ``reason`` lands in ``last_error`` and the snapshot."""
+    if not isinstance(peer, tuple) or any(r < 0 for r in peer):
+        return
+    with _lock:
+        b = _table.setdefault((peer, strategy), _Breaker())
+        b.failures += 1
+        b.consecutive += 1
+        b.last_error = reason
+        opened = b.state != OPEN
+        b.state = OPEN
+        b.pinned = True
+        b.opened_at = time.monotonic()
+        if opened:
+            b.times_opened += 1
+            b.last_transition_at = b.opened_at
+        _recompute_flags_locked()
+    if opened and obstrace.ENABLED:
+        obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
+                      forced=True, error=reason[:200])
+
+
 def record_success(peer: tuple, strategy: str) -> None:
     """One successful exchange of ``strategy`` on ``peer``: resets the
     consecutive-failure counter and closes a half-open breaker. Callers
@@ -178,6 +220,10 @@ def allowed(peer: tuple, strategy: str) -> bool:
         if b.state == HALF_OPEN:
             b.probes += 1
             return True
+        if b.pinned:
+            # rank-failure pins never probe: the link's endpoint is dead,
+            # not degraded — only reset() (session teardown) clears it
+            return False
         cooldown = getattr(envmod.env, "breaker_cooldown_s", 30.0)
         if time.monotonic() - b.opened_at >= cooldown:
             b.state = HALF_OPEN
@@ -246,15 +292,17 @@ def snapshot() -> dict:
                 consecutive_failures=b.consecutive, failures=b.failures,
                 successes=b.successes, times_opened=b.times_opened,
                 probes=b.probes, last_error=b.last_error,
+                pinned=b.pinned,
                 # monotonic age of the CURRENT state (seconds since the
                 # last transition; 0 for a closed breaker that never
                 # transitioned) — open/half-open duration is what the
                 # re-placement hysteresis and quarantine debugging read
                 age_s=(now - b.last_transition_at
                        if b.last_transition_at else 0.0),
+                # a pinned breaker has no cooldown: it never half-opens
                 cooldown_remaining_s=(
                     max(0.0, cooldown - (now - b.opened_at))
-                    if b.state == OPEN else 0.0)))
+                    if b.state == OPEN and not b.pinned else 0.0)))
         return dict(breakers=breakers, demotions=_demotion_count,
                     demoted=[dict(d) for d in _demotions])
 

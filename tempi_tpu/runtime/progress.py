@@ -210,6 +210,17 @@ def running() -> bool:
     return _pump is not None
 
 
+def discard(comm) -> bool:
+    """Drop ``comm``'s queued pump wakeup (if any) from its QoS class
+    lane without serving it. The liveness layer calls this after a
+    rank-failure verdict revoked every pending op on the communicator
+    (ISSUE 9): the queued service request is for work that no longer
+    exists, and leaving it would burn a scheduler slot on an empty
+    backlog. Returns True if a wakeup was queued."""
+    pump = _pump
+    return pump._queue.discard(comm) if pump is not None else False
+
+
 def scheduler():
     """The live pump's class scheduler, or None (qos.snapshot reads lane
     depths/credits through this)."""

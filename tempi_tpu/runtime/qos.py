@@ -228,6 +228,19 @@ class ClassScheduler:
                     _bump("deferred", other)
         return chosen
 
+    def discard(self, item) -> bool:
+        """Remove a queued wakeup for ``item`` from EVERY lane without
+        serving it (a reclassified communicator may sit in its old class
+        lane); True if any lane held it. Used by the liveness layer's
+        revocation step — a rank-failure verdict that emptied a
+        communicator's backlog drains its stale wakeup from the class
+        lane (ISSUE 9)."""
+        with self._cv:
+            hit = False
+            for lane in self._lanes.values():
+                hit = lane.discard(item) or hit
+            return hit
+
     def drain(self) -> List:
         """Every queued item, latency lane first, without blocking (the
         supervisor hands a replaced pump's backlog over under the module

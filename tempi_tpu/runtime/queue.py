@@ -92,6 +92,21 @@ class Queue(Generic[T]):
         self._ids.discard(id(item))
         return item
 
+    def discard(self, item: T) -> bool:
+        """Remove a queued ``item`` (identity comparison) WITHOUT serving
+        it; True if it was queued. The liveness layer drains a revoked
+        communicator's stale wakeup this way (ISSUE 9): after a
+        rank-failure verdict revoked every pending op, a queued pump
+        service would just scan an empty backlog."""
+        with self._cv:
+            if id(item) not in self._ids:
+                return False
+            self._ids.discard(id(item))
+            before = len(self._items)
+            self._items = collections.deque(
+                x for x in self._items if x is not item)
+            return len(self._items) < before
+
     def drain(self) -> List[T]:
         """Remove and return every queued item, oldest first, WITHOUT
         blocking — unlike a pop(timeout=...) loop, which costs up to one

@@ -113,6 +113,22 @@ class ReplaceCounters:
 
 
 @dataclass
+class FtCounters:
+    # fault-tolerant communicators (ISSUE 9; runtime/liveness.py): pinned
+    # at zero with TEMPI_FT unset — the counter-based byte-for-byte guard
+    # that the off path neither suspects nor revokes anything
+    num_suspects: int = 0        # local suspicion events recorded
+    num_verdicts: int = 0        # ranks declared dead by agreement
+    num_revoked: int = 0         # pending requests completed-with-
+                                 # RankFailure by a verdict
+    num_refused: int = 0         # posts to a dead rank refused fast
+    num_heartbeats_dropped: int = 0  # ft.heartbeat chaos: stamps dropped
+    num_agree_failures: int = 0  # agreement votes that failed (verdict
+                                 # deferred, suspicion retained)
+    num_shrinks: int = 0         # survivor communicators built
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -138,6 +154,7 @@ class Counters:
     plan: PlanCacheCounters = field(default_factory=PlanCacheCounters)
     qos: QosCounters = field(default_factory=QosCounters)
     replace: ReplaceCounters = field(default_factory=ReplaceCounters)
+    ft: FtCounters = field(default_factory=FtCounters)
 
     def as_dict(self) -> dict:
         out = {}

@@ -163,11 +163,42 @@ and the README "Online re-placement" section):
                          sub-unit penalty would ATTRACT traffic onto
                          the degraded link)
 
-All resilience, observability, tuning, persistent-collective, QoS, and
-re-placement knobs parse LOUDLY (a typo raises at init rather than
-silently reverting to the hang/die/fly-blind/frozen-model/
-head-of-line-blocked/frozen-placement behavior the knob exists to
-prevent).
+Fault-tolerant communicator knobs (ISSUE 9; see runtime/liveness.py and
+the README "Fault tolerance" section):
+  TEMPI_FT             = off | detect | shrink — ULFM-style rank-failure
+                         handling (default off = one module-flag truth
+                         test per touchpoint; a permanently dead rank
+                         stalls every touching op until the wait
+                         deadline, the pre-ISSUE-9 behavior).
+                         ``detect`` turns local suspicion (repeated
+                         fully-unmatched WaitTimeouts attributed to one
+                         peer, stale heartbeats, api.mark_failed) into an
+                         agreed death VERDICT that revokes pending
+                         requests (RankFailure), refuses new posts fast,
+                         and force-opens the dead rank's breakers;
+                         ``shrink`` additionally allows
+                         ``api.shrink(comm)`` to rebuild a survivor
+                         communicator.
+  TEMPI_FT_SUSPECT_TIMEOUTS  fully-unmatched WaitTimeout events
+                         attributed to ONE peer before that peer is
+                         locally suspected dead (default 2; must be a
+                         positive integer — a zero threshold would
+                         declare a rank dead on evidence nobody saw)
+  TEMPI_FT_HEARTBEAT_S heartbeat-staleness accelerant: a timed-out peer
+                         whose last completed exchange (its heartbeat)
+                         is older than this is suspected IMMEDIATELY,
+                         without waiting out the timeout count
+                         (default 0 = heartbeat evidence off)
+  TEMPI_FT_AGREE_TIMEOUT_S  budget for the multi-process (DCN)
+                         suspect-bitmap allgather backing a death
+                         verdict; processes that do not vote within it
+                         abstain (default 5)
+
+All resilience, observability, tuning, persistent-collective, QoS,
+re-placement, and fault-tolerance knobs parse LOUDLY (a typo raises at
+init rather than silently reverting to the hang/die/fly-blind/
+frozen-model/head-of-line-blocked/frozen-placement/stall-forever
+behavior the knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -307,6 +338,11 @@ class Environment:
     replace_mode: str = "off"      # off | observe | apply
     replace_min_gain: float = 0.05  # hysteresis: modeled relative gain
     replace_penalty: float = 10.0   # live-cost multiplier on degraded links
+    # fault-tolerant communicators (ISSUE 9) — see runtime/liveness.py
+    ft_mode: str = "off"           # off | detect | shrink
+    ft_suspect_timeouts: int = 2   # unmatched timeouts before suspicion
+    ft_heartbeat_s: float = 0.0    # stale-heartbeat accelerant (0 = off)
+    ft_agree_timeout_s: float = 5.0  # DCN agreement vote budget
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -565,6 +601,33 @@ class Environment:
                 ">= 1 (values below 1 reward degraded links)")
         e.replace_penalty = pen
 
+        # fault-tolerance knobs parse loudly too: a typo'd TEMPI_FT
+        # silently staying off would hand the one deployment that asked
+        # for rank-failure handling the exact stall-until-deadline
+        # behavior the mode exists to prevent
+        ft = (getenv("TEMPI_FT") or "off").lower()
+        if ft not in ("off", "detect", "shrink"):
+            raise ValueError(
+                f"bad TEMPI_FT={ft!r}: want off | detect | shrink")
+        e.ft_mode = ft
+        v = getenv("TEMPI_FT_SUSPECT_TIMEOUTS")
+        try:
+            n = int(v) if v else 2
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_FT_SUSPECT_TIMEOUTS={v!r}: want a positive "
+                "integer (timeout events per peer)") from exc
+        if n <= 0:
+            # no silent clamp: a zero threshold would let the very first
+            # (possibly transient) timeout declare a rank dead — a
+            # verdict is FINAL, so the evidence bar must be explicit
+            raise ValueError(
+                f"bad TEMPI_FT_SUSPECT_TIMEOUTS={v!r}: want a positive "
+                "integer (timeout events per peer)")
+        e.ft_suspect_timeouts = n
+        e.ft_heartbeat_s = _float_env("TEMPI_FT_HEARTBEAT_S", 0.0)
+        e.ft_agree_timeout_s = _float_env("TEMPI_FT_AGREE_TIMEOUT_S", 5.0)
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -594,6 +657,9 @@ class Environment:
             # ...and re-placement: "no placement remap" is the bail-out's
             # explicit contract, one-shot AND online
             e.replace_mode = "off"
+            # ...and the liveness layer: the underlying library has no
+            # rank-failure semantics to emulate
+            e.ft_mode = "off"
         return e
 
 
@@ -606,3 +672,21 @@ def read_environment(environ=None) -> Environment:
     global env
     env = Environment.from_environ(environ)
     return env
+
+
+def int_env(name: str, what: str = "an integer", environ=None
+            ) -> "int | None":
+    """Loud single-knob integer parse for ``TEMPI_*`` variables consulted
+    OUTSIDE ``read_environment`` (``multihost``'s ``TEMPI_NUM_PROCESSES``
+    / ``TEMPI_PROCESS_ID``). Unset or empty returns None; anything that
+    is not an integer raises naming the knob — the standing loud-parse
+    constraint: a typo'd process id silently becoming None would join
+    the multi-host world with auto-assigned coordinates, the exact
+    mismatched-rank outcome the knob exists to pin down."""
+    v = (environ if environ is not None else os.environ).get(name)
+    if v is None or v.strip() == "":
+        return None
+    try:
+        return int(v)
+    except ValueError as exc:
+        raise ValueError(f"bad {name}={v!r}: want {what}") from exc

@@ -43,6 +43,10 @@ def pytest_configure(config):
         "markers",
         "replace: online topology re-placement tests (the <30s smoke is "
         "`pytest -m replace`)")
+    config.addinivalue_line(
+        "markers",
+        "ft: fault-tolerant communicator tests — rank-failure detection, "
+        "revocation, shrink (the <30s smoke is `pytest -m ft`)")
 
 
 @pytest.fixture(autouse=True)
@@ -53,7 +57,7 @@ def _reset_globals():
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.parallel import replacement
-    from tempi_tpu.runtime import faults, health, qos
+    from tempi_tpu.runtime import faults, health, liveness, qos
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env
 
@@ -63,6 +67,7 @@ def _reset_globals():
     tune_online.configure()
     qos.configure()
     replacement.configure()
+    liveness.configure()
     counters.init()
     health.reset()
     yield
@@ -70,9 +75,11 @@ def _reset_globals():
     # breaker state and quarantine history must not leak across tests any
     # more than an armed fault spec may — nor may a test's recorded trace
     # events, its armed recorder mode, its learned tune estimators, an
-    # api-armed QoS scheduler, or an armed re-placement mode's ledger
+    # api-armed QoS scheduler, an armed re-placement mode's ledger, or an
+    # armed liveness mode's dead sets and verdicts
     health.reset()
     obstrace.configure("off")
     tune_online.configure("off")
     qos.disarm()
     replacement.configure("off")
+    liveness.configure("off")

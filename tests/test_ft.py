@@ -1,0 +1,567 @@
+"""Fault-tolerant communicator suite (ISSUE 9; runtime/liveness.py).
+
+The recovery stack so far handles *degraded* components (breakers, retry,
+pump supervision, re-placement); this suite pins the ULFM-style contract
+for a rank that is permanently DEAD: local suspicion from attributed
+WaitTimeouts / stale heartbeats / the operator hook, an agreement step
+before any verdict, immediate revocation of pending requests
+(RankFailure, not a burned deadline), fast refusal of new posts, pinned
+breakers, and shrink-to-survivors with recompiled collectives. Every
+chaos case is seeded; the off path is counter-pinned byte-for-byte."""
+
+import contextlib
+import time
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+from tempi_tpu.runtime import faults, health, liveness
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.ft
+
+TY = lambda: dt.contiguous(64, dt.BYTE)  # noqa: E731
+
+
+@contextlib.contextmanager
+def _world(monkeypatch, **env):
+    """An initialized world with the FT knobs armed (overridable per
+    test; value None deletes the variable)."""
+    defaults = dict(TEMPI_FT="shrink", TEMPI_WAIT_TIMEOUT_S="0.3",
+                    TEMPI_FT_SUSPECT_TIMEOUTS="1")
+    defaults.update(env)
+    for k, v in defaults.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+    comm = api.init()  # re-reads env and configures liveness
+    try:
+        yield comm
+    finally:
+        api.finalize()
+
+
+def _fill(comm, value):
+    return comm.buffer_from_host(
+        [np.full(64, value, np.uint8) for _ in range(comm.size)])
+
+
+# -- knob parsing --------------------------------------------------------------
+
+
+def test_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_FT", "revive")
+    with pytest.raises(ValueError, match="TEMPI_FT="):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_FT", "detect")
+    monkeypatch.setenv("TEMPI_FT_SUSPECT_TIMEOUTS", "0")
+    with pytest.raises(ValueError, match="TEMPI_FT_SUSPECT_TIMEOUTS"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_FT_SUSPECT_TIMEOUTS", "2")
+    monkeypatch.setenv("TEMPI_FT_HEARTBEAT_S", "-1")
+    with pytest.raises(ValueError, match="TEMPI_FT_HEARTBEAT_S"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_FT_HEARTBEAT_S", "1.5")
+    monkeypatch.setenv("TEMPI_FT_AGREE_TIMEOUT_S", "soon")
+    with pytest.raises(ValueError, match="TEMPI_FT_AGREE_TIMEOUT_S"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_FT_AGREE_TIMEOUT_S", "2")
+    e = envmod.read_environment()
+    assert (e.ft_mode, e.ft_suspect_timeouts, e.ft_heartbeat_s,
+            e.ft_agree_timeout_s) == ("detect", 2, 1.5, 2.0)
+
+
+def test_tempi_disable_forces_ft_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_FT", "shrink")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    assert envmod.read_environment().ft_mode == "off"
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="bad TEMPI_FT mode"):
+        liveness.configure("zombie")
+
+
+# -- off path: inert and counter-pinned ---------------------------------------
+
+
+def test_off_path_is_inert_and_counter_pinned(monkeypatch):
+    """With TEMPI_FT unset: no liveness state, no counters, the api
+    surface refuses with a clear pointer at the knob, and an exchange is
+    untouched — the byte-for-byte guard every subsystem ships with."""
+    with _world(monkeypatch, TEMPI_FT=None, TEMPI_WAIT_TIMEOUT_S=None,
+                TEMPI_FT_SUSPECT_TIMEOUTS=None) as comm:
+        assert not liveness.ENABLED
+        s, r = _fill(comm, 7), comm.alloc(64)
+        p2p.waitall([p2p.isend(comm, 0, s, 1, TY()),
+                     p2p.irecv(comm, 1, r, 0, TY())])
+        np.testing.assert_array_equal(r.get_rank(1), np.full(64, 7,
+                                                             np.uint8))
+        assert comm.dead_ranks == frozenset()
+        assert all(v == 0
+                   for v in api.counters_snapshot()["ft"].values())
+        snap = api.ft_snapshot()
+        assert snap["mode"] == "off" and snap["verdicts"] == 0
+        with pytest.raises(RuntimeError, match="TEMPI_FT is off"):
+            api.mark_failed(comm, 3)
+        with pytest.raises(RuntimeError, match="TEMPI_FT is off"):
+            api.shrink(comm)
+
+
+# -- detection: WaitTimeout attribution (ISSUE 9 satellite) -------------------
+
+
+def test_suspect_attribution_single_vs_mixed_peers(monkeypatch):
+    """The attribution contract the tentpole consumes, pinned against
+    REAL WaitTimeout diagnostics: N stuck requests to one never-posting
+    peer attribute to that peer; stuck requests to mixed peers are
+    ambiguous and attribute to nobody."""
+    with _world(monkeypatch, TEMPI_FT="detect",
+                TEMPI_FT_SUSPECT_TIMEOUTS="99") as comm:
+        s = _fill(comm, 1)
+        # N=2 stuck requests, both to never-posting peer 5
+        reqs = [p2p.isend(comm, 0, s, 5, TY()),
+                p2p.isend(comm, 1, s, 5, TY(), tag=1)]
+        with pytest.raises(p2p.WaitTimeout) as ei:
+            p2p.waitall(reqs)
+        assert liveness.suspect_of(ei.value.stuck) == comm.library_rank(5)
+        snap = api.ft_snapshot()
+        (cs,) = snap["comms"]
+        assert cs["suspects"] == {comm.library_rank(5): 1}  # one event
+        p2p.cancel(reqs)
+        # mixed peers: sends to 5 AND 6 stuck in one timeout — ambiguous
+        reqs = [p2p.isend(comm, 0, s, 5, TY(), tag=2),
+                p2p.isend(comm, 1, s, 6, TY(), tag=3)]
+        with pytest.raises(p2p.WaitTimeout) as ei:
+            p2p.waitall(reqs)
+        assert liveness.suspect_of(ei.value.stuck) is None
+        (cs,) = api.ft_snapshot()["comms"]
+        assert cs["suspects"] == {comm.library_rank(5): 1}  # unchanged
+        p2p.cancel(reqs)
+
+
+def test_suspect_attribution_edge_rules():
+    """Pure-function edges: non-pending states, wildcard peers, and a
+    'suspect' that itself posted are all ambiguous."""
+    d = dict(kind="send", rank=0, peer=5, tag=0, nbytes=64,
+             strategy="auto", age_s=0.1, state="pending-unmatched")
+    assert liveness.suspect_of([d]) == 5
+    assert liveness.suspect_of([]) is None
+    assert liveness.suspect_of([dict(d, state="matched-in-flight")]) is None
+    assert liveness.suspect_of([dict(d, state="completion-sync"), d]) is None
+    assert liveness.suspect_of([dict(d, peer=p2p.ANY_SOURCE)]) is None
+    # the named peer posted a stuck op of its own: alive enough to post,
+    # so the stall is the engine's, not the peer's
+    assert liveness.suspect_of([d, dict(d, rank=5, peer=5)]) is None
+
+
+def test_engine_stall_is_not_attributed(monkeypatch):
+    """A matched pair stuck behind a stalled ENGINE names both endpoints
+    — ambiguous by the single-peer rule, so an engine failure can never
+    masquerade as a rank death."""
+    with _world(monkeypatch, TEMPI_FT="detect") as comm:
+        faults.configure("p2p.progress:wedge:1.0:42")
+        s, r = _fill(comm, 3), comm.alloc(64)
+        reqs = [p2p.isend(comm, 0, s, 1, TY()),
+                p2p.irecv(comm, 1, r, 0, TY())]
+        with pytest.raises(p2p.WaitTimeout) as ei:
+            p2p.waitall(reqs)
+        assert liveness.suspect_of(ei.value.stuck) is None
+        assert api.ft_snapshot()["comms"][0]["suspects"] == {}
+        assert comm.dead_ranks == frozenset()
+        faults.reset()
+        p2p.waitall(reqs)  # engine healthy again: same exchange completes
+        np.testing.assert_array_equal(r.get_rank(1),
+                                      np.full(64, 3, np.uint8))
+
+
+# -- suspicion -> agreement -> verdict -> revocation --------------------------
+
+
+def test_suspicion_accumulates_to_threshold(monkeypatch):
+    """TEMPI_FT_SUSPECT_TIMEOUTS=2: the first attributed timeout only
+    suspects; the second produces the verdict (and upgrades the raise to
+    RankFailure, chained from the WaitTimeout)."""
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="2") as comm:
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, 4, TY())
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        assert comm.dead_ranks == frozenset()
+        assert api.ft_snapshot()["comms"][0]["suspects"] == {4: 1}
+        with pytest.raises(api.RankFailure) as ei:
+            p2p.waitall([req])  # still posted: wait again, second event
+        assert ei.value.dead == frozenset({4})
+        assert isinstance(ei.value.__cause__, p2p.WaitTimeout)
+        assert comm.dead_ranks == frozenset({4})
+        led = api.ft_snapshot()["ledger"][-1]
+        assert led["evidence"] == {4: "wait-timeout"}
+        assert led["provenance"]["method"] == "in-process"
+
+
+def test_verdict_revokes_pending_and_refuses_new_posts(monkeypatch):
+    """The acceptance criteria's fast-path half: a verdict completes
+    EVERY pending request touching the dead rank immediately (other
+    waiters see RankFailure in much less than a wait deadline) and new
+    posts refuse fast."""
+    with _world(monkeypatch) as comm:
+        s = _fill(comm, 1)
+        doomed = p2p.isend(comm, 2, s, 6, TY(), tag=7)  # a bystander's op
+        trigger = p2p.isend(comm, 0, s, 6, TY())
+        with pytest.raises(api.RankFailure):
+            p2p.waitall([trigger])  # threshold 1: timeout -> verdict
+        # the bystander's request was revoked by the same verdict: its
+        # wait fails instantly, not after another 0.3 s deadline
+        t0 = time.monotonic()
+        with pytest.raises(api.RankFailure):
+            p2p.wait(doomed)
+        assert time.monotonic() - t0 < 0.15
+        assert isinstance(doomed.error, api.RankFailure)
+        assert not comm._pending  # revoked ops left the pending list
+        # new posts refuse fast, in both directions
+        t0 = time.monotonic()
+        with pytest.raises(api.RankFailure):
+            p2p.isend(comm, 1, s, 6, TY())
+        with pytest.raises(api.RankFailure):
+            p2p.irecv(comm, 6, comm.alloc(64), 0, TY())
+        assert time.monotonic() - t0 < 0.1
+        c = api.counters_snapshot()["ft"]
+        assert c["num_verdicts"] == 1 and c["num_refused"] == 2
+        assert c["num_revoked"] >= 2  # trigger + bystander
+
+
+def test_heartbeat_staleness_accelerates_verdict(monkeypatch):
+    """TEMPI_FT_HEARTBEAT_S: a peer that used to complete exchanges and
+    stopped is suspected on the FIRST attributed timeout, without waiting
+    out the timeout count."""
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="99",
+                TEMPI_FT_HEARTBEAT_S="0.05") as comm:
+        s, r = _fill(comm, 2), comm.alloc(64)
+        p2p.waitall([p2p.isend(comm, 0, s, 2, TY()),
+                     p2p.irecv(comm, 2, r, 0, TY())])  # rank 2 heartbeats
+        time.sleep(0.1)  # ...then goes silent past the budget
+        with pytest.raises(api.RankFailure) as ei:
+            p2p.waitall([p2p.isend(comm, 0, s, 2, TY(), tag=1)])
+        assert ei.value.dead == frozenset({2})
+        assert api.ft_snapshot()["ledger"][-1]["evidence"] == {
+            2: "heartbeat"}
+
+
+def test_completed_exchange_clears_suspicion(monkeypatch):
+    """Alive evidence beats stale timeouts: a suspected peer that then
+    completes an exchange is un-suspected (a slow rank is not a dead
+    rank)."""
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="3") as comm:
+        s, r = _fill(comm, 4), comm.alloc(64)
+        req = p2p.isend(comm, 0, s, 3, TY())
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        assert api.ft_snapshot()["comms"][0]["suspects"] == {3: 1}
+        p2p.cancel([req])
+        p2p.waitall([p2p.isend(comm, 0, s, 3, TY(), tag=1),
+                     p2p.irecv(comm, 3, r, 0, TY(), tag=1)])
+        snap = api.ft_snapshot()["comms"][0]
+        assert snap["suspects"] == {}
+        assert 3 in snap["heartbeat_age_s"]
+        assert comm.dead_ranks == frozenset()
+
+
+def test_mark_failed_operator_hook(monkeypatch):
+    """api.mark_failed: operator evidence goes straight through agreement
+    to a verdict; bad ranks and the off mode are refused loudly."""
+    with _world(monkeypatch, TEMPI_FT="detect") as comm:
+        with pytest.raises(ValueError, match="out of range"):
+            api.mark_failed(comm, comm.size)
+        out = api.mark_failed(comm, 6)
+        assert out["dead"] == [6] and out["newly"] == [6]
+        assert out["provenance"]["method"] == "in-process"
+        assert comm.dead_ranks == frozenset({6})
+        again = api.mark_failed(comm, 6)
+        assert again["already"] and again["newly"] == []
+        assert api.ft_snapshot()["ledger"][-1]["evidence"] == {
+            6: "operator"}
+        # detect mode revokes but does not rebuild
+        with pytest.raises(RuntimeError, match="TEMPI_FT=shrink"):
+            api.shrink(comm)
+
+
+# -- verdict side effects across the runtime ----------------------------------
+
+
+def test_verdict_pins_breakers_open(monkeypatch):
+    """Every (link, strategy) breaker touching the dead rank force-opens
+    PINNED with reason=rank_failed: no cooldown probe ever hands traffic
+    back to a dead endpoint."""
+    with _world(monkeypatch, TEMPI_FT="detect",
+                TEMPI_BREAKER_COOLDOWN_S="0") as comm:
+        api.mark_failed(comm, 5)
+        assert health.TRIPPED
+        for s in range(comm.size):
+            if s == 5:
+                continue
+            for strat in ("device", "oneshot", "staged"):
+                lk = health.link(5, s)
+                assert health.state(lk, strat) == health.OPEN
+                # cooldown 0 would half-open an ordinary breaker; a
+                # pinned one refuses the probe forever
+                assert health.allowed(lk, strat) is False
+                assert health.state(lk, strat) == health.OPEN
+        snap = api.health_snapshot()
+        pinned = [b for b in snap["breakers"] if b["pinned"]]
+        assert len(pinned) == (comm.size - 1) * 3
+        assert all(b["last_error"] == "rank_failed" for b in pinned)
+        assert all(b["cooldown_remaining_s"] == 0.0 for b in pinned)
+        # a healthy link's breaker is untouched
+        assert health.state(health.link(0, 1), "device") == health.CLOSED
+
+
+def test_replacement_prices_dead_links_unusable(monkeypatch):
+    """replacement.live_cost: a dead rank's links are penalized (and the
+    provenance says why) so a remap repels traffic from it."""
+    from tempi_tpu.parallel import replacement
+
+    with _world(monkeypatch, TEMPI_FT="detect") as comm:
+        D0 = comm.topology.distance_matrix()
+        api.mark_failed(comm, 4)
+        D, prov = replacement.live_cost(comm)
+        assert prov["dead_ranks"] == [4]
+        assert not prov["static"]
+        lib = comm.library_rank(4)
+        others = [r for r in range(comm.size) if r != lib]
+        assert all(D[lib, s] > D0[lib, s] for s in others)
+
+
+def test_qos_lane_drains_on_full_revocation(monkeypatch):
+    """A verdict that empties a communicator's backlog drains its queued
+    pump wakeup from the QoS class lane — the scheduler must not burn a
+    slot serving work that no longer exists."""
+    from tempi_tpu.runtime import progress
+
+    with _world(monkeypatch, TEMPI_PROGRESS_THREAD="1") as comm:
+        # stall the engine so the queued wakeup cannot be served before
+        # the verdict drains it
+        faults.configure("p2p.progress:wedge:1.0:11")
+        s = _fill(comm, 1)
+        p2p.isend(comm, 0, s, 6, TY())
+        assert comm in progress._pump._queue._lanes["default"]
+        api.mark_failed(comm, 6)
+        assert not comm._pending
+        assert comm not in progress._pump._queue._lanes["default"]
+        faults.reset()
+
+
+def test_persistent_collective_refuses_start_on_dead_ranks(monkeypatch):
+    """ULFM semantics for the compiled collective: a handle on the parent
+    refuses start() with the verdict and a pointer at the recovery path."""
+    with _world(monkeypatch) as comm:
+        size = comm.size
+        counts = np.full((size, size), 8, np.int64)
+        np.fill_diagonal(counts, 0)
+        disp = np.tile(np.arange(size) * 8, (size, 1))
+        sb = comm.buffer_from_host(
+            [np.full(size * 8, r + 1, np.uint8) for r in range(size)])
+        rb = comm.alloc(size * 8)
+        pc = api.alltoallv_init(comm, sb, counts, disp, rb, counts.T, disp)
+        pc.start(); pc.wait()  # healthy replay works
+        api.mark_failed(comm, size - 1)
+        with pytest.raises(api.RankFailure, match="api.shrink"):
+            pc.start()
+
+
+# -- shrink -------------------------------------------------------------------
+
+
+def test_shrink_refuses_inflight_survivor_ops(monkeypatch):
+    with _world(monkeypatch) as comm:
+        api.mark_failed(comm, 7)
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, 1, TY())  # survivor-to-survivor
+        with pytest.raises(RuntimeError, match="epoch-boundary"):
+            api.shrink(comm)
+        p2p.cancel([req])
+        assert api.shrink(comm).size == comm.size - 1
+
+
+def test_shrink_renumbers_dist_graph(monkeypatch):
+    """A dist-graph parent's adjacency and edge weights renumber densely
+    over the survivors; the shrunk communicator exchanges correctly."""
+    with _world(monkeypatch) as world:
+        size = world.size
+        ring_s = [[(r - 1) % size] for r in range(size)]
+        ring_d = [[(r + 1) % size] for r in range(size)]
+        g = api.dist_graph_create_adjacent(world, ring_s, ring_d,
+                                           reorder=False)
+        api.mark_failed(g, size - 1)
+        new = api.shrink(g)
+        k = new.size
+        assert k == size - 1
+        assert sorted(new.graph) == list(range(k))
+        # the ring lost its wrap-through-the-dead-rank edges; every
+        # surviving edge stays within [0, k)
+        assert all(0 <= v < k for (u, v) in new.graph_edges)
+        assert all(0 <= u < k for (u, v) in new.graph_edges)
+        assert new.graph[0][0] == []  # 0's ring source was the dead rank
+        s, r = (new.buffer_from_host(
+            [np.full(64, rr + 1, np.uint8) for rr in range(k)]),
+            new.alloc(64))
+        p2p.waitall([p2p.isend(new, 0, s, 1, TY()),
+                     p2p.irecv(new, 1, r, 0, TY())])
+        np.testing.assert_array_equal(r.get_rank(1),
+                                      np.full(64, 1, np.uint8))
+
+
+def test_acceptance_shrink_story(monkeypatch):
+    """The ISSUE 9 acceptance story end-to-end: a permanently wedged
+    victim rank is detected via attributed timeouts, all survivors agree
+    on the same dead set, pending ops fail with RankFailure far below
+    the wait deadline, api.shrink yields a survivor communicator on
+    which a byte-verified persistent alltoallv compiles over the
+    survivor set, and api.ft_snapshot exposes the whole trail."""
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="2") as comm:
+        size = comm.size
+        victim = size - 1
+        s = _fill(comm, 1)
+        # the victim wedges: its ops never post. Two attributed timeouts
+        # cross the threshold; the second wait upgrades to RankFailure.
+        req = p2p.isend(comm, 0, s, victim, TY())
+        bystander = p2p.isend(comm, 3, s, victim, TY(), tag=5)
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        with pytest.raises(api.RankFailure):
+            p2p.waitall([req])
+        # fast revoke: the bystander fails in << TEMPI_WAIT_TIMEOUT_S
+        t0 = time.monotonic()
+        with pytest.raises(api.RankFailure):
+            p2p.wait(bystander)
+        assert time.monotonic() - t0 < 0.15
+        # every survivor's view converges (in-process agreement: one
+        # registry IS every rank's registry)
+        assert comm.dead_ranks == frozenset({victim})
+        snap = api.ft_snapshot()
+        assert snap["agreement"]["method"] == "in-process"
+        assert snap["comms"][0]["dead"] == [victim]
+        # shrink and byte-verify a persistent alltoallv over survivors
+        new = api.shrink(comm)
+        k = new.size
+        assert k == size - 1
+        compiles_before = api.counters_snapshot()["coll"]["num_compiles"]
+        counts = np.full((k, k), 8, np.int64)
+        np.fill_diagonal(counts, 0)
+        disp = np.tile(np.arange(k) * 8, (k, 1))
+        sb = new.buffer_from_host(
+            [np.full(k * 8, r + 1, np.uint8) for r in range(k)])
+        rb = new.alloc(k * 8)
+        pc = api.alltoallv_init(new, sb, counts, disp, rb, counts.T, disp)
+        pc.start(); pc.wait()
+        # the schedule recompiled over the survivor set (fresh comm,
+        # fresh plan cache — never a stale 8-rank replay)
+        assert api.counters_snapshot()["coll"]["num_compiles"] \
+            > compiles_before
+        for r in range(k):
+            expect = np.repeat(np.arange(1, k + 1), 8).astype(np.uint8)
+            expect[r * 8:(r + 1) * 8] = 0  # diagonal count 0
+            np.testing.assert_array_equal(rb.get_rank(r), expect)
+        c = api.counters_snapshot()["ft"]
+        assert c["num_verdicts"] == 1 and c["num_shrinks"] == 1
+        assert [e.get("kind", "verdict")
+                for e in api.ft_snapshot()["ledger"]] == ["verdict",
+                                                          "shrink"]
+
+
+# -- chaos (dual-marked for the -m faults smoke) ------------------------------
+
+
+@pytest.mark.faults
+def test_agree_chaos_defers_verdict_then_converges(monkeypatch):
+    """A raise at ft.agree fails THE VOTE, never half-applies a verdict:
+    suspicion is retained, the timeout stays a WaitTimeout, and once the
+    chaos clears the next timeout's retried vote converges."""
+    with _world(monkeypatch) as comm:
+        faults.configure("ft.agree:raise:1.0:17")
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, 5, TY())
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        assert comm.dead_ranks == frozenset()
+        snap = api.ft_snapshot()["comms"][0]
+        assert snap["suspects"] == {5: 1}  # suspicion retained
+        assert api.counters_snapshot()["ft"]["num_agree_failures"] == 1
+        faults.reset()
+        with pytest.raises(api.RankFailure):
+            p2p.waitall([req])  # retried vote converges
+        assert comm.dead_ranks == frozenset({5})
+
+
+@pytest.mark.faults
+def test_heartbeat_chaos_drops_stamps_never_the_exchange(monkeypatch):
+    with _world(monkeypatch) as comm:
+        faults.configure("ft.heartbeat:raise:1.0:23")
+        s, r = _fill(comm, 9), comm.alloc(64)
+        p2p.waitall([p2p.isend(comm, 0, s, 1, TY()),
+                     p2p.irecv(comm, 1, r, 0, TY())])
+        np.testing.assert_array_equal(r.get_rank(1),
+                                      np.full(64, 9, np.uint8))
+        assert api.counters_snapshot()["ft"][
+            "num_heartbeats_dropped"] >= 1
+        # no stamp landed anywhere (a comm with zero recorded liveness
+        # never even enters the registry)
+        assert all(c["heartbeat_age_s"] == {}
+                   for c in api.ft_snapshot()["comms"])
+
+
+@pytest.mark.faults
+def test_wedge_refused_at_ft_sites():
+    """A wedged vote would deadlock every survivor's verdict; a wedged
+    heartbeat hook runs under the progress lock. Both refuse the kind."""
+    for site in ("ft.agree", "ft.heartbeat"):
+        with pytest.raises(faults.FaultSpecError, match="wedge"):
+            faults.configure(f"{site}:wedge:1.0:1")
+
+
+@pytest.mark.faults
+def test_kill_a_rank_chaos_variant(monkeypatch):
+    """The kill-a-rank chaos story: with seeded chaos on BOTH ft sites
+    (votes failing half the time, heartbeat stamps dropping), a wedged
+    victim is still detected, agreed on, revoked, and shrunk around —
+    detection degrades to more timeouts, never to a wrong or divergent
+    verdict."""
+    with _world(monkeypatch, TEMPI_WAIT_TIMEOUT_S="0.15") as comm:
+        faults.configure("ft.agree:raise:0.5:97,ft.heartbeat:raise:0.5:5")
+        victim = 2
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, victim, TY())
+        deadline = time.monotonic() + 10.0
+        while not comm.dead_ranks and time.monotonic() < deadline:
+            with pytest.raises((p2p.WaitTimeout, api.RankFailure)):
+                p2p.waitall([req])
+        assert comm.dead_ranks == frozenset({victim})
+        new = api.shrink(comm)
+        assert new.size == comm.size - 1
+        s2, r2 = _fill(new, 5), new.alloc(64)
+        p2p.waitall([p2p.isend(new, 0, s2, 1, TY()),
+                     p2p.irecv(new, 1, r2, 0, TY())])
+        np.testing.assert_array_equal(r2.get_rank(1),
+                                      np.full(64, 5, np.uint8))
+        faults.reset()
+
+
+# -- registry lifecycle -------------------------------------------------------
+
+
+def test_snapshot_reads_empty_outside_sessions():
+    snap = api.ft_snapshot()
+    assert snap["mode"] == "off"
+    assert snap["ledger"] == [] and snap["comms"] == []
+
+
+def test_verdicts_reset_per_session(monkeypatch):
+    with _world(monkeypatch, TEMPI_FT="detect") as comm:
+        api.mark_failed(comm, 1)
+        assert api.ft_snapshot()["verdicts"] == 1
+    # finalize reset the registry (per-session, like counters)
+    assert api.ft_snapshot()["verdicts"] == 0
+    assert api.ft_snapshot()["comms"] == []

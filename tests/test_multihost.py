@@ -30,3 +30,71 @@ def test_dryrun_dcn_degenerate(monkeypatch):
     out = multihost.dryrun_dcn(ranks_per_node=64)
     assert out["num_nodes"] == 1
     assert not out["ok"] and "can't split" in out["reason"]
+
+
+def test_dryrun_dcn_restores_ranks_per_node_env(monkeypatch):
+    """ISSUE 9 satellite: dryrun_dcn used to leave TEMPI_RANKS_PER_NODE=4
+    in os.environ for the rest of the session — every later
+    read_environment (any init(), any test) silently inherited the
+    simulated node split. Both directions of the save/restore contract:
+    an unset variable is unset again, a preset value is put back."""
+    import os
+
+    from tempi_tpu.utils import env as envmod
+
+    monkeypatch.delenv("TEMPI_RANKS_PER_NODE", raising=False)
+    multihost.dryrun_dcn(ranks_per_node=4)
+    assert "TEMPI_RANKS_PER_NODE" not in os.environ
+    assert envmod.env.ranks_per_node == 0  # parsed env restored too
+
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    multihost.dryrun_dcn(ranks_per_node=4)
+    assert os.environ["TEMPI_RANKS_PER_NODE"] == "2"
+    assert envmod.env.ranks_per_node == 2
+
+
+def test_init_distributed_env_knobs_parse_loudly(monkeypatch):
+    """ISSUE 9 satellite: TEMPI_NUM_PROCESSES/TEMPI_PROCESS_ID used to go
+    through a bare int() — a typo died with a context-free ValueError (or
+    joined a mismatched world). They now parse via utils/env.int_env,
+    naming the knob, BEFORE the first connect attempt."""
+    calls = []
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(multihost, "_initialized", False)
+    monkeypatch.setenv("TEMPI_NUM_PROCESSES", "two")
+    with pytest.raises(ValueError, match="TEMPI_NUM_PROCESSES"):
+        multihost.init_distributed(coordinator_address="127.0.0.1:9999")
+    assert not calls  # the bad knob failed before any connect attempt
+    assert not multihost._initialized
+
+    monkeypatch.setenv("TEMPI_NUM_PROCESSES", "1")
+    monkeypatch.setenv("TEMPI_PROCESS_ID", "zero")
+    with pytest.raises(ValueError, match="TEMPI_PROCESS_ID"):
+        multihost.init_distributed(coordinator_address="127.0.0.1:9999")
+    assert not calls
+
+
+def test_int_env_helper_contract():
+    """utils/env.int_env: unset/empty -> None, integers parse, anything
+    else raises naming the knob (the loud-parse constraint)."""
+    from tempi_tpu.utils import env as envmod
+
+    assert envmod.int_env("TEMPI_NUM_PROCESSES", environ={}) is None
+    assert envmod.int_env("X", environ={"X": ""}) is None
+    assert envmod.int_env("X", environ={"X": " 3 "}) == 3
+    with pytest.raises(ValueError, match="bad X='3.5'"):
+        envmod.int_env("X", environ={"X": "3.5"})
+
+
+def test_init_distributed_warns_on_explicit_args_after_init(monkeypatch,
+                                                            capsys):
+    """ISSUE 9 satellite: explicit arguments after the world is already
+    initialized were silently ignored; now a loud warning says so."""
+    monkeypatch.setattr(multihost, "_initialized", True)
+    pidx, pcount = multihost.init_distributed(process_id=3)
+    assert pidx == 0 and pcount == 1  # single-host world: jax answers
+    assert "IGNORED" in capsys.readouterr().err
