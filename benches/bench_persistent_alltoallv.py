@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""One-shot vs persistent-replay alltoallv across skew patterns (ISSUE 5).
+"""One-shot vs persistent-replay alltoallv across skew patterns (ISSUE 5),
+plus the flat-vs-hierarchical plan A/B (ISSUE 10).
 
 The persistent API (`api.alltoallv_init` -> start/wait) pays matching,
 method choice, and schedule compilation once; this bench measures what that
@@ -12,12 +13,21 @@ of the engine:
   * skewed  — sparse plus a single large outlier pair (the skew-split and
               chunk-split shape)
 
-CSV columns: pattern, method, mode (oneshot|persistent), setup_s (init/
-compile wall time), time_s (trimean per exchange). The nonzero counters —
-including the coll.num_compiles/num_replays and plan cache hit/miss
-evidence — print to stderr via benches/_common.report_counters.
+`--hier flat,hier` grows the two-level A/B: the same persistent exchange
+compiled as today's flat plan vs the ICI x DCN hierarchy (per-node leader
+aggregation; `--ranks-per-node N` builds the synthetic multi-node topology
+a CPU mesh needs to exercise it without hardware — cpu-mesh-32 with
+`--ranks-per-node 4` is the judged shape). The hier/flat time ratio per
+pattern prints to stderr, and the nonzero counters — including the
+coll.hier_* evidence that the two-tier plan actually ran — print via
+benches/_common.report_counters.
+
+CSV columns: pattern, method, hier (flat|hier|auto), mode
+(oneshot|persistent), setup_s (init/compile wall time), time_s (trimean
+per exchange).
 """
 
+import os
 import sys
 import time
 
@@ -42,11 +52,23 @@ def main() -> int:
     p.add_argument("--scale", type=int, default=1 << 12)
     p.add_argument("--methods", default="auto,remote_first,isir_staged",
                    help="comma list: auto or AlltoallvMethod values")
+    p.add_argument("--hier", default="flat",
+                   help="comma list over flat|hier|auto: which plan "
+                        "families to A/B for the persistent path "
+                        "(e.g. --hier flat,hier,auto)")
+    p.add_argument("--ranks-per-node", type=int, default=0,
+                   help="synthetic TEMPI_RANKS_PER_NODE topology so a CPU "
+                        "mesh exercises the two-tier plan without "
+                        "hardware (0 = discover from the platform)")
     args = p.parse_args()
+    if args.ranks_per_node:
+        # before api.init(): topology discovery reads the knob there
+        os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
     setup_platform(args)
 
     from tempi_tpu import api
     from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import env as envmod
     from tempi_tpu.utils.env import AlltoallvMethod
 
     devices_or_die(1)
@@ -55,8 +77,15 @@ def main() -> int:
     kw = bench_kwargs(args.quick)
     methods = [None if m.strip() == "auto" else AlltoallvMethod(m.strip())
                for m in args.methods.split(",") if m.strip()]
+    hier_modes = [h.strip() for h in args.hier.split(",") if h.strip()]
+    for h in hier_modes:
+        if h not in ("flat", "hier", "auto"):
+            print(f"bad --hier entry {h!r}: want flat|hier|auto",
+                  file=sys.stderr)
+            return 2
 
     rows = []
+    ratios = {}  # pattern -> {hier_mode: best persistent time}
     for pattern, counts in make_patterns(size, args.scale, seed=5).items():
         sdispls, rdispls = make_displs(counts)
         nb_s = max(1, int(counts.sum(1).max()))
@@ -73,24 +102,51 @@ def main() -> int:
 
             oneshot()  # compile/caches hot
             r1 = benchmark(oneshot, **kw)
-            rows.append((pattern, label, "oneshot", 0.0, r1.trimean))
+            rows.append((pattern, label, "-", "oneshot", 0.0, r1.trimean))
 
-            t0 = time.perf_counter()
-            pc = api.alltoallv_init(comm, sb, counts, sdispls, rb,
-                                    counts.T, rdispls, method=method)
+            for hmode in hier_modes:
+                # the plan-family knob the compile consults; forced flat
+                # methods pin the flat plan regardless (hier competes
+                # only when the method choice is model-driven)
+                envmod.env.coll_hier = hmode
+                t0 = time.perf_counter()
+                pc = api.alltoallv_init(comm, sb, counts, sdispls, rb,
+                                        counts.T, rdispls, method=method)
 
-            def persistent():
-                pc.start()
-                pc.wait()
-                rb.data.block_until_ready()
+                def persistent():
+                    pc.start()
+                    pc.wait()
+                    rb.data.block_until_ready()
 
-            persistent()  # first start compiles the lowering's programs
-            setup = time.perf_counter() - t0
-            r2 = benchmark(persistent, **kw)
-            rows.append((pattern, label, "persistent", setup, r2.trimean))
-            pc.free()
+                persistent()  # first start compiles the lowering's programs
+                setup = time.perf_counter() - t0
+                r2 = benchmark(persistent, **kw)
+                rows.append((pattern, label, hmode, "persistent", setup,
+                             r2.trimean))
+                if hmode == "hier" and pc.method != "hier":
+                    # single-node topology / forced flat method: the row
+                    # above measured the FLAT plan — say so, and keep it
+                    # out of the speedup ratio so the A/B cannot misreport
+                    print(f"note: --hier hier ran method={pc.method!r} "
+                          f"for [{pattern}/{label}] (plan ineligible — "
+                          "pass --ranks-per-node for a multi-node "
+                          "topology)", file=sys.stderr)
+                elif method is None:
+                    best = ratios.setdefault(pattern, {})
+                    best[hmode] = min(best.get(hmode, float("inf")),
+                                      r2.trimean)
+                pc.free()
 
-    emit_csv(("pattern", "method", "mode", "setup_s", "time_s"), rows)
+    emit_csv(("pattern", "method", "hier", "mode", "setup_s", "time_s"),
+             rows)
+    # the acceptance ratio: hierarchical vs flat persistent replay (AUTO
+    # method), per pattern — >1 means the two-tier plan is faster
+    for pattern, best in ratios.items():
+        if "flat" in best and "hier" in best and best["hier"] > 0:
+            print(f"hier speedup [{pattern}]: "
+                  f"{best['flat'] / best['hier']:.2f}x "
+                  f"(flat {best['flat']:.3e}s vs hier "
+                  f"{best['hier']:.3e}s)", file=sys.stderr)
     api.finalize()
     return 0
 

@@ -61,10 +61,14 @@ def trace_report(path: str) -> int:
     if not rows:
         print(f"no span events ({instants} instant events)")
         return 1
-    print(f"{'span':>18} {'strategy':>10} {'count':>7} {'mean':>10} "
-          f"{'p50':>10} {'max':>10} {'total':>10}")
+    # the tier column splits hierarchical coll.round spans into their
+    # ici/dcn legs (ISSUE 10) — where a two-level exchange spends its
+    # time; flat spans print "-"
+    print(f"{'span':>18} {'strategy':>10} {'tier':>5} {'count':>7} "
+          f"{'mean':>10} {'p50':>10} {'max':>10} {'total':>10}")
     for r in rows:
-        print(f"{r['name']:>18} {r['strategy']:>10} {r['count']:>7} "
+        print(f"{r['name']:>18} {r['strategy']:>10} "
+              f"{r.get('tier', '-'):>5} {r['count']:>7} "
               f"{_fmt_t(r['mean_us'] / 1e6):>10} "
               f"{_fmt_t(r['p50_us'] / 1e6):>10} "
               f"{_fmt_t(r['max_us'] / 1e6):>10} "

@@ -23,6 +23,15 @@ Method set and lowering:
     (``send_init``-style requests at the reserved ``tags.COLL_SCHEDULE``
     tag) replayed through the p2p ``_PersistentBatch`` path; off-node
     rounds dispatch first (the schedule compiler's remote-first prefix).
+  * ``hier``          — the two-level (ICI x DCN) plan of
+    ``coll.schedule.compile_hier_schedule`` (ISSUE 10): off-node bytes
+    gather to per-node leaders over the intra-node tier, leaders exchange
+    ONE aggregated message per node pair over DCN (reserved
+    ``tags.COLL_HIER``), and the leaders scatter to local destinations —
+    DCN bytes move once per NODE instead of once per rank. Eligible only
+    on multi-node topologies with off-node traffic; competes in AUTO
+    costed per tier (TEMPI_COLL_HIER=auto) or is forced outright
+    (=hier); TEMPI_COLL_HIER=flat pins today's one-tier plan.
 
 AUTO method choice is model-driven with the established precedence:
 env-forced (explicit ``method=`` or a TEMPI_ALLTOALLV_* knob) > open
@@ -61,18 +70,23 @@ from ..utils.env import AlltoallvMethod
 from ..parallel import p2p, tags
 from ..parallel import plan as planmod
 from ..parallel.communicator import Communicator, DistBuffer
-from .schedule import Schedule, compile_schedule
+from .schedule import HierSchedule, Schedule, compile_hier_schedule, \
+    compile_schedule
 
 #: Transport strategy each collective method rides — the breaker/tune key
 #: space (runtime/health.py, tune/online.py) is per-p2p-strategy, so the
 #: health and drift evidence of the underlying transport steers the
-#: collective method the same way it steers individual exchanges.
+#: collective method the same way it steers individual exchanges. The
+#: hierarchical plan's DCN leg rides the device transport (its ICI legs
+#: are host staging), so a device breaker opening on a scheduled link
+#: steers AUTO away from it exactly like isir_remote_first.
 _UNDERLYING = {
     "device_fused": "device",
     "staged": "staged",
     "isir_remote_first": "device",
     "isir_staged": "staged",
     "isir_remote_staged": "staged",
+    "hier": "device",
 }
 
 #: The AUTO candidate set (isir_remote_staged is reachable only by forcing,
@@ -137,6 +151,29 @@ def _method_estimates(comm: Communicator, sched: Schedule,
     return est
 
 
+def _hier_estimate(hs: HierSchedule, sc: np.ndarray) -> float:
+    """Swept-sheet cost of the two-level plan, in seconds, mirroring what
+    the hier lowering actually executes: one bulk gather pass through the
+    host (D2H of the widest send row, H2D of the widest leader staging
+    row), the leader-exchange rounds back-to-back over the inter-node
+    tier, and one bulk scatter pass (D2H staging, H2D of the widest recv
+    row). Unmeasured curves price it at +inf — on an unmeasured system
+    AUTO keeps today's flat default, so the hierarchy must be forced to
+    run (TEMPI_COLL_HIER=hier), never guessed into."""
+    if not hs.phase_b:
+        return math.inf  # nothing crosses nodes: the flat plan by fiat
+    sp = msys.get()
+    out_max = int(sc.sum(axis=1).max())
+    in_max = int(sc.sum(axis=0).max())
+    t = msys.interp_time(sp.d2h, max(out_max, 1)) \
+        + msys.interp_time(sp.h2d, max(hs.gather_bytes, 1))
+    for rnd in hs.phase_b:
+        t += msys.model_direct_1d(max(m.nbytes for m in rnd), False)
+    t += msys.interp_time(sp.d2h, max(hs.scatter_bytes, 1)) \
+        + msys.interp_time(sp.h2d, max(in_max, 1))
+    return t
+
+
 def _tune_overlay(comm: Communicator, sc: np.ndarray, remote: np.ndarray,
                   est: Dict[str, float]) -> List[str]:
     """Scale the swept estimates by the drift-proven learned evidence of
@@ -168,14 +205,22 @@ def _tune_overlay(comm: Communicator, sc: np.ndarray, remote: np.ndarray,
 
 
 def _choose_method(comm: Communicator, sched: Schedule, sc: np.ndarray,
-                   remote: np.ndarray, links, forced: Optional[str]) -> str:
+                   remote: np.ndarray, links, forced: Optional[str],
+                   hier: Optional[HierSchedule] = None) -> str:
     """One method for the compiled schedule, with the established
-    precedence: env-forced > open breaker > tune > swept model."""
+    precedence: env-forced > open breaker > tune > swept model. When a
+    two-level plan is eligible (``hier`` non-None: multi-node topology,
+    off-node bytes, TEMPI_COLL_HIER=auto) it competes in the same AUTO
+    pool, costed per tier from the measured sheet — small or
+    already-local matrices keep today's flat plan because the hierarchy's
+    fixed staging passes never pay off for them."""
     if forced is not None:
         if obstrace.ENABLED:
             obstrace.emit("coll.choice", method=forced, forced=True)
         return forced
     est = _method_estimates(comm, sched, sc)
+    if hier is not None:
+        est["hier"] = _hier_estimate(hier, sc)
     tuned = _tune_overlay(comm, sc, remote, est) \
         if tune_online.ADAPTING else []
     quarantined = []
@@ -378,6 +423,144 @@ class _IsirLowering:
                 pass  # waitall's own failure paths restore restartability
 
 
+class _HierLowering:
+    """``hier``: the two-level (ICI x DCN) plan of
+    :func:`coll.schedule.compile_hier_schedule`, executed as
+
+      round 0                — ONE bulk gather pass through the host:
+                               every rank's off-node segments land in its
+                               node leader's outbound staging buffer (the
+                               fully-addressable collapse of the compiled
+                               phase-A rounds — host staging IS the
+                               intra-node transport here, the reference's
+                               "host staging where it pays");
+      rounds 1..B            — the compiled phase-B rounds as persistent
+                               p2p batches at the reserved
+                               ``tags.COLL_HIER`` tag, device transport:
+                               ONE aggregated message per (src node, dst
+                               node) pair instead of one per rank pair —
+                               the DCN-bytes-move-once-per-node win;
+      round B+1              — ONE bulk scatter pass: completes the DCN
+                               batches, then forwards staged bytes to
+                               their local destinations and applies the
+                               purely-local direct segments.
+
+    Staging buffers are allocated once at compile (leader rows sized for
+    the widest aggregate; non-leader rows idle). Rounds are idempotent
+    for the per-round retry loop: the host passes rebuild their output
+    from scratch and a DCN batch guards against double-start exactly like
+    ``_IsirLowering``. Multi-controller worlds (partially-addressable
+    buffers) cannot host-stage across the node and degrade to
+    ``device_fused`` at build time — same rationale as ``staged``."""
+
+    def __init__(self, comm, sendbuf, recvbuf, hs: HierSchedule):
+        from ..parallel.alltoallv import _lib_perm
+        self.comm, self.sendbuf, self.recvbuf = comm, sendbuf, recvbuf
+        self.hs = hs
+        self._gstage = comm.alloc(max(1, hs.gather_bytes))
+        self._sstage = comm.alloc(max(1, hs.scatter_bytes))
+        lib = _lib_perm(comm)
+        seg = lambda m: (int(lib[m.src]), int(lib[m.dst]),  # noqa: E731
+                         m.soffset, m.roffset, m.nbytes)
+        self._gather_segs = [seg(m) for rnd in hs.phase_a for m in rnd
+                             if m.kind == "gather"]
+        self._direct_segs = [seg(m) for rnd in hs.phase_a for m in rnd
+                             if m.kind == "direct"]
+        self._scatter_segs = [seg(m) for rnd in hs.phase_c for m in rnd]
+        self.round_batches: List[List[Tuple[list, str]]] = []
+        for rnd in hs.phase_b:
+            preqs = []
+            for m in rnd:
+                preqs.append(p2p.PersistentRequest(
+                    "send", comm, m.src, self._gstage, m.dst, dtypes.BYTE,
+                    m.nbytes, tags.COLL_HIER, m.soffset, internal=True))
+                preqs.append(p2p.PersistentRequest(
+                    "recv", comm, m.dst, self._sstage, m.src, dtypes.BYTE,
+                    m.nbytes, tags.COLL_HIER, m.roffset, internal=True))
+            self.round_batches.append([(preqs, "device")])
+        self.num_rounds = len(self.round_batches) + 2
+        a_msgs = sum(len(rnd) for rnd in hs.phase_a)
+        a_bytes = sum(m.nbytes for rnd in hs.phase_a for m in rnd)
+        c_msgs = sum(len(rnd) for rnd in hs.phase_c)
+        c_bytes = sum(m.nbytes for rnd in hs.phase_c for m in rnd)
+        self._round_stats = [(a_msgs, a_bytes)] \
+            + [(len(rnd), sum(m.nbytes for m in rnd))
+               for rnd in hs.phase_b] + [(c_msgs, c_bytes)]
+
+    def run_round(self, ri: int) -> None:
+        if ri == 0:
+            self._gather()
+        elif ri <= len(self.round_batches):
+            for preqs, strat in self.round_batches[ri - 1]:
+                if preqs and preqs[0].active is not None:
+                    continue  # a retry must not double-start the batch
+                p2p.startall(preqs, strat)
+        else:
+            self._scatter()
+
+    def _gather(self) -> None:
+        import jax
+        comm = self.comm
+        with comm._progress_lock:
+            host_s = np.ascontiguousarray(np.asarray(self.sendbuf.data))
+            host_g = np.zeros(self._gstage.data.shape, np.uint8)
+            for ls, ld, so, ro, nb in self._gather_segs:
+                host_g[ld, ro: ro + nb] = host_s[ls, so: so + nb]
+            self._gstage.data = jax.device_put(host_g, comm.sharding())
+
+    def _scatter(self) -> None:
+        import jax
+        # complete the DCN exchange OUTSIDE the lock (waitall drives its
+        # own progress), then stage the received bytes out under it
+        started = [p for p in self._all_preqs() if p.active is not None]
+        if started:
+            p2p.waitall_persistent(started)
+        comm = self.comm
+        with comm._progress_lock:
+            host_in = np.ascontiguousarray(np.asarray(self._sstage.data))
+            host_r = np.array(self.recvbuf.data, copy=True, order="C")
+            for ls, ld, so, ro, nb in self._scatter_segs:
+                host_r[ld, ro: ro + nb] = host_in[ls, so: so + nb]
+            if self._direct_segs:
+                # only a matrix WITH same-node pairs pays this second
+                # sendbuf D2H; a fully off-node exchange already moved
+                # everything through the gather pass
+                host_s = np.ascontiguousarray(np.asarray(self.sendbuf.data))
+                for ls, ld, so, ro, nb in self._direct_segs:
+                    host_r[ld, ro: ro + nb] = host_s[ls, so: so + nb]
+            self.recvbuf.data = jax.device_put(host_r, comm.sharding())
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._round_stats[ri]
+
+    def round_tier(self, ri: int) -> str:
+        return "dcn" if 0 < ri <= len(self.round_batches) else "ici"
+
+    def _all_preqs(self) -> list:
+        return [p for batches in self.round_batches
+                for preqs, _ in batches for p in preqs]
+
+    def poll(self) -> bool:
+        # the scatter pass already completed every DCN batch; only the
+        # final H2D of the recv buffer can still be in flight
+        return p2p._buf_ready(self.recvbuf)
+
+    def finish(self) -> None:
+        p2p._sync_bufs([self.recvbuf], deadline=p2p._deadline())
+
+    def abort(self) -> None:
+        """A failed start leaves the handle restartable: in-flight DCN
+        batches are completed/withdrawn (same contract as
+        ``_IsirLowering.abort``); staging contents are rebuilt from
+        scratch by the next gather pass."""
+        started = [p for p in self._all_preqs() if p.active is not None]
+        if started:
+            try:
+                p2p.waitall_persistent(started)
+            except Exception:
+                pass  # waitall's own failure paths restore restartability
+
+
 # -- the persistent collective handle ----------------------------------------
 
 
@@ -410,25 +593,13 @@ class PersistentColl:
         m = method or envmod.env.alltoallv
         self._forced = _FORCED_BY_ENUM.get(m)  # None = model-driven
         self._chunk = envmod.env.coll_chunk_bytes
-        lib = [comm.library_rank(a) for a in range(comm.size)]
-        self._remote = np.zeros_like(sc, dtype=bool)
-        for a, p in zip(*np.nonzero(sc)):
-            self._remote[a, p] = not comm.is_colocated(lib[int(a)],
-                                                       lib[int(p)])
-        self.links = {health.link(lib[int(a)], lib[int(p)])
-                      for a, p in zip(*np.nonzero(sc))}
-        # the schedule is pure (matrix, topology, chunk) -> rounds: cached
-        # per communicator so N identical alltoallv_init calls compile one
-        # schedule (the plan cache's hit/miss counters are the evidence)
-        key = ("coll-sched", self._chunk, sc.tobytes(), sd.tobytes(),
-               rd.tobytes())
-        with comm._progress_lock:
-            sched = planmod.cache_get(comm, key)
-            if not isinstance(sched, Schedule):
-                sched = compile_schedule(sc, sd, rd, self._remote,
-                                         self._chunk)
-                planmod.cache_put(comm, key, sched)
-        self.schedule: Schedule = sched
+        ici = envmod.env.coll_chunk_bytes_ici
+        dcn = envmod.env.coll_chunk_bytes_dcn
+        self._chunk_ici = ici if ici >= 0 else self._chunk
+        self._chunk_dcn = dcn if dcn >= 0 else self._chunk
+        self._hier_mode = envmod.env.coll_hier
+        self._derive_topology()
+        self._compile_schedules()
         self.method: str = ""
         self._lowering = None
         self._active = False
@@ -442,9 +613,82 @@ class PersistentColl:
 
     # -- compile / recompile --------------------------------------------------
 
+    def _derive_topology(self) -> None:
+        """Everything the compile derives from the CURRENT app->library
+        mapping: per-pair remote flags, the breaker-key link set, the
+        app-rank node map, and the elected node leaders (topology.leaders
+        translated into application-rank space — the schedule compiler is
+        comm-free)."""
+        comm = self.comm
+        lib = [comm.library_rank(a) for a in range(comm.size)]
+        self._remote = np.zeros_like(self.sc, dtype=bool)
+        for a, p in zip(*np.nonzero(self.sc)):
+            self._remote[a, p] = not comm.is_colocated(lib[int(a)],
+                                                       lib[int(p)])
+        self.links = {health.link(lib[int(a)], lib[int(p)])
+                      for a, p in zip(*np.nonzero(self.sc))}
+        topo = comm.topology
+        self._node_of = [topo.node_of_rank[lib[a]]
+                         for a in range(comm.size)]
+        self._leaders = [comm.application_rank(r) for r in topo.leaders()]
+
+    def _hier_eligible(self) -> bool:
+        """A two-level plan exists only where it can pay: a multi-node
+        topology with off-node bytes, no forced flat method, and
+        TEMPI_COLL_HIER not pinned to flat. Single-node topologies and
+        all-local matrices keep today's flat plan identically."""
+        return (self._hier_mode != "flat" and self._forced is None
+                and len(set(self._node_of)) > 1
+                and bool(self._remote.any()))
+
+    def _compile_schedules(self) -> None:
+        """Compile (or cache-hit) the flat schedule, and the two-level
+        plan when one is eligible. Both are pure (matrix, topology,
+        tier-config) -> rounds artifacts, cached per communicator so N
+        identical alltoallv_init calls compile each once (the plan
+        cache's hit/miss counters are the evidence); the hier key grows
+        the tier config — per-tier chunk thresholds, node map, leaders —
+        so a re-placement epoch or a knob change can never read back a
+        stale plan."""
+        comm = self.comm
+        key = planmod.coll_schedule_key("flat", (self._chunk,),
+                                        self.sc, self.sd, self.rd)
+        with comm._progress_lock:
+            sched = planmod.cache_get(comm, key)
+            if not isinstance(sched, Schedule):
+                sched = compile_schedule(self.sc, self.sd, self.rd,
+                                         self._remote, self._chunk)
+                planmod.cache_put(comm, key, sched)
+            self.schedule: Schedule = sched
+            self.hier_schedule: Optional[HierSchedule] = None
+            if self._hier_eligible():
+                hkey = planmod.coll_schedule_key(
+                    "hier", (self._chunk_ici, self._chunk_dcn,
+                             tuple(self._node_of), tuple(self._leaders)),
+                    self.sc, self.sd, self.rd)
+                hs = planmod.cache_get(comm, hkey)
+                if not isinstance(hs, HierSchedule):
+                    hs = compile_hier_schedule(
+                        self.sc, self.sd, self.rd, self._node_of,
+                        self._leaders, self._chunk_ici, self._chunk_dcn)
+                    planmod.cache_put(comm, hkey, hs)
+                self.hier_schedule = hs
+
+    def _choose(self) -> str:
+        """TEMPI_COLL_HIER=hier forces the two-level plan wherever one is
+        eligible (the env-forced arm of the precedence — never overridden
+        by breakers, like an env-forced method); otherwise the eligible
+        hier plan competes in the model-driven AUTO choice."""
+        if self._hier_mode == "hier" and self.hier_schedule is not None:
+            if obstrace.ENABLED:
+                obstrace.emit("coll.choice", method="hier", forced=True)
+            return "hier"
+        return _choose_method(self.comm, self.schedule, self.sc,
+                              self._remote, self.links, self._forced,
+                              hier=self.hier_schedule)
+
     def _compile(self, recompile: bool = False) -> None:
-        method = _choose_method(self.comm, self.schedule, self.sc,
-                                self._remote, self.links, self._forced)
+        method = self._choose()
         if recompile and method == self.method:
             # no healthier alternative exists (e.g. every transport's
             # breaker open): keep replaying the compiled plan rather than
@@ -463,6 +707,23 @@ class PersistentColl:
         addressable = all(
             getattr(b.data, "is_fully_addressable", True)
             for b in (self.sendbuf, self.recvbuf))
+        if method == "hier":
+            if not addressable or self.hier_schedule is None:
+                # the gather/scatter host passes need every local shard;
+                # multi-controller worlds take the device path (same
+                # rationale as the staged degrade below)
+                log.debug("hierarchical plan on a partially-addressable "
+                          "buffer: lowering to device_fused")
+                method = "device_fused"
+            else:
+                low = _HierLowering(self.comm, self.sendbuf, self.recvbuf,
+                                    self.hier_schedule)
+                ctr.counters.coll.hier_compiles += 1
+                ctr.counters.coll.hier_dcn_msgs += \
+                    self.hier_schedule.dcn_msgs
+                ctr.counters.coll.hier_dcn_bytes += \
+                    self.hier_schedule.dcn_bytes
+                return low
         if method == "staged" and not addressable:
             # the bulk host permute needs every shard; multi-controller
             # worlds take the device path (same rationale as the one-shot
@@ -492,26 +753,13 @@ class PersistentColl:
         permutation). Env-forced METHODS are still honored — only the
         mapping-derived state refreshes."""
         comm = self.comm
-        lib = [comm.library_rank(a) for a in range(comm.size)]
-        self._remote = np.zeros_like(self.sc, dtype=bool)
-        for a, p in zip(*np.nonzero(self.sc)):
-            self._remote[a, p] = not comm.is_colocated(lib[int(a)],
-                                                       lib[int(p)])
-        self.links = {health.link(lib[int(a)], lib[int(p)])
-                      for a, p in zip(*np.nonzero(self.sc))}
-        key = ("coll-sched", self._chunk, self.sc.tobytes(),
-               self.sd.tobytes(), self.rd.tobytes())
-        with comm._progress_lock:
-            # the apply step dropped the plan cache, so this compiles
-            # fresh (and re-caches for sibling handles on the same comm)
-            sched = planmod.cache_get(comm, key)
-            if not isinstance(sched, Schedule):
-                sched = compile_schedule(self.sc, self.sd, self.rd,
-                                         self._remote, self._chunk)
-                planmod.cache_put(comm, key, sched)
-        self.schedule = sched
-        self.method = _choose_method(comm, self.schedule, self.sc,
-                                     self._remote, self.links, self._forced)
+        self._derive_topology()
+        # the apply step dropped the plan cache, so this compiles fresh
+        # (and re-caches for sibling handles on the same comm); the hier
+        # plan rebuilds too — its node map, leaders, and staging layout
+        # all embed the old permutation
+        self._compile_schedules()
+        self.method = self._choose()
         self._lowering = self._build_lowering(self.method)
         self._mapping_epoch = comm.mapping_epoch
         ctr.counters.coll.num_compiles += 1
@@ -526,6 +774,8 @@ class PersistentColl:
         never recompile (explicit configuration is never overridden)."""
         if self._forced is not None or not health.TRIPPED:
             return False
+        if self.method == "hier" and self._hier_mode == "hier":
+            return False  # explicitly forced plan: never overridden
         us = _UNDERLYING[self.method]
         return any(health.state(lk, us) == health.OPEN for lk in self.links)
 
@@ -563,11 +813,15 @@ class PersistentColl:
             self._compile(recompile=True)
         if self._started:
             ctr.counters.coll.num_replays += 1
+            if isinstance(self._lowering, _HierLowering):
+                ctr.counters.coll.hier_replays += 1
         retries = envmod.env.retry_attempts
         low = self._lowering
+        hier = isinstance(low, _HierLowering)
         try:
             for ri in range(low.num_rounds):
                 t0 = time.monotonic() if obstrace.ENABLED else 0.0
+                tier = low.round_tier(ri) if hier else None
                 attempt = 0
                 while True:
                     try:
@@ -575,6 +829,8 @@ class PersistentColl:
                             # BEFORE the round dispatches: a raise never
                             # leaves a round half-applied
                             faults.check("coll.round")
+                            if hier:
+                                faults.check("coll.hier_round")
                         low.run_round(ri)
                         break
                     except Exception:
@@ -586,12 +842,17 @@ class PersistentColl:
                         if delay > 0:
                             time.sleep(delay)
                 ctr.counters.coll.num_rounds += 1
+                if tier == "ici":
+                    ctr.counters.coll.hier_rounds_ici += 1
+                elif tier == "dcn":
+                    ctr.counters.coll.hier_rounds_dcn += 1
                 if obstrace.ENABLED:
                     msgs, nbytes = low.round_stats(ri)
+                    extra = {"tier": tier} if tier else {}
                     obstrace.emit_span("coll.round", t0, round=ri,
                                        msgs=msgs, nbytes=nbytes,
                                        method=self.method,
-                                       retries=attempt)
+                                       retries=attempt, **extra)
         except BaseException:
             low.abort()
             raise

@@ -29,6 +29,32 @@ pair behind the round that carries it — the round-level analog of the
 skew-split threshold the fused one-shot path applies
 (``alltoallv._split_threshold``).
 
+**Two-level plans (ISSUE 10).** :func:`compile_hier_schedule` generalizes
+the flat schedule to the ICI x DCN hierarchy of a multihost pod: the
+network is two tiers, not one flat mesh, and a 32-rank exchange that
+prices every pair at flat-mesh cost pays DCN latency once per RANK PAIR
+when it only needs to pay it once per NODE PAIR. The hierarchical plan has
+three phases:
+
+  * **phase A (gather, ICI)** — every rank forwards its off-node bytes to
+    its node's leader; purely-local (src node == dst node) traffic rides
+    the same intra-node rounds as direct messages.
+  * **phase B (exchange, DCN)** — leaders exchange ONE aggregated message
+    per (source node, destination node) pair, matched at node granularity:
+    per round no leader sends twice or receives twice, and no DCN message
+    ever runs between non-leader ranks.
+  * **phase C (scatter, ICI)** — each leader forwards the received
+    aggregate to the local destination ranks.
+
+Phase A/C messages chunk against the ICI threshold
+(TEMPI_COLL_CHUNK_BYTES_ICI), phase B against the DCN threshold
+(TEMPI_COLL_CHUNK_BYTES_DCN) — the two tiers have very different
+bandwidth-delay products, so one knob cannot serve both. The invariants
+the runtime (and the property tests) rely on: per-tier matching, leader
+conservation (phase-B bytes into a node == phase-C bytes out of its
+leader), no DCN message between non-leaders, and exact end-to-end
+delivery (``simulate`` replays the three phases over numpy buffers).
+
 Pure Python/numpy: no jax, no communicator, no I/O — the compiler is
 deterministic for a given (matrix, topology, chunk) input, which is what
 makes the compiled artifact cacheable under ``plan.cache_get/cache_put``.
@@ -37,7 +63,7 @@ makes the compiled artifact cacheable under ``plan.cache_get/cache_put``.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -151,31 +177,311 @@ def compile_schedule(sc: np.ndarray, sd: np.ndarray, rd: np.ndarray,
     busy_s: List[set] = []
     busy_r: List[set] = []
 
-    def place(parts: List[SMsg]) -> None:
-        last = -1  # chunks of one pair ride strictly increasing rounds
-        for p in parts:
-            k = last + 1
-            while True:
-                if k == len(rounds):
-                    rounds.append([])
-                    busy_s.append(set())
-                    busy_r.append(set())
-                if p.src not in busy_s[k] and p.dst not in busy_r[k]:
-                    rounds[k].append(p)
-                    busy_s[k].add(p.src)
-                    busy_r[k].add(p.dst)
-                    last = k
-                    break
-                k += 1
-
     for parts in remote_pairs:
-        place(parts)
+        _place(parts, rounds, busy_s, busy_r)
     # every round created so far carries >= 1 off-node message; local
     # fill-in below can only reuse those rounds or append after them, so
     # the remote prefix property holds by construction
     sched.remote_rounds = len(rounds)
     for parts in local_pairs:
-        place(parts)
+        _place(parts, rounds, busy_s, busy_r)
 
     sched.rounds = rounds
+    return sched
+
+
+def _place(parts: Sequence, rounds: List[list], busy_s: List[set],
+           busy_r: List[set]) -> None:
+    """Greedy matching insertion shared by the flat and hierarchical
+    compilers: each chunk lands in the earliest round where its sender and
+    receiver are both free, and chunks of one pair ride strictly
+    increasing rounds (a split message flows in offset order). A
+    self-message (src == dst) occupies both slots of its rank."""
+    last = -1
+    for p in parts:
+        k = last + 1
+        while True:
+            if k == len(rounds):
+                rounds.append([])
+                busy_s.append(set())
+                busy_r.append(set())
+            if p.src not in busy_s[k] and p.dst not in busy_r[k]:
+                rounds[k].append(p)
+                busy_s[k].add(p.src)
+                busy_r[k].add(p.dst)
+                last = k
+                break
+            k += 1
+
+
+# -- two-level (ICI x DCN) plans ----------------------------------------------
+
+
+#: Hierarchical message kinds, in dataflow order: ``direct`` moves
+#: sendbuf -> recvbuf (same-node pair), ``gather`` moves sendbuf -> the
+#: leader's outbound staging, ``xnode`` moves leader staging -> leader
+#: staging over DCN, ``scatter`` moves inbound staging -> recvbuf.
+HIER_KINDS = ("direct", "gather", "xnode", "scatter")
+
+
+@dataclass(frozen=True)
+class HMsg:
+    """One scheduled hierarchical message (or chunk of one). Offsets are
+    interpreted per ``kind``: the source offset indexes the buffer the
+    kind reads (sendbuf for direct/gather, the leader's outbound staging
+    for xnode, the leader's inbound staging for scatter) and the
+    destination offset the buffer it writes."""
+
+    kind: str
+    src: int
+    dst: int
+    soffset: int
+    roffset: int
+    nbytes: int
+    tier: str  # "ici" | "dcn"
+
+
+@dataclass
+class HierSchedule:
+    """A compiled three-phase (gather / exchange / scatter) plan over one
+    (matrix, node map, tier-chunk) input."""
+
+    size: int
+    node_of: List[int]
+    leaders: List[int]           # leader app rank per node id
+    phase_a: List[List[HMsg]] = field(default_factory=list)  # ICI rounds
+    phase_b: List[List[HMsg]] = field(default_factory=list)  # DCN rounds
+    phase_c: List[List[HMsg]] = field(default_factory=list)  # ICI rounds
+    chunk_ici: int = 0
+    chunk_dcn: int = 0
+    total_bytes: int = 0
+    gather_bytes: int = 0        # widest per-leader outbound staging row
+    scatter_bytes: int = 0       # widest per-leader inbound staging row
+    dcn_msgs: int = 0            # aggregated node-pair messages (unchunked)
+    dcn_bytes: int = 0           # total bytes crossing DCN
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.leaders)
+
+    def phases(self) -> List[Tuple[str, List[List[HMsg]]]]:
+        return [("ici", self.phase_a), ("dcn", self.phase_b),
+                ("ici", self.phase_c)]
+
+    # -- property-check helpers (the two-tier invariants) ---------------------
+
+    def check_matchings(self) -> None:
+        """Per-tier matching: within any round of any phase no rank sends
+        twice or receives twice. Phase B is additionally matched at node
+        granularity for free — one leader per node."""
+        for pname, rounds in (("A", self.phase_a), ("B", self.phase_b),
+                              ("C", self.phase_c)):
+            for ri, rnd in enumerate(rounds):
+                senders = [m.src for m in rnd]
+                receivers = [m.dst for m in rnd]
+                if len(set(senders)) != len(senders) \
+                        or len(set(receivers)) != len(receivers):
+                    raise AssertionError(
+                        f"phase {pname} round {ri} is not a matching: "
+                        f"senders={senders} receivers={receivers}")
+
+    def check_tier_separation(self) -> None:
+        """Phase A/C messages stay on one node (ICI); every phase-B
+        message runs leader-to-leader across nodes (DCN) — no DCN message
+        between non-leader ranks, ever."""
+        leaders = set(self.leaders)
+        for rnd in self.phase_a:
+            for m in rnd:
+                assert m.tier == "ici" and m.kind in ("direct", "gather")
+                assert self.node_of[m.src] == self.node_of[m.dst], \
+                    f"phase A message {m} crosses nodes"
+        for rnd in self.phase_b:
+            for m in rnd:
+                assert m.tier == "dcn" and m.kind == "xnode"
+                assert m.src in leaders and m.dst in leaders, \
+                    f"DCN message {m} between non-leader ranks"
+                assert self.node_of[m.src] != self.node_of[m.dst], \
+                    f"phase B message {m} stays on one node"
+        for rnd in self.phase_c:
+            for m in rnd:
+                assert m.tier == "ici" and m.kind == "scatter"
+                assert self.node_of[m.src] == self.node_of[m.dst], \
+                    f"phase C message {m} crosses nodes"
+
+    def check_leader_conservation(self) -> None:
+        """Every byte a node's leader receives over DCN leaves it over ICI:
+        phase-B bytes INTO leader(Y) == phase-C bytes OUT of leader(Y)
+        (a leader's own incoming bytes count — they ride a phase-C
+        self-scatter)."""
+        b_in: Dict[int, int] = {}
+        c_out: Dict[int, int] = {}
+        for rnd in self.phase_b:
+            for m in rnd:
+                b_in[m.dst] = b_in.get(m.dst, 0) + m.nbytes
+        for rnd in self.phase_c:
+            for m in rnd:
+                c_out[m.src] = c_out.get(m.src, 0) + m.nbytes
+        if b_in != c_out:
+            raise AssertionError(
+                f"leader conservation violated: DCN-in {b_in} != "
+                f"scatter-out {c_out}")
+
+    def simulate(self, send_rows: List[np.ndarray], recv_nbytes: int
+                 ) -> List[np.ndarray]:
+        """Replay the three phases over plain numpy buffers — the
+        executable definition of exact end-to-end delivery the property
+        tests compare against the one-shot oracle."""
+        gstage = [np.zeros(self.gather_bytes, np.uint8)
+                  for _ in range(self.size)]
+        sstage = [np.zeros(self.scatter_bytes, np.uint8)
+                  for _ in range(self.size)]
+        recv = [np.zeros(recv_nbytes, np.uint8) for _ in range(self.size)]
+        for rnd in self.phase_a:
+            for m in rnd:
+                seg = send_rows[m.src][m.soffset: m.soffset + m.nbytes]
+                if m.kind == "direct":
+                    recv[m.dst][m.roffset: m.roffset + m.nbytes] = seg
+                else:
+                    gstage[m.dst][m.roffset: m.roffset + m.nbytes] = seg
+        for rnd in self.phase_b:
+            for m in rnd:
+                sstage[m.dst][m.roffset: m.roffset + m.nbytes] = \
+                    gstage[m.src][m.soffset: m.soffset + m.nbytes]
+        for rnd in self.phase_c:
+            for m in rnd:
+                recv[m.dst][m.roffset: m.roffset + m.nbytes] = \
+                    sstage[m.src][m.soffset: m.soffset + m.nbytes]
+        return recv
+
+
+def compile_hier_schedule(sc: np.ndarray, sd: np.ndarray, rd: np.ndarray,
+                          node_of: Sequence[int], leaders: Sequence[int],
+                          chunk_ici: int = 0, chunk_dcn: int = 0
+                          ) -> HierSchedule:
+    """Compile byte matrices into a two-level (ICI x DCN) plan.
+
+    ``sc``/``sd``/``rd`` exactly as :func:`compile_schedule`; ``node_of``
+    maps each application rank to its node id and ``leaders`` names the
+    leader application rank of each node (``parallel.topology`` elects
+    them; the compiler stays comm-free). Off-node (src, dst) segments are
+    laid out in the leaders' staging buffers in sorted (src node, dst
+    node, src, dst) order, so a phase-B node-pair message is ONE
+    contiguous block on both sides and phase C finds every segment at a
+    mirror offset.
+    """
+    size = sc.shape[0]
+    assert sc.shape == (size, size), "counts must be a square byte matrix"
+    assert len(node_of) == size
+    node_of = [int(n) for n in node_of]
+    leaders = [int(a) for a in leaders]
+    for n, lead in enumerate(leaders):
+        assert node_of[lead] == n, \
+            f"leader {lead} of node {n} lives on node {node_of[lead]}"
+    sched = HierSchedule(size=size, node_of=node_of, leaders=leaders,
+                         chunk_ici=int(chunk_ici), chunk_dcn=int(chunk_dcn),
+                         total_bytes=int(sc.sum()))
+
+    # partition pairs by locality; group remote pairs by (src node, dst
+    # node) in the deterministic staging order
+    local_pairs: List[Tuple[int, int, int]] = []
+    blocks: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+    for s, d in zip(*np.nonzero(sc)):
+        s, d = int(s), int(d)
+        n = int(sc[s, d])
+        X, Y = node_of[s], node_of[d]
+        if X == Y:
+            local_pairs.append((s, d, n))
+        else:
+            blocks.setdefault((X, Y), []).append((s, d, n))
+
+    # staging layout: per leader, outbound blocks ordered by dst node and
+    # inbound blocks by src node; within a block segments sort by (s, d).
+    # out_off/in_off index the (X, Y) block starts; seg_off the segment
+    # offsets WITHIN a block (identical on both sides — mirror layout)
+    out_used = [0] * len(leaders)
+    in_used = [0] * len(leaders)
+    out_off: Dict[Tuple[int, int], int] = {}
+    in_off: Dict[Tuple[int, int], int] = {}
+    seg_off: Dict[Tuple[int, int], int] = {}
+    for (X, Y) in sorted(blocks):
+        segs = sorted(blocks[(X, Y)])
+        total = sum(n for _, _, n in segs)
+        out_off[(X, Y)] = out_used[X]
+        in_off[(X, Y)] = in_used[Y]
+        out_used[X] += total
+        in_used[Y] += total
+        off = 0
+        for s, d, n in segs:
+            seg_off[(s, d)] = off
+            off += n
+    sched.gather_bytes = max(out_used, default=0)
+    sched.scatter_bytes = max(in_used, default=0)
+    sched.dcn_msgs = len(blocks)
+    sched.dcn_bytes = sum(n for segs in blocks.values()
+                          for _, _, n in segs)
+
+    def chunked(kind, src, dst, soff, roff, n, chunk, tier):
+        parts, off = [], 0
+        for pn in _chunks(n, chunk):
+            parts.append(HMsg(kind=kind, src=src, dst=dst,
+                              soffset=soff + off, roffset=roff + off,
+                              nbytes=pn, tier=tier))
+            off += pn
+        return parts
+
+    # biggest pairs first pack the tightest rounds; (src, dst) tiebreak
+    # keeps the artifact reproducible (same policy as the flat compiler)
+    key = lambda pl: (-sum(p.nbytes for p in pl), pl[0].src, pl[0].dst)  # noqa: E731
+
+    # phase A: gather every off-node segment to its node leader; local
+    # direct pairs fill the free slots of the same ICI rounds (they steal
+    # no gather slot — the greedy matching keeps the pair sets disjoint)
+    gather_pairs = []
+    for (X, Y), segs in sorted(blocks.items()):
+        lead = leaders[X]
+        for s, d, n in sorted(segs):
+            gather_pairs.append(chunked(
+                "gather", s, lead, int(sd[s, d]),
+                out_off[(X, Y)] + seg_off[(s, d)], n, chunk_ici, "ici"))
+    direct_pairs = [chunked("direct", s, d, int(sd[s, d]), int(rd[d, s]),
+                            n, chunk_ici, "ici")
+                    for s, d, n in local_pairs]
+    gather_pairs.sort(key=key)
+    direct_pairs.sort(key=key)
+    rounds: List[List[HMsg]] = []
+    busy_s: List[set] = []
+    busy_r: List[set] = []
+    for parts in gather_pairs + direct_pairs:
+        _place(parts, rounds, busy_s, busy_r)
+    sched.phase_a = rounds
+
+    # phase B: one aggregated message per (src node, dst node), leader to
+    # leader, matched at node granularity, chunked at the DCN threshold
+    xnode_pairs = []
+    for (X, Y) in sorted(blocks):
+        total = sum(n for _, _, n in blocks[(X, Y)])
+        xnode_pairs.append(chunked("xnode", leaders[X], leaders[Y],
+                                   out_off[(X, Y)], in_off[(X, Y)], total,
+                                   chunk_dcn, "dcn"))
+    xnode_pairs.sort(key=key)
+    rounds, busy_s, busy_r = [], [], []
+    for parts in xnode_pairs:
+        _place(parts, rounds, busy_s, busy_r)
+    sched.phase_b = rounds
+
+    # phase C: scatter each received segment from the leader's inbound
+    # staging to its local destination (the leader's own bytes ride a
+    # self-scatter, so leader conservation is exact)
+    scatter_pairs = []
+    for (X, Y), segs in sorted(blocks.items()):
+        lead = leaders[Y]
+        for s, d, n in sorted(segs):
+            scatter_pairs.append(chunked(
+                "scatter", lead, d, in_off[(X, Y)] + seg_off[(s, d)],
+                int(rd[d, s]), n, chunk_ici, "ici"))
+    scatter_pairs.sort(key=key)
+    rounds, busy_s, busy_r = [], [], []
+    for parts in scatter_pairs:
+        _place(parts, rounds, busy_s, busy_r)
+    sched.phase_c = rounds
     return sched

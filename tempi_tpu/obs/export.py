@@ -74,22 +74,28 @@ def write(path: str, events: List[Dict[str, Any]],
 
 
 def summarize(doc: dict) -> List[dict]:
-    """Per-(span name, strategy) latency summary of a Chrome trace dump —
-    what ``benches/perf_report.py --trace`` prints. Returns rows sorted by
-    total time descending: ``{name, strategy, count, total_us, mean_us,
-    p50_us, max_us}``."""
+    """Per-(span name, strategy, tier) latency summary of a Chrome trace
+    dump — what ``benches/perf_report.py --trace`` prints. Returns rows
+    sorted by total time descending: ``{name, strategy, tier, count,
+    total_us, mean_us, p50_us, max_us}``. ``tier`` splits the rounds of a
+    hierarchical collective (ISSUE 10) into their ici/dcn legs, so a
+    Perfetto dump shows WHERE a two-level exchange spends its time; spans
+    without a tier attribute collapse into one "-" row, exactly as
+    before."""
     groups: Dict[tuple, List[float]] = {}
     for ev in doc.get("traceEvents", []):
         if ev.get("ph") != "X":
             continue
-        strategy = (ev.get("args") or {}).get("strategy", "-")
-        groups.setdefault((ev["name"], strategy), []).append(
+        args = ev.get("args") or {}
+        strategy = args.get("strategy", "-")
+        tier = args.get("tier", "-")
+        groups.setdefault((ev["name"], strategy, tier), []).append(
             float(ev.get("dur", 0.0)))
     rows = []
-    for (name, strategy), durs in groups.items():
+    for (name, strategy, tier), durs in groups.items():
         durs.sort()
         n = len(durs)
-        rows.append(dict(name=name, strategy=strategy, count=n,
+        rows.append(dict(name=name, strategy=strategy, tier=tier, count=n,
                          total_us=sum(durs), mean_us=sum(durs) / n,
                          p50_us=durs[n // 2], max_us=durs[-1]))
     rows.sort(key=lambda r: -r["total_us"])

@@ -674,6 +674,21 @@ class ExchangePlan:
 _PLAN_CACHE_MAX = 128
 
 
+def coll_schedule_key(kind: str, tier_config: tuple, *mats) -> tuple:
+    """Cache key for compiled collective schedules (coll/persistent.py).
+
+    ``kind`` names the plan family (``"flat"`` | ``"hier"``) and
+    ``tier_config`` carries everything beyond the byte matrices that
+    shapes the compiled artifact — for a flat plan the single chunk
+    threshold, for a two-level plan the per-tier chunk thresholds plus
+    the node map and elected leaders (ISSUE 10: two handles over the same
+    matrices but different tier configs must never share a schedule; a
+    re-placement epoch changes the node map, so the stale entry can never
+    be read back either)."""
+    return ("coll-sched", kind, tuple(tier_config)) \
+        + tuple(np.asarray(m).tobytes() for m in mats)
+
+
 def cache_get(comm: Communicator, key):
     """LRU-aware read of the communicator's plan/program cache. Hit/miss
     counters ride the public snapshot (``api.counters_snapshot()``) so a

@@ -16,3 +16,9 @@ COLL_SCHEDULE = RESERVED_BASE + 2
 # (multihost.allgather_suspects) namespaces its coordinator-KV keys under
 # it so agreement traffic can never collide with application state
 FT_AGREE = RESERVED_BASE + 3
+# hierarchical two-level collectives (coll/persistent._HierLowering): the
+# leader-to-leader DCN exchange phase rides its own reserved id, distinct
+# from COLL_SCHEDULE, so a hierarchical replay can never FIFO-match a flat
+# persistent round (or application traffic) interleaved on the same
+# communicator
+COLL_HIER = RESERVED_BASE + 4

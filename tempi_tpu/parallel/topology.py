@@ -62,6 +62,36 @@ class Topology:
     def has_ici_distances(self) -> bool:
         return self.coords is not None
 
+    def leaders(self) -> List[int]:
+        """Per-node leader election for the two-level collective plans
+        (coll/schedule.compile_hier_schedule): the lowest library rank on
+        each node. Deterministic across every process observing the same
+        topology — an SPMD world must agree on who aggregates without a
+        vote (the reference labels nodes by the same allgathered order,
+        topology.cpp:34-90; the first rank of a node is the one every
+        rank derives identically)."""
+        return [ranks[0] for ranks in self.ranks_of_node]
+
+    def node_distance_matrix(self) -> np.ndarray:
+        """Node-granular companion of ``distance_matrix``: (num_nodes,
+        num_nodes) placement distances — 0 on the diagonal, DCN_FACTOR x
+        the ICI diameter everywhere else (crossing DCN costs the same
+        whichever leader pair carries it). NOTE: the hier plan decision
+        itself is costed from the MEASURED sheet
+        (coll.persistent._hier_estimate), not this static view — this is
+        the placement-layer abstraction (a node-weighted re-placement
+        objective is the natural consumer), property-pinned by the hier
+        tests."""
+        nn = self.num_nodes
+        if self.coords is not None:
+            dims = np.asarray(self.torus_dims, dtype=np.int64)
+            diam = max(1, int((dims // 2).sum()))
+        else:
+            diam = 1
+        dist = np.full((nn, nn), DCN_FACTOR * diam, dtype=np.int64)
+        np.fill_diagonal(dist, 0)
+        return dist
+
     def ici_hops(self, a: int, b: int) -> int:
         """Wrap-around manhattan hop count on the ICI torus."""
         assert self.coords is not None
@@ -96,6 +126,17 @@ def _node_keys(devices: Sequence) -> List:
     """One hashable node key per device."""
     ranks_per_node = envmod.env.ranks_per_node
     if ranks_per_node > 0:
+        if len(devices) % ranks_per_node:
+            # the last node is RAGGED (fewer ranks than the others). Legal
+            # — real pods lose hosts — but never silent: a two-level plan
+            # compiled over it aggregates less than the operator expects,
+            # and a typo'd node size should be visible in the log, not in
+            # a latency regression (TEMPI_RANKS_PER_NODE itself parses
+            # loudly in utils/env.py)
+            log.warn(
+                f"TEMPI_RANKS_PER_NODE={ranks_per_node} does not divide "
+                f"the {len(devices)}-rank world: the last node is ragged "
+                f"({len(devices) % ranks_per_node} rank(s))")
         return [i // ranks_per_node for i in range(len(devices))]
     # multi-process: the process boundary is the DCN boundary
     pids = {getattr(d, "process_index", 0) for d in devices}

@@ -97,6 +97,16 @@ SITES = (
                           # regions, so the per-round retry loop can
                           # re-dispatch idempotently; wedge refused —
                           # the round runs under the progress lock)
+    "coll.hier_round",    # each round of a HIERARCHICAL (two-level)
+                          # collective plan, fired alongside coll.round
+                          # only when the hier lowering runs
+                          # (coll/persistent.py — same before-dispatch
+                          # contract: gather/scatter host passes rebuild
+                          # their staging idempotently and the DCN
+                          # batches guard against double-start, so the
+                          # per-round retry loop re-dispatches safely;
+                          # wedge refused for the same progress-lock
+                          # reason as coll.round)
     "replace.apply",      # each rank re-placement apply step
                           # (parallel/replacement.py — fires BEFORE the
                           # new permutation is installed, so a raise

@@ -82,6 +82,15 @@ class CollCounters:
     num_recompiles: int = 0  # health-driven recompiles (breaker opened)
     num_replays: int = 0     # start() calls that replayed a compiled plan
     num_rounds: int = 0      # schedule rounds dispatched
+    # hierarchical two-level plans (ISSUE 10): pinned at zero whenever the
+    # flat plan runs — the counter-based byte-for-byte guard that a
+    # not-chosen hierarchy decides and allocates nothing
+    hier_compiles: int = 0   # _HierLowering builds (incl. recompiles)
+    hier_replays: int = 0    # start() replays of a hierarchical plan
+    hier_rounds_ici: int = 0  # intra-node (gather/scatter) rounds run
+    hier_rounds_dcn: int = 0  # leader-exchange rounds run
+    hier_dcn_msgs: int = 0   # aggregated node-pair messages compiled
+    hier_dcn_bytes: int = 0  # bytes the compiled plans move over DCN
 
 
 @dataclass

@@ -112,6 +112,32 @@ coll/persistent.py and the README "Persistent collectives" section):
                          wire time) when measured, else the historical
                          1<<14 guess; negative rejected loudly.
 
+Hierarchical two-level collective knobs (ISSUE 10; see
+coll/schedule.compile_hier_schedule, coll/persistent.py and the README
+"Hierarchical collectives" section):
+  TEMPI_COLL_HIER      = flat | hier | auto — the A/B/C-vs-flat plan
+                         decision of the persistent-collective compiler
+                         (default auto: the two-level plan competes in
+                         the model-driven AUTO choice, costed per tier
+                         from the measured sheet, and is NEVER chosen on
+                         a single-node topology or an all-local matrix).
+                         ``flat`` pins today's one-tier schedule;
+                         ``hier`` forces the two-level plan wherever the
+                         topology has >1 node (single-node topologies
+                         fall back to the flat plan identically — there
+                         is no DCN tier to aggregate for).
+  TEMPI_COLL_CHUNK_BYTES_ICI  chunk threshold of the intra-node (ICI)
+                         phases of a two-level plan — gather/scatter and
+                         direct local messages split past it. Unset =
+                         inherit TEMPI_COLL_CHUNK_BYTES; negative
+                         rejected loudly; 0 disables splitting.
+  TEMPI_COLL_CHUNK_BYTES_DCN  chunk threshold of the leader-to-leader
+                         (DCN) exchange phase. The two tiers have very
+                         different bandwidth-delay products, so the
+                         aggregated node-pair messages get their own
+                         knob. Unset = inherit TEMPI_COLL_CHUNK_BYTES;
+                         negative rejected loudly; 0 disables splitting.
+
 Multi-tenant QoS knobs (ISSUE 7; see runtime/qos.py, runtime/progress.py
 and the README "Multi-tenant QoS" section):
   TEMPI_QOS_DEFAULT    = latency | bulk — the QoS class of communicators
@@ -328,6 +354,11 @@ class Environment:
     # skew-split tail message; -1 = unset (derive from the swept sheet
     # when measured, else the historical 1<<14 guess)
     a2av_split_overhead: int = -1
+    # hierarchical two-level collectives (ISSUE 10) — see
+    # coll/schedule.compile_hier_schedule and coll/persistent.py
+    coll_hier: str = "auto"        # flat | hier | auto
+    coll_chunk_bytes_ici: int = -1  # -1 = inherit coll_chunk_bytes
+    coll_chunk_bytes_dcn: int = -1  # -1 = inherit coll_chunk_bytes
     # multi-tenant QoS (no reference analog; ISSUE 7) — see runtime/qos.py
     # (class scheduler) and runtime/progress.py (pump integration)
     qos_default: str = ""          # "" = QoS off | latency | bulk
@@ -394,10 +425,27 @@ class Environment:
         except ValueError:
             e.pack_kernel = PackKernel.AUTO
 
-        try:
-            e.ranks_per_node = int(getenv("TEMPI_RANKS_PER_NODE") or 0)
-        except ValueError:
+        # loud, unlike the other perf knobs above (ISSUE 10 satellite): a
+        # typo'd node size silently becoming 0 would rediscover the
+        # platform topology and quietly compile single-node (flat) plans
+        # in the one run that asked to simulate a multi-node pod
+        v = getenv("TEMPI_RANKS_PER_NODE")
+        if v is None or v.strip() == "":
             e.ranks_per_node = 0
+        else:
+            try:
+                rpn = int(v)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad TEMPI_RANKS_PER_NODE={v!r}: want a non-negative "
+                    "integer (ranks per simulated node; 0 = discover from "
+                    "the platform)") from exc
+            if rpn < 0:
+                raise ValueError(
+                    f"bad TEMPI_RANKS_PER_NODE={v!r}: want a non-negative "
+                    "integer (ranks per simulated node; 0 = discover from "
+                    "the platform)")
+            e.ranks_per_node = rpn
 
         try:
             spec = (getenv("TEMPI_TORUS") or "").lower()
@@ -520,6 +568,35 @@ class Environment:
                     f"bad TEMPI_A2AV_SPLIT_OVERHEAD={v!r}: want a "
                     "non-negative integer (bytes)")
             e.a2av_split_overhead = i
+
+        # hierarchical-collective knobs parse loudly too: a typo'd
+        # TEMPI_COLL_HIER silently falling back to auto would quietly
+        # change which PLAN a production collective compiled — the exact
+        # class of surprise the loud-parse constraint exists to prevent
+        ch = (getenv("TEMPI_COLL_HIER") or "auto").lower()
+        if ch not in ("flat", "hier", "auto"):
+            raise ValueError(
+                f"bad TEMPI_COLL_HIER={ch!r}: want flat | hier | auto")
+        e.coll_hier = ch
+
+        def _tier_chunk(name: str) -> int:
+            v = getenv(name)
+            if v is None or v == "":
+                return -1  # unset: inherit TEMPI_COLL_CHUNK_BYTES
+            try:
+                i = int(v)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative integer "
+                    "(bytes; 0 disables splitting)") from exc
+            if i < 0:
+                raise ValueError(
+                    f"bad {name}={v!r}: want a non-negative integer "
+                    "(bytes; 0 disables splitting)")
+            return i
+
+        e.coll_chunk_bytes_ici = _tier_chunk("TEMPI_COLL_CHUNK_BYTES_ICI")
+        e.coll_chunk_bytes_dcn = _tier_chunk("TEMPI_COLL_CHUNK_BYTES_DCN")
 
         # QoS knobs parse loudly too: a typo'd class name silently leaving
         # QoS off would hand the one multi-tenant deployment that asked
@@ -654,6 +731,10 @@ class Environment:
             e.tune_mode = "off"
             # ...and the class scheduler: the bail-out runs no pump
             e.qos_default = ""
+            # ...and the two-level plan compiler: "native all_to_all, no
+            # strategy modeling" means the flat schedule, never a
+            # leader-staged hierarchy
+            e.coll_hier = "flat"
             # ...and re-placement: "no placement remap" is the bail-out's
             # explicit contract, one-shot AND online
             e.replace_mode = "off"

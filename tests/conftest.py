@@ -37,6 +37,10 @@ def pytest_configure(config):
         "`pytest -m coll`)")
     config.addinivalue_line(
         "markers",
+        "hier: hierarchical two-level (ICI x DCN) collective tests (the "
+        "<30s smoke is `pytest -m hier`)")
+    config.addinivalue_line(
+        "markers",
         "qos: multi-tenant QoS scheduler tests (the <30s smoke is "
         "`pytest -m qos`)")
     config.addinivalue_line(

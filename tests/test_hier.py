@@ -1,0 +1,566 @@
+"""Hierarchical two-level collectives (ISSUE 10): the ICI x DCN plan
+compiler (coll/schedule.compile_hier_schedule), its runtime lowering
+(coll/persistent._HierLowering), and the satellites.
+
+Marker ``hier`` is the tier-1-compatible <30s smoke (`pytest -m hier`),
+like the coll/faults/obs markers; the chaos variants are dual-marked
+``faults`` so the chaos smoke exercises the ``coll.hier_round`` site.
+"""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.coll.schedule import compile_hier_schedule
+from tempi_tpu.runtime import faults, health
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.hier
+
+
+# -- pure compiler properties (no mesh) ---------------------------------------
+
+
+def _random_mats(size, seed, density=0.4, hi=64, skew=None):
+    rng = np.random.default_rng(seed)
+    sc = rng.integers(1, hi, (size, size)).astype(np.int64)
+    sc[rng.random((size, size)) > density] = 0
+    if skew:
+        s, d, n = skew
+        sc[s, d] = n
+    sd = np.zeros_like(sc)
+    rd = np.zeros_like(sc)
+    for r in range(size):
+        sd[r] = np.concatenate([[0], np.cumsum(sc[r])[:-1]])
+        rd[r] = np.concatenate([[0], np.cumsum(sc.T[r])[:-1]])
+    return sc, sd, rd
+
+
+def _nodes(size, rpn):
+    """node_of + leaders for a ``rpn``-ranks-per-node chunking — the last
+    node RAGGED when rpn does not divide size, exactly like
+    topology._node_keys."""
+    node_of = [i // rpn for i in range(size)]
+    leaders = sorted({n: i for i, n in reversed(list(enumerate(node_of)))}
+                     .values())
+    return node_of, leaders
+
+
+def _oracle(sc, sd, rd, send_rows, nbr):
+    size = sc.shape[0]
+    want = [np.zeros(nbr, np.uint8) for _ in range(size)]
+    for s in range(size):
+        for d in range(size):
+            n = int(sc[s, d])
+            if n:
+                want[d][rd[d, s]: rd[d, s] + n] = \
+                    send_rows[s][sd[s, d]: sd[s, d] + n]
+    return want
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+@pytest.mark.parametrize("rpn", [2, 3, 4])  # 3 leaves 8 ranks RAGGED (3,3,2)
+@pytest.mark.parametrize("chunks", [(0, 0), (37, 101)])
+def test_hier_two_tier_invariants_and_exact_delivery(seed, rpn, chunks):
+    """The acceptance properties on random matrices over even AND ragged
+    node sizes: per-tier matchings, tier separation (no DCN message
+    between non-leaders), leader conservation, and exact end-to-end
+    delivery via the three-phase simulation vs the one-shot oracle."""
+    size = 8
+    sc, sd, rd = _random_mats(size, seed)
+    node_of, leaders = _nodes(size, rpn)
+    ici, dcn = chunks
+    hs = compile_hier_schedule(sc, sd, rd, node_of, leaders, ici, dcn)
+    hs.check_matchings()
+    hs.check_tier_separation()
+    hs.check_leader_conservation()
+    rng = np.random.default_rng(seed + 100)
+    nbs = max(1, int(sc.sum(1).max()))
+    nbr = max(1, int(sc.sum(0).max()))
+    rows = [rng.integers(0, 256, nbs, np.uint8) for _ in range(size)]
+    got = hs.simulate(rows, nbr)
+    want = _oracle(sc, sd, rd, rows, nbr)
+    for r in range(size):
+        np.testing.assert_array_equal(got[r], want[r])
+
+
+def test_hier_phase_b_is_node_granular():
+    """Phase B carries ONE aggregated message per (src node, dst node)
+    pair — the DCN-bytes-move-once-per-node contract — and every byte of
+    every off-node pair rides it."""
+    size = 8
+    sc, sd, rd = _random_mats(size, 3, density=0.8)
+    node_of, leaders = _nodes(size, 4)
+    hs = compile_hier_schedule(sc, sd, rd, node_of, leaders, 0, 0)
+    # unchunked: one xnode message per node pair with off-node bytes
+    per_pair = {}
+    for rnd in hs.phase_b:
+        for m in rnd:
+            key = (node_of[m.src], node_of[m.dst])
+            per_pair[key] = per_pair.get(key, 0) + m.nbytes
+    want = {}
+    for s in range(size):
+        for d in range(size):
+            if sc[s, d] and node_of[s] != node_of[d]:
+                key = (node_of[s], node_of[d])
+                want[key] = want.get(key, 0) + int(sc[s, d])
+    assert per_pair == want
+    assert hs.dcn_msgs == len(want)
+    assert hs.dcn_bytes == sum(want.values())
+    assert sum(len(rnd) for rnd in hs.phase_b) == len(want)
+
+
+def test_hier_chunk_thresholds_per_tier():
+    """Phase B chunks against the DCN threshold across strictly
+    increasing rounds; phase A/C gather/scatter segments chunk against
+    the ICI threshold independently."""
+    size = 4
+    sc = np.zeros((size, size), np.int64)
+    sc[0, 2] = 300  # node 0 -> node 1 under rpn=2
+    sd = np.zeros_like(sc)
+    rd = np.zeros_like(sc)
+    node_of, leaders = _nodes(size, 2)
+    hs = compile_hier_schedule(sc, sd, rd, node_of, leaders,
+                               chunk_ici=50, chunk_dcn=128)
+    b = [(ri, m) for ri, rnd in enumerate(hs.phase_b) for m in rnd]
+    assert [m.nbytes for _, m in b] == [128, 128, 44]
+    rids = [ri for ri, _ in b]
+    assert rids == sorted(rids) and len(set(rids)) == len(rids)
+    gathers = [m for rnd in hs.phase_a for m in rnd if m.kind == "gather"]
+    assert [m.nbytes for m in gathers] == [50] * 6
+    hs.check_leader_conservation()
+
+
+def test_hier_single_node_has_no_dcn_phase():
+    """All-local matrices compile to direct messages only — phase B (and
+    both staging footprints) empty."""
+    size = 4
+    sc, sd, rd = _random_mats(size, 5)
+    hs = compile_hier_schedule(sc, sd, rd, [0] * size, [0], 0, 0)
+    assert hs.phase_b == [] and hs.phase_c == []
+    assert hs.gather_bytes == 0 and hs.scatter_bytes == 0
+    assert all(m.kind == "direct" for rnd in hs.phase_a for m in rnd)
+
+
+def test_hier_schedule_deterministic():
+    size = 8
+    sc, sd, rd = _random_mats(size, 11)
+    node_of, leaders = _nodes(size, 3)
+    a = compile_hier_schedule(sc, sd, rd, node_of, leaders, 16, 64)
+    b = compile_hier_schedule(sc, sd, rd, node_of, leaders, 16, 64)
+    assert a.phase_a == b.phase_a and a.phase_b == b.phase_b \
+        and a.phase_c == b.phase_c
+
+
+def test_hier_leader_on_wrong_node_refused():
+    size = 4
+    sc, sd, rd = _random_mats(size, 0)
+    with pytest.raises(AssertionError, match="leader"):
+        compile_hier_schedule(sc, sd, rd, [0, 0, 1, 1], [0, 1], 0, 0)
+
+
+# -- runtime on the 8-device CPU mesh -----------------------------------------
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+@pytest.fixture()
+def make_world():
+    """Deferred init: topology discovery reads TEMPI_RANKS_PER_NODE at
+    api.init(), so tests that monkeypatch a synthetic node map must init
+    AFTER arming the env (the ``world`` fixture inits before the test
+    body runs)."""
+    inited = []
+
+    def f():
+        comm = api.init()
+        inited.append(comm)
+        return comm
+
+    yield f
+    if inited:
+        api.finalize()
+
+
+def make_case(comm, seed=0, hi=32, density=0.7, outlier=None):
+    size = comm.size
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, hi, (size, size))
+    counts[rng.random((size, size)) > density] = 0
+    if outlier:
+        s, d, n = outlier
+        counts[s, d] = n
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    recvcounts = counts.T.copy()
+    for r in range(size):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(recvcounts[r])[:-1]])
+    nb_s = max(1, int(counts.sum(1).max()))
+    nb_r = max(1, int(recvcounts.sum(1).max()))
+    rows = [rng.integers(0, 256, nb_s, np.uint8) for _ in range(size)]
+    sendbuf = comm.buffer_from_host(rows)
+    recvbuf = comm.alloc(nb_r)
+    want = [np.zeros(nb_r, np.uint8) for _ in range(size)]
+    for s in range(size):
+        for d in range(size):
+            n = counts[s, d]
+            if n:
+                want[d][rdispls[d, s]: rdispls[d, s] + n] = \
+                    rows[s][sdispls[s, d]: sdispls[s, d] + n]
+    return counts, sdispls, recvcounts, rdispls, sendbuf, recvbuf, want
+
+
+def _check(comm, recvbuf, want):
+    for r in range(comm.size):
+        np.testing.assert_array_equal(recvbuf.get_rank(r), want[r])
+
+
+def _force_hier(monkeypatch, rpn="2"):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", rpn)
+    monkeypatch.setenv("TEMPI_COLL_HIER", "hier")
+    envmod.read_environment()
+
+
+@pytest.mark.parametrize("rpn", ["2", "3", "4"])  # 3 = ragged last node
+def test_hier_delivers_byte_identical_and_replays(make_world, monkeypatch, rpn):
+    """Forced two-level plan: byte-identical to the one-shot engine on
+    even and ragged node sizes, replay counters moving, DCN round and
+    message evidence nonzero."""
+    _force_hier(monkeypatch, rpn)
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=int(rpn))
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    assert pc.method == "hier"
+    assert ctr.counters.coll.hier_compiles == 1
+    assert ctr.counters.coll.hier_dcn_msgs > 0
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+    replays = ctr.counters.coll.hier_replays
+    pc.start()  # replay: no recompile
+    pc.wait()
+    _check(world, rbuf, want)
+    assert ctr.counters.coll.hier_compiles == 1
+    assert ctr.counters.coll.hier_replays == replays + 1
+    assert ctr.counters.coll.hier_rounds_dcn > 0
+    assert ctr.counters.coll.hier_rounds_ici > 0
+    # one-shot oracle cross-check on a fresh buffer
+    rbuf2 = world.alloc(rbuf.nbytes)
+    api.alltoallv(world, sbuf, counts, sd, rbuf2, rc, rd)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf2.get_rank(r), rbuf.get_rank(r))
+
+
+def test_hier_skewed_outlier_delivers(make_world, monkeypatch):
+    """The skewed shape (the bench's judged config): a large off-node
+    outlier pair chunk-splits over DCN and still delivers exactly."""
+    _force_hier(monkeypatch, "4")
+    monkeypatch.setenv("TEMPI_COLL_CHUNK_BYTES_DCN", "256")
+    envmod.read_environment()
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(
+        world, seed=4, hi=8, density=0.3, outlier=(1, 6, 1000))
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    assert pc.method == "hier"
+    assert len(pc.hier_schedule.phase_b) >= 1000 // 256
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+
+
+def test_hier_never_chosen_on_single_node(world):
+    """AUTO must never pick hier on a single-node topology (there is no
+    DCN tier to aggregate for), and forcing it falls back to the flat
+    plan identically — zero hier counters either way."""
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=6)
+    for mode in ("auto", "hier"):
+        envmod.env.coll_hier = mode
+        pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+        assert pc.method != "hier"
+        assert pc.hier_schedule is None
+        pc.start()
+        pc.wait()
+        _check(world, rbuf, want)
+        pc.free()
+    assert ctr.counters.coll.hier_compiles == 0
+    assert ctr.counters.coll.hier_rounds_ici == 0
+    assert ctr.counters.coll.hier_rounds_dcn == 0
+
+
+def test_hier_counters_pinned_when_flat_runs(world, monkeypatch):
+    """The counter-based byte-for-byte guard: a multi-node topology whose
+    plan decision lands on flat moves NO hier counter — a not-chosen
+    hierarchy decides and allocates nothing."""
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "2")
+    monkeypatch.setenv("TEMPI_COLL_HIER", "flat")
+    envmod.read_environment()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=7)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+    api.alltoallv(world, sbuf, counts, sd, rbuf, rc, rd)
+    snap = api.counters_snapshot()["coll"]
+    assert all(v == 0 for k, v in snap.items() if k.startswith("hier_"))
+
+
+def test_hier_auto_is_costed_from_the_sheet(make_world, monkeypatch):
+    """The A/B/C-vs-flat decision is model-driven: on a measured sheet
+    whose inter-node tier is expensive relative to host staging, AUTO
+    picks hier on a multi-node topology; an unmeasured sheet keeps
+    today's flat default (hier must be forced, never guessed into)."""
+    from tempi_tpu.measure import system as msys
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "4")
+    envmod.read_environment()
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=8)
+    prior = msys.get()
+    try:
+        # unmeasured: flat default
+        msys.set_system(msys.SystemPerformance())
+        pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+        assert pc.method != "hier"
+        pc.free()
+        # measured, DCN-latency-dominated: per-message inter-node cost is
+        # huge, host staging and ICI cheap -> aggregation wins
+        sp = msys.SystemPerformance()
+        cheap = [(1, 1e-7), (1 << 22, 1e-5)]
+        sp.d2h = list(cheap)
+        sp.h2d = list(cheap)
+        sp.host_pingpong = [(1, 10.0), (1 << 22, 10.0)]  # staged priced out
+        sp.intra_node_pingpong = list(cheap)
+        sp.inter_node_pingpong = [(1, 1e-2), (1 << 22, 2e-2)]
+        msys.set_system(sp)
+        pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+        assert pc.method == "hier"
+        pc.start()
+        pc.wait()
+        _check(world, rbuf, want)
+        pc.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_hier_recompiles_off_an_open_device_breaker(make_world, monkeypatch):
+    """The breaker machinery steers the two-level plan like any other
+    method: the DCN leg rides the device transport, so a device breaker
+    opening on a scheduled link recompiles the AUTO-chosen hier plan onto
+    a healthy flat method — never a stale replay."""
+    from tempi_tpu.coll.persistent import _UNDERLYING
+    from tempi_tpu.measure import system as msys
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "4")
+    envmod.read_environment()
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=9)
+    prior = msys.get()
+    try:
+        sp = msys.SystemPerformance()
+        cheap = [(1, 1e-7), (1 << 22, 1e-5)]
+        sp.d2h = list(cheap)
+        sp.h2d = list(cheap)
+        # staged finite but dearer than hier: after the device quarantine
+        # it is the healthy method the recompile can land on
+        sp.host_pingpong = [(1, 5e-2), (1 << 22, 5e-2)]
+        sp.intra_node_pingpong = list(cheap)
+        sp.inter_node_pingpong = [(1, 1e-2), (1 << 22, 2e-2)]
+        msys.set_system(sp)
+        pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+        assert pc.method == "hier"  # AUTO-chosen, not forced
+        pc.start()
+        pc.wait()
+        for lk in pc.links:
+            for _ in range(envmod.env.breaker_threshold):
+                health.record_failure(lk, _UNDERLYING["hier"],
+                                      error="synthetic")
+        assert health.TRIPPED
+        recompiles = ctr.counters.coll.num_recompiles
+        pc.start()
+        pc.wait()
+        assert ctr.counters.coll.num_recompiles == recompiles + 1
+        assert pc.method != "hier"
+        _check(world, rbuf, want)
+    finally:
+        msys.set_system(prior)
+
+
+def test_hier_forced_never_recompiled_by_breakers(make_world, monkeypatch):
+    """TEMPI_COLL_HIER=hier is the env-forced arm of the precedence: an
+    open breaker never overrides it (the p2p chooser's contract)."""
+    from tempi_tpu.coll.persistent import _UNDERLYING
+    _force_hier(monkeypatch)
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=10)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    for lk in pc.links:
+        for _ in range(envmod.env.breaker_threshold):
+            health.record_failure(lk, _UNDERLYING["hier"],
+                                  error="synthetic")
+    recompiles = ctr.counters.coll.num_recompiles
+    pc.start()
+    pc.wait()
+    assert ctr.counters.coll.num_recompiles == recompiles
+    assert pc.method == "hier"
+    _check(world, rbuf, want)
+
+
+def test_hier_recompiles_on_mapping_epoch(make_world, monkeypatch):
+    """An applied rank re-placement bumps the communicator's epoch; the
+    next start() rebuilds the mapping-derived state — node map, leaders,
+    staging layout — before replaying (the recompile-on-epoch contract
+    held at the two-level layer)."""
+    _force_hier(monkeypatch)
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=11)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    world.mapping_epoch += 1
+    world.invalidate_plans()
+    compiles = ctr.counters.coll.hier_compiles
+    pc.start()
+    pc.wait()
+    assert ctr.counters.coll.hier_compiles == compiles + 1
+    assert pc._mapping_epoch == world.mapping_epoch
+    _check(world, rbuf, want)
+
+
+@pytest.mark.faults
+def test_hier_round_fault_with_retries_delivers(make_world, monkeypatch):
+    """coll.hier_round chaos with retries armed: the per-round retry loop
+    re-draws the site and re-dispatches idempotently — gather/scatter
+    passes rebuild their staging, DCN batches refuse a double start."""
+    _force_hier(monkeypatch)
+    monkeypatch.setenv("TEMPI_FAULTS", "coll.hier_round:raise:0.4:7")
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "8")
+    envmod.read_environment()
+    faults.configure()
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=12)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    assert pc.method == "hier"
+    for _ in range(2):
+        pc.start()
+        pc.wait()
+        _check(world, rbuf, want)
+
+
+@pytest.mark.faults
+def test_hier_round_fault_exhaustion_is_restartable(make_world, monkeypatch):
+    """With retries unarmed a coll.hier_round raise surfaces immediately;
+    the handle returns to the inactive state and a later healthy start
+    delivers the full exchange."""
+    _force_hier(monkeypatch)
+    monkeypatch.setenv("TEMPI_FAULTS", "coll.hier_round:raise:1:3")
+    envmod.read_environment()
+    faults.configure()
+    world = make_world()
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=13)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    with pytest.raises(faults.InjectedFault):
+        pc.start()
+    faults.reset()
+    pc.start()
+    pc.wait()
+    _check(world, rbuf, want)
+
+
+def test_hier_round_spans_carry_tier(make_world, monkeypatch):
+    """Each hier round's coll.round span is tagged with its tier, and the
+    trace summary breaks latency down per tier (the Perfetto
+    where-does-a-hierarchical-exchange-spend-its-time satellite)."""
+    from tempi_tpu.obs import export, trace as obstrace
+    _force_hier(monkeypatch)
+    world = make_world()
+    obstrace.configure("flight")  # after init: init re-arms from the env
+    counts, sd, rc, rd, sbuf, rbuf, _ = make_case(world, seed=14)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    spans = [e for e in obstrace.snapshot() if e["name"] == "coll.round"]
+    assert len(spans) == pc._lowering.num_rounds
+    tiers = {s["tier"] for s in spans}
+    assert tiers == {"ici", "dcn"}
+    doc = export.to_chrome(obstrace.snapshot())
+    rows = [r for r in export.summarize(doc) if r["name"] == "coll.round"]
+    assert {r["tier"] for r in rows} == {"ici", "dcn"}
+    obstrace.configure("off")
+
+
+# -- satellites: knobs, ragged discovery --------------------------------------
+
+
+def test_hier_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_COLL_HIER", "sideways")
+    with pytest.raises(ValueError, match="TEMPI_COLL_HIER"):
+        envmod.read_environment()
+    monkeypatch.delenv("TEMPI_COLL_HIER")
+    for name in ("TEMPI_COLL_CHUNK_BYTES_ICI", "TEMPI_COLL_CHUNK_BYTES_DCN"):
+        for bad in ("-1", "lots"):
+            monkeypatch.setenv(name, bad)
+            with pytest.raises(ValueError, match=name):
+                envmod.read_environment()
+            monkeypatch.delenv(name)
+    # unset tier thresholds inherit the flat chunk knob
+    monkeypatch.setenv("TEMPI_COLL_CHUNK_BYTES", "4096")
+    envmod.read_environment()
+    assert envmod.env.coll_chunk_bytes_ici == -1
+    assert envmod.env.coll_chunk_bytes_dcn == -1
+    monkeypatch.setenv("TEMPI_COLL_CHUNK_BYTES_ICI", "512")
+    monkeypatch.setenv("TEMPI_COLL_CHUNK_BYTES_DCN", "65536")
+    envmod.read_environment()
+    assert envmod.env.coll_chunk_bytes_ici == 512
+    assert envmod.env.coll_chunk_bytes_dcn == 65536
+    assert envmod.env.coll_hier == "auto"  # the default
+
+
+def test_ranks_per_node_parses_loudly(monkeypatch):
+    """ISSUE 10 satellite: a typo'd node size must fail init, not
+    silently rediscover a single-node (flat-plan) topology."""
+    for bad in ("four", "-2", "3.5"):
+        monkeypatch.setenv("TEMPI_RANKS_PER_NODE", bad)
+        with pytest.raises(ValueError, match="TEMPI_RANKS_PER_NODE"):
+            envmod.read_environment()
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "4")
+    envmod.read_environment()
+    assert envmod.env.ranks_per_node == 4
+    monkeypatch.delenv("TEMPI_RANKS_PER_NODE")
+    envmod.read_environment()
+    assert envmod.env.ranks_per_node == 0
+
+
+def test_disable_forces_flat(monkeypatch):
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    monkeypatch.setenv("TEMPI_COLL_HIER", "hier")
+    envmod.read_environment()
+    assert envmod.env.coll_hier == "flat"
+
+
+def test_ragged_topology_discovered_and_leaders_elected(monkeypatch):
+    """TEMPI_RANKS_PER_NODE that does not divide the world builds a
+    ragged last node (validated loudly — a warning names it) and leader
+    election stays deterministic: the lowest rank of each node."""
+    from tempi_tpu.parallel import topology as topo_mod
+
+    class _Dev:
+        def __init__(self, i):
+            self.id = i
+
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", "3")
+    envmod.read_environment()
+    topo = topo_mod.discover([_Dev(i) for i in range(8)])
+    assert topo.ranks_of_node == [[0, 1, 2], [3, 4, 5], [6, 7]]
+    assert topo.leaders() == [0, 3, 6]
+    nd = topo.node_distance_matrix()
+    assert nd.shape == (3, 3)
+    assert (np.diag(nd) == 0).all()
+    off = nd[~np.eye(3, dtype=bool)]
+    assert (off == off[0]).all() and off[0] > 0
