@@ -24,6 +24,12 @@ def base_parser(desc: str, multirank: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--cpu-devices", type=int, default=8)
     p.add_argument("--quick", action="store_true",
                    help="short sampling budgets")
+    p.add_argument("--lockcheck", choices=("assert", "log"), default=None,
+                   help="arm the TEMPI_LOCKCHECK runtime lock-order "
+                        "checker for this run (ISSUE 11): a real "
+                        "workload under the pump/supervisor threads "
+                        "doubles as a race regression test; nonzero "
+                        "lockcheck.* counters land in the counter report")
     return p
 
 
@@ -31,6 +37,15 @@ def setup_platform(args) -> None:
     if args.cpu:
         from tempi_tpu.utils.platform import force_cpu
         force_cpu(device_count=args.cpu_devices)
+    if getattr(args, "lockcheck", None):
+        # via the environment, not locks.configure() directly: api.init()
+        # re-reads the env and re-runs configure(), which would silently
+        # disarm a directly-configured mode mid-bench
+        os.environ["TEMPI_LOCKCHECK"] = args.lockcheck
+        from tempi_tpu.utils import env as envmod
+        from tempi_tpu.utils import locks
+        envmod.read_environment()
+        locks.configure()
 
 
 def accelerator_usable(timeout_s: int = 120) -> bool:

@@ -1,0 +1,92 @@
+"""Machine-checked runtime invariants (ISSUE 11).
+
+The repo's cross-cutting contracts — every knob loud-parses via
+``utils/env.py``, every fault site is registered in ``faults.SITES``,
+counter and trace-event names come from registries, reserved tags only
+via ``tags.py``, module locks only via the named-lock factory — were
+enforced by convention plus one hand-rolled drift test. This package
+enforces them mechanically:
+
+* :mod:`tempi_tpu.analysis.contracts` — an AST contract linter over the
+  package source (rule table in the README's "Static analysis & race
+  detection" section).
+* :mod:`tempi_tpu.analysis.lockorder` — a static pass that builds the
+  cross-module lock-nesting graph from ``with``-statement ASTs and flags
+  cycles (the compile-time companion of the ``TEMPI_LOCKCHECK`` runtime
+  checker in ``utils/locks.py``).
+
+Run as ``python -m tempi_tpu.analysis`` (exit 0 = clean). Findings are
+machine-readable; a finding is either FIXED or explicitly OWNED via the
+justified-baseline file (``analysis/baseline.json``: ``{key, reason}``
+entries — an entry without a reason is itself an error, and an entry
+whose finding no longer fires is reported stale so the baseline can only
+shrink). ``tests/test_analysis.py`` self-runs both passes on the repo and
+pins zero unbaselined findings.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .contracts import Finding, load_baseline, parse_package, run_contracts
+from .lockorder import run_lockorder
+
+__all__ = ["Finding", "Report", "run_report", "run_contracts",
+           "run_lockorder", "load_baseline", "DEFAULT_BASELINE"]
+
+#: The justified-baseline file shipped with the package.
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+@dataclass
+class Report:
+    """One full analysis run: unbaselined findings (the failures),
+    baseline-suppressed findings (each owned by a reason string), stale
+    baseline keys (entries whose finding no longer fires — prune them),
+    and the static lock-nesting graph for diagnostics."""
+
+    findings: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    lock_graph: Dict[str, List[str]] = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def as_dict(self) -> dict:
+        return dict(
+            clean=self.clean,
+            findings=[f.as_dict() for f in self.findings],
+            baselined=[f.as_dict() for f in self.baselined],
+            stale_baseline=list(self.stale_baseline),
+            lock_graph={k: list(v) for k, v in self.lock_graph.items()},
+        )
+
+
+def run_report(root: Optional[str] = None,
+               baseline_path: Optional[str] = DEFAULT_BASELINE) -> Report:
+    """Run the contract linter and the static lock-order pass over the
+    package (``root=None`` = the installed ``tempi_tpu`` tree) and fold
+    the justified baseline in. ``baseline_path=None`` disables the
+    baseline (every finding reported raw)."""
+    files = parse_package(root)
+    findings = run_contracts(root, files=files)
+    lo_findings, graph = run_lockorder(root, files=files)
+    findings = findings + lo_findings
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    hit = set()
+    for f in findings:
+        if f.key in baseline:
+            hit.add(f.key)
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    stale = sorted(set(baseline) - hit)
+    return Report(findings=kept, baselined=suppressed,
+                  stale_baseline=stale, lock_graph=graph)

@@ -1,0 +1,68 @@
+"""CLI for the contract linter + static lock-order pass.
+
+::
+
+    python -m tempi_tpu.analysis              # human-readable, exit 0/1
+    python -m tempi_tpu.analysis --json       # machine-readable report
+    python -m tempi_tpu.analysis --graph      # also print the lock graph
+    python -m tempi_tpu.analysis --no-baseline  # raw findings, no owns
+
+Exit status: 0 = clean (every finding fixed or owned in the justified
+baseline, no stale baseline entries), 1 = findings or stale entries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_BASELINE, run_report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tempi_tpu.analysis",
+        description="tempi_tpu contract linter + static lock-order pass")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--graph", action="store_true",
+                    help="also print the static lock-nesting graph")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="justified-baseline file "
+                         "(default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding raw")
+    args = ap.parse_args(argv)
+
+    report = run_report(
+        baseline_path=None if args.no_baseline else args.baseline)
+
+    if args.json:
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+        return 0 if report.clean else 1
+
+    for f in report.findings:
+        loc = f"{f.file}:{f.line}" if f.line else f.file
+        print(f"FINDING [{f.rule}] {loc}: {f.message}")
+    for key in report.stale_baseline:
+        print(f"STALE-BASELINE {key}: the finding no longer fires — "
+              "prune the entry")
+    if report.baselined:
+        print(f"({len(report.baselined)} finding(s) owned by the "
+              "justified baseline)")
+    if args.graph:
+        print("static lock-nesting graph (outer -> inners):")
+        for outer, inners in sorted(report.lock_graph.items()):
+            print(f"  {outer} -> {', '.join(inners)}")
+    if report.clean:
+        print("analysis clean: every contract holds "
+              "(or is explicitly owned)")
+        return 0
+    print(f"analysis FAILED: {len(report.findings)} finding(s), "
+          f"{len(report.stale_baseline)} stale baseline entr(ies)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,496 @@
+"""AST contract linter: the package's cross-cutting invariants as rules.
+
+Each rule walks the package AST (never regexes over raw source, except to
+extract ``TEMPI_*`` tokens from string constants) and yields
+:class:`Finding` records with a stable, line-number-free ``key`` so the
+justified baseline survives unrelated edits. Rules:
+
+  ``env-raw-access``    — ``os.environ`` touched outside the allowlist
+                          (``utils/env.py`` and ``utils/platform.py``
+                          whole-file; ``multihost.dryrun_dcn``'s
+                          save/restore). Everything else goes through the
+                          loud helpers (``read_environment``, ``int_env``,
+                          ``bool_env``, ``str_env``).
+  ``env-knob-registry`` — a ``TEMPI_*`` literal in code that is not in
+                          ``env.KNOWN_KNOBS`` (a knob that exists only in
+                          code is undocumented, unvalidated surface).
+                          Prefix families (``"TEMPI_DATATYPE_*"`` prose)
+                          match any registered knob they prefix.
+  ``knob-readme``       — a registered knob missing from the README knob
+                          tables (the registry and the operator docs must
+                          not drift).
+  ``fault-site``        — ``faults.check("<site>")`` call sites and
+                          ``faults.SITES`` disagree, either direction
+                          (generalizes the drift guard that lived in
+                          ``tests/test_recovery.py``).
+  ``counter-name``      — a ``counters.<group>.<field>`` attribute chain
+                          that does not resolve against the dataclass
+                          groups in ``utils/counters.py``.
+  ``trace-event``       — an ``obstrace.emit``/``emit_span``/``span``
+                          name literal not in ``obs/events.EVENTS``, or a
+                          registered event with no emit site.
+  ``reserved-tag``      — an integer literal >= ``tags.RESERVED_BASE``
+                          outside ``parallel/tags.py`` (reserved tag ids
+                          only via the named constants).
+  ``raw-lock``          — ``threading.Lock/RLock/Condition`` constructed
+                          outside ``utils/locks.py`` (module locks must
+                          carry a name for the lock-order checker).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+_TEMPI_TOKEN = re.compile(r"TEMPI_[A-Z0-9_]+")
+
+#: files (package-relative, posix) where raw ``os.environ`` access is the
+#: point: the parse layer itself, the platform shim that must set
+#: JAX_PLATFORMS/XLA_FLAGS before jax imports, and the dryrun's
+#: save/restore of the simulated node size (function-scoped).
+_ENV_ALLOW_FILES = ("utils/env.py", "utils/platform.py")
+_ENV_ALLOW_FUNCS = {("parallel/multihost.py", "dryrun_dcn")}
+
+#: module-level names of utils/counters.py that may legally follow a
+#: ``counters`` segment in an attribute chain without naming a group
+_COUNTER_MODULE_ATTRS_EXTRA = {"as_dict"}
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str      # package-relative posix path
+    line: int
+    message: str
+    key: str       # stable baseline key: rule:file:token (no line numbers)
+
+    def as_dict(self) -> dict:
+        return dict(rule=self.rule, file=self.file, line=self.line,
+                    message=self.message, key=self.key)
+
+
+def _package_root(root: Optional[str]) -> str:
+    if root is not None:
+        return os.path.abspath(root)
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def package_files(root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """(relative-posix-path, absolute-path) for every ``.py`` file in the
+    package tree, sorted for deterministic finding order."""
+    pkg = _package_root(root)
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                absp = os.path.join(dirpath, fn)
+                rel = os.path.relpath(absp, pkg).replace(os.sep, "/")
+                out.append((rel, absp))
+    return out
+
+
+def _parse(absp: str) -> ast.AST:
+    with open(absp, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=absp)
+
+
+class _FuncStackVisitor(ast.NodeVisitor):
+    """Generic visitor tracking the enclosing function name."""
+
+    def __init__(self):
+        self.func_stack: List[str] = []
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @property
+    def func(self) -> str:
+        return self.func_stack[-1] if self.func_stack else "<module>"
+
+
+# -- rule: env-raw-access ------------------------------------------------------
+
+
+class _EnvAccessVisitor(_FuncStackVisitor):
+    def __init__(self, rel: str, findings: List[Finding]):
+        super().__init__()
+        self.rel = rel
+        self.findings = findings
+
+    def visit_Attribute(self, node):
+        if (isinstance(node.value, ast.Name) and node.value.id == "os"
+                and node.attr == "environ"):
+            fn = self.func
+            if (self.rel, fn) not in _ENV_ALLOW_FUNCS:
+                self.findings.append(Finding(
+                    rule="env-raw-access", file=self.rel, line=node.lineno,
+                    message=f"raw os.environ access in {fn}() — go through "
+                            "utils/env.py (read_environment or the loud "
+                            "int_env/bool_env/str_env helpers)",
+                    key=f"env-raw-access:{self.rel}:{fn}"))
+        self.generic_visit(node)
+
+
+def _check_env_access(rel: str, tree: ast.AST,
+                      findings: List[Finding]) -> None:
+    if rel in _ENV_ALLOW_FILES:
+        return
+    _EnvAccessVisitor(rel, findings).visit(tree)
+    # the from-import form would make later `environ[...]` accesses
+    # invisible to the attribute matcher — refuse the import itself
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module == "os"
+                and any(a.name == "environ" for a in node.names)):
+            findings.append(Finding(
+                rule="env-raw-access", file=rel, line=node.lineno,
+                message="`from os import environ` hides raw environment "
+                        "access from the linter — import os (or better, "
+                        "go through utils/env.py)",
+                key=f"env-raw-access:{rel}:from-import-environ"))
+
+
+# -- rule: env-knob-registry / knob-readme -------------------------------------
+
+
+def _iter_str_constants(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node
+
+
+def _check_knob_literals(rel: str, tree: ast.AST, known: Tuple[str, ...],
+                         findings: List[Finding]) -> None:
+    if rel == "utils/env.py":
+        return  # the registry itself
+    for node in _iter_str_constants(tree):
+        for tok in set(_TEMPI_TOKEN.findall(node.value)):
+            if tok in known:
+                continue
+            # prose prefix families — "TEMPI_DATATYPE_*" and friends —
+            # are recognizable by their trailing underscore ONLY: a typo'd
+            # full knob name that happens to prefix a registered one
+            # (TEMPI_RETRY_ATTEMPT for ..._ATTEMPTS) must NOT slip through
+            if tok.endswith("_") and any(k.startswith(tok) for k in known):
+                continue
+            findings.append(Finding(
+                rule="env-knob-registry", file=rel, line=node.lineno,
+                message=f"{tok} is not in env.KNOWN_KNOBS — register the "
+                        "knob (and document it) or fix the literal",
+                key=f"env-knob-registry:{rel}:{tok}"))
+
+
+_BRACE_FAMILY = re.compile(r"(TEMPI_[A-Z0-9_]*)\{([A-Z0-9_,]+)\}")
+
+
+def _check_knob_readme(readme_path: str, known: Tuple[str, ...],
+                       findings: List[Finding]) -> None:
+    if not os.path.exists(readme_path):
+        return  # installed-package run; the repo test covers this
+    with open(readme_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    # expand brace families — `TEMPI_ALLTOALLV_{REMOTE_FIRST,STAGED}`
+    # documents both members
+    documented = set(_TEMPI_TOKEN.findall(text))
+    for m in _BRACE_FAMILY.finditer(text):
+        for member in m.group(2).split(","):
+            documented.add(m.group(1) + member)
+    for knob in known:
+        if knob not in documented:
+            findings.append(Finding(
+                rule="knob-readme", file="README.md", line=0,
+                message=f"registered knob {knob} is missing from the "
+                        "README knob tables",
+                key=f"knob-readme:README.md:{knob}"))
+
+
+# -- rule: fault-site ----------------------------------------------------------
+
+
+def _check_fault_sites(files: List[Tuple[str, ast.AST]],
+                       findings: List[Finding]) -> None:
+    from ..runtime import faults
+    called: Dict[str, Tuple[str, int]] = {}
+    for rel, tree in files:
+        if rel == "runtime/faults.py":
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "check"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "faults"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                site = node.args[0].value
+                called.setdefault(site, (rel, node.lineno))
+                if site not in faults.SITES:
+                    findings.append(Finding(
+                        rule="fault-site", file=rel, line=node.lineno,
+                        message=f"faults.check({site!r}) is not a "
+                                "registered site in faults.SITES",
+                        key=f"fault-site:{rel}:{site}"))
+    for site in faults.SITES:
+        if site not in called:
+            findings.append(Finding(
+                rule="fault-site", file="runtime/faults.py", line=0,
+                message=f"fault site {site!r} registered in faults.SITES "
+                        "has no faults.check call site in the package",
+                key=f"fault-site:runtime/faults.py:{site}"))
+
+
+# -- rule: counter-name --------------------------------------------------------
+
+
+def _counter_schema():
+    import dataclasses
+
+    from ..utils import counters as ctr
+    groups = {}
+    for f in dataclasses.fields(ctr.Counters):
+        groups[f.name] = {g.name for g in dataclasses.fields(
+            type(getattr(ctr.counters, f.name)))}
+    module_attrs = ({n for n in dir(ctr) if not n.startswith("_")}
+                    | _COUNTER_MODULE_ATTRS_EXTRA)
+    return groups, module_attrs
+
+
+def _attr_chain(node: ast.Attribute) -> Optional[List[str]]:
+    parts: List[str] = []
+    cur: ast.AST = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _check_counter_names(rel: str, tree: ast.AST,
+                         groups: Dict[str, Set[str]],
+                         module_attrs: Set[str],
+                         findings: List[Finding]) -> None:
+    if rel == "utils/counters.py":
+        return
+    # only maximal chains: skip Attribute nodes that are the .value of a
+    # larger Attribute (they would re-report the same chain's prefix)
+    inner = {id(n.value) for n in ast.walk(tree)
+             if isinstance(n, ast.Attribute)}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute) or id(node) in inner:
+            continue
+        parts = _attr_chain(node)
+        if not parts or "counters" not in parts[:-1]:
+            continue
+        i = len(parts) - 2 - parts[:-1][::-1].index("counters")
+        rest = parts[i + 1:]
+        if not rest:
+            continue
+        g = rest[0]
+        if g in groups:
+            if len(rest) > 1 and rest[1] not in groups[g]:
+                findings.append(Finding(
+                    rule="counter-name", file=rel, line=node.lineno,
+                    message=f"counters.{g}.{rest[1]} does not resolve: "
+                            f"group {g!r} has no field {rest[1]!r}",
+                    key=f"counter-name:{rel}:{g}.{rest[1]}"))
+        elif g not in module_attrs:
+            findings.append(Finding(
+                rule="counter-name", file=rel, line=node.lineno,
+                message=f"counters.{g} does not resolve: no such counter "
+                        "group or counters-module attribute",
+                key=f"counter-name:{rel}:{g}"))
+
+
+# -- rule: trace-event ---------------------------------------------------------
+
+
+def _check_trace_events(files: List[Tuple[str, ast.AST]],
+                        findings: List[Finding]) -> None:
+    from ..obs import events as obs_events
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for rel, tree in files:
+        if rel in ("obs/trace.py", "obs/events.py"):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("emit", "emit_span", "span")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "obstrace"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                name = node.args[0].value
+                emitted.setdefault(name, (rel, node.lineno))
+                if name not in obs_events.EVENTS:
+                    findings.append(Finding(
+                        rule="trace-event", file=rel, line=node.lineno,
+                        message=f"trace event {name!r} is not registered "
+                                "in obs/events.EVENTS",
+                        key=f"trace-event:{rel}:{name}"))
+    for name in obs_events.EVENTS:
+        if name not in emitted:
+            findings.append(Finding(
+                rule="trace-event", file="obs/events.py", line=0,
+                message=f"registered trace event {name!r} has no emit "
+                        "site in the package",
+                key=f"trace-event:obs/events.py:{name}"))
+
+
+# -- rule: reserved-tag --------------------------------------------------------
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        lo, hi = _const_int(node.left), _const_int(node.right)
+        if lo is None or hi is None:
+            return None
+        if isinstance(node.op, ast.LShift) and 0 <= hi < 128:
+            return lo << hi
+        if isinstance(node.op, ast.Add):
+            return lo + hi
+        if isinstance(node.op, ast.Sub):
+            return lo - hi
+        if isinstance(node.op, ast.Mult):
+            return lo * hi
+        if isinstance(node.op, ast.BitOr):
+            return lo | hi
+    return None
+
+
+def _check_reserved_tags(rel: str, tree: ast.AST,
+                         findings: List[Finding]) -> None:
+    if rel == "parallel/tags.py":
+        return
+    from ..parallel import tags
+    # flag only maximal constant expressions (a BinOp's operands would
+    # otherwise re-report)
+    inner: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and _const_int(node) is not None:
+            for sub in ast.walk(node):
+                if sub is not node:
+                    inner.add(id(sub))
+    for node in ast.walk(tree):
+        if id(node) in inner:
+            continue
+        if not isinstance(node, (ast.Constant, ast.BinOp)):
+            continue
+        v = _const_int(node)
+        if v is not None and v >= tags.RESERVED_BASE:
+            findings.append(Finding(
+                rule="reserved-tag", file=rel, line=node.lineno,
+                message=f"integer literal {v} is in the reserved tag "
+                        "space (>= tags.RESERVED_BASE) — use the named "
+                        "constants in parallel/tags.py",
+                key=f"reserved-tag:{rel}:{v}"))
+
+
+# -- rule: raw-lock ------------------------------------------------------------
+
+
+def _check_raw_locks(rel: str, tree: ast.AST,
+                     findings: List[Finding]) -> None:
+    if rel == "utils/locks.py":
+        return
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("Lock", "RLock", "Condition")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "threading"):
+            findings.append(Finding(
+                rule="raw-lock", file=rel, line=node.lineno,
+                message=f"threading.{node.func.attr}() constructed "
+                        "directly — module locks must come from the "
+                        "named-lock factory (utils/locks.py) so the "
+                        "lock-order checker can see them",
+                key=f"raw-lock:{rel}:{node.func.attr}"))
+        # the from-import form would make bare Lock()/RLock()/Condition()
+        # calls invisible to the matcher above — refuse the import itself
+        if (isinstance(node, ast.ImportFrom)
+                and node.module == "threading"):
+            for a in node.names:
+                if a.name in ("Lock", "RLock", "Condition"):
+                    findings.append(Finding(
+                        rule="raw-lock", file=rel, line=node.lineno,
+                        message=f"`from threading import {a.name}` hides "
+                                "raw lock construction from the linter — "
+                                "use the named-lock factory "
+                                "(utils/locks.py)",
+                        key=f"raw-lock:{rel}:from-import-{a.name}"))
+
+
+# -- driver --------------------------------------------------------------------
+
+
+def parse_package(root: Optional[str] = None) -> List[Tuple[str, ast.AST]]:
+    """Parse every package file once: ``[(relative-path, tree), ...]``.
+    Both passes accept this, so one analysis run parses one time."""
+    return [(rel, _parse(absp)) for rel, absp in package_files(root)]
+
+
+def run_contracts(root: Optional[str] = None,
+                  readme_path: Optional[str] = None,
+                  files: "Optional[List[Tuple[str, ast.AST]]]" = None
+                  ) -> List[Finding]:
+    """Run every contract rule over the package tree rooted at ``root``
+    (default: the installed ``tempi_tpu``). ``readme_path`` defaults to
+    ``README.md`` next to the package (the repo layout); ``files`` lets a
+    caller reuse :func:`parse_package` output across passes."""
+    from ..utils import env as envmod
+    pkg = _package_root(root)
+    if readme_path is None:
+        readme_path = os.path.join(os.path.dirname(pkg), "README.md")
+    if files is None:
+        files = parse_package(root)
+    findings: List[Finding] = []
+    groups, module_attrs = _counter_schema()
+    for rel, tree in files:
+        _check_env_access(rel, tree, findings)
+        _check_knob_literals(rel, tree, envmod.KNOWN_KNOBS, findings)
+        _check_counter_names(rel, tree, groups, module_attrs, findings)
+        _check_reserved_tags(rel, tree, findings)
+        _check_raw_locks(rel, tree, findings)
+    _check_fault_sites(files, findings)
+    _check_trace_events(files, findings)
+    _check_knob_readme(readme_path, envmod.KNOWN_KNOBS, findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.key))
+    return findings
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """``{key: reason}`` from the justified-baseline JSON. Every entry
+    MUST carry a non-empty reason string — an unexplained suppression is
+    itself a contract violation and raises here."""
+    import json
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, str] = {}
+    for entry in data.get("entries", ()):
+        key = entry.get("key")
+        reason = entry.get("reason", "")
+        if not key or not isinstance(key, str):
+            raise ValueError(f"baseline entry without a key: {entry!r}")
+        if not reason or not str(reason).strip():
+            raise ValueError(
+                f"baseline entry {key!r} has no reason — a suppression "
+                "must say WHY the finding is owned, or be removed")
+        out[key] = str(reason)
+    return out

@@ -1,0 +1,208 @@
+"""Static lock-order pass: the cross-module lock-nesting graph from ASTs.
+
+The runtime checker (``utils/locks.py``, ``TEMPI_LOCKCHECK``) records the
+acquisition order the program ACTUALLY executes; this pass builds the
+order the source TEXT promises, by resolving ``with``-statement context
+expressions against the named-lock factory's creation sites and walking
+lexical nesting. A cycle in the static graph means two code paths promise
+contradictory orders — a deadlock waiting for the right interleaving —
+and is flagged without running anything.
+
+Resolution model (deliberately simple, and honest about it):
+
+* ``X = locks.named_lock("name")`` / ``named_rlock`` / ``named_condition``
+  at module level binds the variable ``X`` to ``"name"`` within that
+  module; ``self.X = ...`` in a class binds the ATTRIBUTE ``X``.
+* a ``with X:`` or ``with obj.X:`` item resolves through the defining
+  module's map first, then through a global attribute map built from
+  attributes whose name is defined in exactly ONE module (so
+  ``comm._progress_lock`` resolves anywhere, while an ambiguous ``_cv``
+  only resolves inside its own module).
+* only LEXICAL nesting is walked (a ``with`` inside a ``with``, including
+  multi-item forms). Nesting through function calls is the runtime
+  checker's job — the two passes are companions, not substitutes.
+* edges between two holds of the same name are skipped, mirroring the
+  runtime checker's same-name rule (per-instance families have no global
+  order).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .contracts import Finding, parse_package
+
+_FACTORY_FUNCS = ("named_lock", "named_rlock", "named_condition")
+
+
+def _factory_name(value: ast.AST) -> Optional[str]:
+    """The lock name if ``value`` contains a named-lock factory call
+    (possibly behind a conditional expression, like Queue's default
+    condition)."""
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if attr in _FACTORY_FUNCS and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    return None
+
+
+def collect_lock_defs(tree: ast.AST) -> Dict[str, str]:
+    """``{variable-or-attribute-name: lock-name}`` for one module."""
+    defs: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        name = _factory_name(node.value)
+        if name is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                defs[tgt.id] = name
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                defs[tgt.attr] = name
+    return defs
+
+
+def _resolve(item: ast.expr, local: Dict[str, str],
+             global_attrs: Dict[str, str]) -> Optional[str]:
+    if isinstance(item, ast.Name):
+        return local.get(item.id)
+    if isinstance(item, ast.Attribute):
+        return local.get(item.attr) or global_attrs.get(item.attr)
+    return None
+
+
+class _NestingVisitor(ast.NodeVisitor):
+    """Walk one module, recording lexical with-nesting edges between
+    resolved lock names. The hold stack resets at function boundaries —
+    a nested def's body runs later, under whatever locks its CALLER
+    holds, which is the runtime checker's domain."""
+
+    def __init__(self, rel: str, local: Dict[str, str],
+                 global_attrs: Dict[str, str],
+                 edges: Dict[Tuple[str, str], List[Tuple[str, int]]]):
+        self.rel = rel
+        self.local = local
+        self.global_attrs = global_attrs
+        self.edges = edges
+        self.stack: List[str] = []
+
+    def visit_FunctionDef(self, node):
+        saved, self.stack = self.stack, []
+        self.generic_visit(node)
+        self.stack = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            name = _resolve(item.context_expr, self.local,
+                            self.global_attrs)
+            if name is None:
+                continue
+            for held in self.stack:
+                if held != name:
+                    self.edges.setdefault((held, name), []).append(
+                        (self.rel, node.lineno))
+            self.stack.append(name)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.stack[len(self.stack) - pushed:]
+
+    visit_AsyncWith = visit_With
+
+
+def build_lock_graph(root: Optional[str] = None,
+                     files: "Optional[List[Tuple[str, ast.AST]]]" = None
+                     ) -> Tuple[Dict[Tuple[str, str],
+                                     List[Tuple[str, int]]],
+                                Dict[str, str]]:
+    """The static nesting graph: ``{(outer, inner): [(file, line), ...]}``
+    plus the global attribute map used for resolution (diagnostics).
+    ``files`` reuses :func:`contracts.parse_package` output."""
+    trees = files if files is not None else parse_package(root)
+    per_module: Dict[str, Dict[str, str]] = {
+        rel: collect_lock_defs(tree) for rel, tree in trees}
+    # attributes defined in exactly one module resolve globally
+    attr_owners: Dict[str, Set[str]] = {}
+    for rel, defs in per_module.items():
+        for var, name in defs.items():
+            attr_owners.setdefault(var, set()).add(name)
+    global_attrs = {var: next(iter(names))
+                    for var, names in attr_owners.items()
+                    if len(names) == 1}
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for rel, tree in trees:
+        _NestingVisitor(rel, per_module.get(rel, {}), global_attrs,
+                        edges).visit(tree)
+    return edges, global_attrs
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], List[Tuple[str, int]]]
+                 ) -> List[List[str]]:
+    """Elementary cycles via DFS over the name graph (small: one node per
+    lock name). Each cycle is reported once, rotated to start at its
+    lexicographically smallest node."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    cycles: List[List[str]] = []
+
+    def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                i = path.index(nxt)
+                cyc = path[i:]
+                k = cyc.index(min(cyc))
+                canon = tuple(cyc[k:] + cyc[:k])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(list(canon))
+            elif len(path) <= len(graph):
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return cycles
+
+
+def run_lockorder(root: Optional[str] = None,
+                  files: "Optional[List[Tuple[str, ast.AST]]]" = None
+                  ) -> Tuple[List[Finding], Dict[str, List[str]]]:
+    """Findings (one per distinct cycle) plus the static order graph
+    ``{outer: [inners]}`` for the report."""
+    edges, _ = build_lock_graph(root, files=files)
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in sorted(edges):
+        adj.setdefault(a, []).append(b)
+    findings: List[Finding] = []
+    for cyc in _find_cycles(edges):
+        ring = cyc + [cyc[0]]
+        sites = []
+        for a, b in zip(ring, ring[1:]):
+            where = edges.get((a, b), [("?", 0)])[0]
+            sites.append(f"{a}->{b} at {where[0]}:{where[1]}")
+        findings.append(Finding(
+            rule="lock-order-cycle", file=sites[0].split(" at ")[1]
+            .rsplit(":", 1)[0], line=0,
+            message="static lock-nesting cycle "
+                    + " -> ".join(ring) + " (" + "; ".join(sites) + ")",
+            key="lock-order-cycle:" + "->".join(ring)))
+    return findings, adj

@@ -30,6 +30,10 @@ def init(devices=None) -> Communicator:
     if _world is not None:
         return _world
     envmod.read_environment()
+    from .utils import locks
+    locks.configure()  # arm TEMPI_LOCKCHECK after the env parse, with a
+    # fresh acquisition-order graph — recorded order is per-session
+    # evidence, like counters
     from .runtime import faults
     faults.configure()  # arm TEMPI_FAULTS after the env parse; a bad
     # spec fails init loudly (a chaos run that silently tests nothing

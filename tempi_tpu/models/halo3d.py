@@ -483,7 +483,8 @@ class HaloExchange:
         """The fused program is the DEVICE transport; honor the global
         transport knobs (a TEMPI_DATATYPE_ONESHOT sweep must exercise the
         oneshot engine path, not be silently fused over) and provide the
-        usual presence-based escape hatch (TEMPI_NO_FUSED).
+        usual escape hatch (TEMPI_NO_FUSED, loud-parsed via env.bool_env
+        at call time so benches/tests can flip it mid-session).
 
         Under AUTO the measured model keeps its authority: the fused path
         activates only when the per-message model (the same decision the
@@ -493,11 +494,9 @@ class HaloExchange:
         cached per instance: edge geometry is fixed at construction, and
         the engine's own per-comm decision caches have the same
         load-model-then-decide-once lifecycle."""
-        import os
-
         from ..utils import env as envmod
         from ..utils.env import DatatypeMethod
-        if os.environ.get("TEMPI_NO_FUSED") is not None:
+        if envmod.bool_env("TEMPI_NO_FUSED"):
             return False
         if envmod.env.no_tempi:
             # TEMPI_DISABLE measures the baseline: the fused program is a

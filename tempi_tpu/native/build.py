@@ -15,11 +15,13 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..utils import locks
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libtempi_native.so")
 _SOURCES = ["partition.cpp", "iid.cpp", "allocator.cpp"]
 
-_lock = threading.Lock()
+_lock = locks.named_lock("native.build")
 _lib = None
 _tried = False
 

@@ -1,0 +1,64 @@
+"""Trace-event name registry (ISSUE 11).
+
+Every structured event the flight recorder can carry is named here — the
+analog of ``runtime/faults.SITES`` for the observability layer. Dashboards,
+the Chrome-trace export's consumers, and the failure-snapshot triage all
+key on these strings; a typo'd name at an emit site would record events no
+consumer ever queries, silently. The contract linter
+(``python -m tempi_tpu.analysis``) enforces both directions: every
+``obstrace.emit``/``emit_span``/``span`` call site uses a registered name,
+and every registered name has at least one live emit site (a name whose
+emitter was deleted must leave the registry, or the registry stops being
+the truth).
+
+Adding an event = adding its name here and the guarded emit at the code
+location (house pattern: ``if obstrace.ENABLED: obstrace.emit(...)``).
+"""
+
+#: Registered event names, grouped by emitting subsystem.
+EVENTS = (
+    # parallel/p2p.py — post/match/dispatch/completion lifecycle
+    "p2p.post",          # one send/recv posted (kind, rank, peer, tag, nbytes)
+    "p2p.match",         # one matching scan (span; matched count)
+    "p2p.dispatch",      # one strategy batch dispatched (span; outcome)
+    "p2p.complete",      # one request completed (req id, strategy)
+    "p2p.drain",         # completion-sync drain (span; outcome)
+    "p2p.wait_timeout",  # a WaitTimeout fired (stuck count)
+    "p2p.cancel",        # an eager request cancelled (MPI_Cancel analog)
+    "p2p.retry",         # a retry-with-demotion attempt began
+    "p2p.repost",        # a cancelled request reposted on the retry path
+    # parallel/plan.py — staged/oneshot host transports
+    "p2p.staged_round",  # one pack→D2H→move→H2D→unpack round (span)
+    # parallel/alltoallv.py — collective lowering
+    "alltoallv.pair",    # one per-peer message of an isend/irecv lowering
+    "alltoallv.lower",   # one collective lowered to pairs (span)
+    # coll/persistent.py — persistent-collective schedules
+    "coll.choice",       # plan choice (flat vs hier; forced or modeled)
+    "coll.round",        # one schedule round dispatched (span)
+    # tune/online.py — online performance-model adaptation
+    "tune.drift",        # a bin's swept prediction declared stale
+    "tune.adopt",        # adapt mode re-ranked a decision
+    # measure/sweep.py — measurement sections
+    "sweep.section",     # one sweep section captured (span; outcome)
+    # parallel/replacement.py — online topology re-placement
+    "replace.decision",  # one epoch-boundary evaluation's verdict
+    "replace.applied",   # a new mapping installed
+    # runtime/health.py — circuit breakers
+    "breaker.open",      # breaker opened (link, strategy, failures)
+    "breaker.close",     # breaker closed after a successful probe
+    "breaker.half_open",  # cooldown elapsed; probe allowed
+    "breaker.demotion",  # retry demoted the strategy toward STAGED
+    # runtime/liveness.py — fault-tolerant communicators
+    "ft.rank_failure",   # a RankFailure was raised (dead set)
+    "ft.suspect",        # local suspicion recorded (rank, count, source)
+    "ft.verdict",        # agreed death verdict applied
+    "ft.shrink",         # survivor communicator built
+    # runtime/progress.py — pump, supervisor, QoS admission
+    "pump.step",         # one background pump service (span; outcome)
+    "pump.replaced",     # supervisor replaced a wedged/dead pump
+    "pump.quarantine_lifted",  # an abandoned thread exited; comm restored
+    "qos.backpressure",  # a class lane refused a wakeup; caller drove
+    "qos.quarantine",    # a wedge verdict attributed to a class lane
+    # runtime/events.py — leak-site tracker
+    "events.leak",       # an unfreed buffer's allocation site at finalize
+)

@@ -65,6 +65,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 
 MODES = ("off", "flight", "full")
@@ -77,7 +78,7 @@ MODE = "off"
 _DEFAULT_CAPACITY = 4096
 _FAILURE_KEEP = 20  # bounded failure-snapshot history (diagnostics, not logs)
 
-_lock = threading.Lock()  # guards config swaps + ring registry, NOT appends
+_lock = locks.named_lock("trace")  # guards config swaps + ring registry, NOT appends
 _rings: List["_Ring"] = []
 _tls = threading.local()
 _gen = 0          # bumped by configure()/reset(): stale rings detach lazily

@@ -55,12 +55,12 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..utils import env as envmod
 from ..utils import logging as log
 from ..utils.numeric import gcd
 from .strided_block import StridedBlock
@@ -81,16 +81,24 @@ _MAX_DMAS = 64
 # engines; splitting the row range into S concurrent copies (disjoint row
 # chunks of the same output) engages more of them. Read at import;
 # TEMPI_PACK_SPLIT=1 disables, =S targets S-way. Default chosen by the
-# on-chip sweep in benches/bench_pack_tuning.py. Parsed defensively like
-# every other TEMPI_* knob: a malformed value must not break import.
+# on-chip sweep in benches/bench_pack_tuning.py. Parsed LOUDLY like every
+# other TEMPI_* knob (env.int_env + a positive-value check): the old
+# defensive parse clamped zero/negative splits to 1 and shrugged off
+# malformed values — silently running the one-big-copy kernel in the
+# exact session that asked to engage the parallel DMA engines.
 
 
 def _split_target_from_env() -> int:
-    try:
-        return max(1, int(os.environ.get("TEMPI_PACK_SPLIT", "1")))
-    except ValueError:
-        log.warn("malformed TEMPI_PACK_SPLIT ignored")
+    v = envmod.int_env(
+        "TEMPI_PACK_SPLIT",
+        what="a positive integer (S-way DMA row split; 1 = one copy)")
+    if v is None:
         return 1
+    if v <= 0:
+        raise ValueError(
+            f"bad TEMPI_PACK_SPLIT={v}: want a positive integer (S-way "
+            "DMA row split; 1 = one copy, not zero copies)")
+    return v
 
 
 _DMA_SPLIT_TARGET = _split_target_from_env()

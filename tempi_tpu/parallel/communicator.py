@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import locks
 from ..utils import logging as log
 from . import topology as topo_mod
 
@@ -104,7 +105,7 @@ class Communicator:
         self._pending = []  # deferred isend/irecv ops (async engine)
         # serializes op posting and progress between the application thread
         # and the background progress pump
-        self._progress_lock = threading.RLock()
+        self._progress_lock = locks.named_rlock("communicator.progress")
         self.freed = False
         # set by the pump supervisor (runtime/progress.py) when a wedged
         # pump thread was abandoned mid-serve on this communicator: the

@@ -81,8 +81,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
     import jax
 
     addr = (coordinator_address
-            or os.environ.get("TEMPI_COORDINATOR")
-            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+            or envmod.str_env("TEMPI_COORDINATOR")
+            or envmod.str_env("JAX_COORDINATOR_ADDRESS"))
     if _initialized and (coordinator_address is not None
                          or num_processes is not None
                          or process_id is not None):

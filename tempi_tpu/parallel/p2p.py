@@ -10,8 +10,9 @@ reference's "progress only inside TEMPI calls" guarantee
 (async_operation.cpp:501-513). Matched ops compile into an ExchangePlan and
 execute as collective rounds.
 
-Strategy selection mirrors SendRecvND (sender.cpp:251-328): the TEMPI_DATATYPE
-knob forces DEVICE/ONESHOT, and AUTO consults the measured system model
+Strategy selection mirrors SendRecvND (sender.cpp:251-328): the
+TEMPI_DATATYPE_* knobs force DEVICE/ONESHOT, and AUTO consults the measured
+system model
 (measure/system.py) keyed on {colocated, bytes} with a per-plan decision
 cache.
 """

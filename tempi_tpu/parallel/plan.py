@@ -33,6 +33,7 @@ from ..obs import trace as obstrace
 from ..runtime import faults
 from ..utils import compat
 from ..utils import counters as ctr
+from ..utils import env as envmod
 from ..utils import logging as log
 from .communicator import AXIS, Communicator, DistBuffer
 
@@ -67,13 +68,12 @@ def donation_argnums(n: int, skip: int = 0) -> tuple:
     (every plan buffer is rebound to an output carrying identical
     pass-through content); only raw pre-exchange ``jax.Array`` references
     die. CPU ignores donation with a warning per jit, so donate only on
-    accelerator backends. TEMPI_NO_DONATE (presence-based, like every
-    TEMPI_* gate) is the escape hatch for applications that hold raw array
-    references across exchanges. Shared by the exchange plans, the fused/
-    ragged alltoallv programs, and the halo stencil."""
-    import os
-    if jax.default_backend() == "cpu" \
-            or os.environ.get("TEMPI_NO_DONATE") is not None:
+    accelerator backends. TEMPI_NO_DONATE (loud-parsed via env.bool_env
+    at call time, like TEMPI_NO_FUSED) is the escape hatch for
+    applications that hold raw array references across exchanges. Shared
+    by the exchange plans, the fused/ragged alltoallv programs, and the
+    halo stencil."""
+    if jax.default_backend() == "cpu" or envmod.bool_env("TEMPI_NO_DONATE"):
         return ()
     return tuple(range(skip, n))
 

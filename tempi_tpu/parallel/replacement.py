@@ -63,6 +63,7 @@ from ..runtime import faults, health, liveness
 from ..tune import online as tune_online
 from ..utils import counters as ctr
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 from . import partition as part_mod
 from .communicator import Communicator
@@ -77,7 +78,7 @@ MODE = "off"
 
 _LEDGER_KEEP = 100  # bounded decision ledger (diagnostics, not logs)
 
-_lock = threading.Lock()
+_lock = locks.named_lock("replacement")
 _decisions: list = []
 _decision_count = 0
 _applied_total = 0

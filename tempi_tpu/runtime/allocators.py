@@ -29,6 +29,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..utils import counters as ctr
+from ..utils import locks
 from ..utils import logging as log
 from ..utils.numeric import next_pow2
 
@@ -97,7 +98,7 @@ class _PyPool:
         self._live: Dict[int, int] = {}  # id(base array) -> class
         self._stats = dict(num_allocs=0, num_requests=0, num_releases=0,
                            current_usage=0, max_usage=0, reserved=0)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("allocators")
 
     def allocate(self, nbytes: int) -> np.ndarray:
         cls = max(64, next_pow2(nbytes))

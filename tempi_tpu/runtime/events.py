@@ -28,6 +28,7 @@ import jax
 
 from ..obs import trace as obstrace
 from ..utils import counters as ctr
+from ..utils import locks
 from ..utils import logging as log
 
 PREWARM = 5  # reference pre-creates 5 events (events.cpp:69)
@@ -80,7 +81,7 @@ class _EventPool:
     """Reusable event pool with leak detection (events.cpp:17-73)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("events")
         self._free: List[Event] = [Event() for _ in range(PREWARM)]
         self._outstanding = 0
         # id(event) -> creation site, tracked only while the flight

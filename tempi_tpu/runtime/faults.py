@@ -71,6 +71,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 
 #: Registered injection sites. Adding a site = adding its name here and an
@@ -199,7 +200,7 @@ _release_event = threading.Event()
 # site exercised concurrently — the background pump and an application
 # waiter both pass p2p.progress — must not lose increments or interleave
 # rng draws, or the (seed, pass number) determinism contract breaks
-_state_lock = threading.Lock()
+_state_lock = locks.named_lock("faults")
 
 
 def configure(spec: Optional[str] = None) -> None:
@@ -366,7 +367,7 @@ class _Watchdog:
 
 
 _watchdog: Optional[_Watchdog] = None
-_watchdog_lock = threading.Lock()
+_watchdog_lock = locks.named_lock("faults.watchdog")
 
 
 def call_with_timeout(fn, timeout_s: float):

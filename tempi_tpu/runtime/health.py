@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..obs import trace as obstrace
 from ..utils import env as envmod
+from ..utils import locks
 
 CLOSED = "closed"
 OPEN = "open"
@@ -95,7 +96,7 @@ class _Breaker:
     pinned: bool = False
 
 
-_lock = threading.Lock()
+_lock = locks.named_lock("health")
 _table: Dict[Tuple[tuple, str], _Breaker] = {}
 # demotion audit trail for the api snapshot (bounded; diagnostics, not logs)
 _demotions: List[dict] = []

@@ -79,6 +79,7 @@ import numpy as np
 from ..obs import trace as obstrace
 from ..utils import counters as ctr
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 from . import faults, health
 
@@ -145,7 +146,7 @@ class _CommLiveness:
     agree_round: int = 0
 
 
-_lock = threading.Lock()
+_lock = locks.named_lock("liveness")
 _states: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _verdicts: List[dict] = []
 _verdict_entries = 0

@@ -43,6 +43,7 @@ from typing import List, Optional
 
 from ..obs import trace as obstrace
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 from . import faults, qos
 from .queue import ShutDown
@@ -154,7 +155,7 @@ _quarantined: "weakref.WeakSet" = weakref.WeakSet()
 _replacements = 0  # total supervisor-driven pump replacements
 _supervisor: Optional[threading.Thread] = None
 _supervisor_stop = threading.Event()
-_lock = threading.Lock()
+_lock = locks.named_lock("progress")
 
 
 def start() -> ProgressPump:

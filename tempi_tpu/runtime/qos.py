@@ -49,6 +49,7 @@ from typing import Dict, List, Optional
 
 from ..utils import counters as ctr
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 from .queue import Queue, ShutDown  # noqa: F401  (re-export for the pump)
 
@@ -62,7 +63,7 @@ ENABLED = False
 # lane-quarantine verdicts this session (class -> count): the supervisor's
 # wedge verdicts attributed to the tenant's class, for qos_snapshot()
 _quarantine_verdicts: Dict[str, int] = {}
-_verdict_lock = threading.Lock()
+_verdict_lock = locks.named_lock("qos.verdicts")
 
 
 def configure() -> None:
@@ -164,7 +165,7 @@ class ClassScheduler:
     def __init__(self):
         # RLock: pop()/push_unique() hold the shared condition while
         # calling lane methods that re-enter it
-        self._cv = threading.Condition(threading.RLock())
+        self._cv = locks.named_condition("qos")
         self._lanes: Dict[str, Queue] = {
             cls: Queue(cond=self._cv) for cls in CLASSES}
         self._credits: Dict[str, int] = {cls: 0 for cls in CLASSES}

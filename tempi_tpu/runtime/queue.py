@@ -16,6 +16,8 @@ import collections
 import threading
 from typing import Generic, List, Optional, TypeVar
 
+from ..utils import locks
+
 T = TypeVar("T")
 
 
@@ -38,7 +40,7 @@ class Queue(Generic[T]):
         # must not scan the deque, or a large multi-tenant backlog makes
         # every op-post notify linear in queued communicators
         self._ids: set = set()
-        self._cv = cond if cond is not None else threading.Condition()
+        self._cv = cond if cond is not None else locks.named_condition("queue")
         self._closed = False
 
     def push(self, item: T) -> None:

@@ -62,6 +62,7 @@ from ..measure import system as msys
 from ..obs import trace as obstrace
 from ..runtime import faults, health
 from ..utils import env as envmod
+from ..utils import locks
 from ..utils import logging as log
 
 MODES = ("off", "observe", "adapt")
@@ -105,7 +106,7 @@ class BinStats:
     # off-node link would read as degraded next to its ICI peers
 
 
-_lock = threading.Lock()
+_lock = locks.named_lock("tune.online")
 _table: Dict[Tuple[tuple, str, int], BinStats] = {}
 _stale_count = 0
 _samples = 0

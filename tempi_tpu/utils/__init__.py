@@ -1,1 +1,1 @@
-from . import counters, env, logging, numeric, statistics  # noqa: F401
+from . import counters, env, locks, logging, numeric, statistics  # noqa: F401

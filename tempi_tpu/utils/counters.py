@@ -138,6 +138,16 @@ class FtCounters:
 
 
 @dataclass
+class LockCheckCounters:
+    # lock-order race detector (ISSUE 11; utils/locks.py): pinned at zero
+    # with TEMPI_LOCKCHECK unset — the counter-based byte-for-byte guard
+    # that the off path tracks nothing and touches no graph state
+    num_tracked_acquires: int = 0  # acquires recorded while armed
+    num_edges: int = 0             # acquisition-order edges first recorded
+    num_inversions: int = 0        # would-be inversions (incl. self-deadlocks)
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -164,6 +174,7 @@ class Counters:
     qos: QosCounters = field(default_factory=QosCounters)
     replace: ReplaceCounters = field(default_factory=ReplaceCounters)
     ft: FtCounters = field(default_factory=FtCounters)
+    lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
 
     def as_dict(self) -> dict:
         out = {}

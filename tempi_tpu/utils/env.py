@@ -220,11 +220,44 @@ the README "Fault tolerance" section):
                          verdict; processes that do not vote within it
                          abstain (default 5)
 
+Correctness-tooling knobs (ISSUE 11; see utils/locks.py,
+tempi_tpu/analysis/ and the README "Static analysis & race detection"
+section):
+  TEMPI_LOCKCHECK      = off | assert | log — the runtime lock-order
+                         race detector over the named-lock factory
+                         (default off = one module-flag truth test per
+                         acquire, counters.lockcheck pinned at zero).
+                         ``assert`` raises LockOrderError BEFORE an
+                         acquire that would close a cycle in the global
+                         acquisition-order graph (the chaos smoke runs
+                         under this mode, so every fault/recovery/FT/QoS
+                         scenario doubles as a race regression test);
+                         ``log`` records and warns once per inverted
+                         pair, then continues (production triage).
+
+Per-call boolean/integer escape hatches read OUTSIDE read_environment
+(consulted at call time so tests and benches can flip them mid-session;
+loud-parsed via bool_env/int_env below):
+  TEMPI_NO_FUSED       disable the fused exchange+stencil halo program
+                         (models/halo3d._fused_eligible): the exchange
+                         routes through the engine and applies its
+                         per-message strategy choices instead
+  TEMPI_NO_DONATE      disable HBM buffer donation in exchange programs
+                         (parallel/plan.donation_argnums): the escape
+                         hatch for applications holding raw pre-exchange
+                         jax.Array references across exchanges
+  TEMPI_PACK_SPLIT     single-combo pack-DMA row-split target, read once
+                         at ops/pack_pallas import (1 = one big strided
+                         copy; S = S concurrent disjoint row chunks;
+                         zero/negative rejected loudly — a non-positive
+                         split would silently disable the parallel-DMA
+                         engagement the knob exists to tune)
+
 All resilience, observability, tuning, persistent-collective, QoS,
-re-placement, and fault-tolerance knobs parse LOUDLY (a typo raises at
-init rather than silently reverting to the hang/die/fly-blind/
-frozen-model/head-of-line-blocked/frozen-placement/stall-forever
-behavior the knob exists to prevent).
+re-placement, fault-tolerance, and correctness-tooling knobs parse
+LOUDLY (a typo raises at init rather than silently reverting to the
+hang/die/fly-blind/frozen-model/head-of-line-blocked/frozen-placement/
+stall-forever/race-unchecked behavior the knob exists to prevent).
 """
 
 from __future__ import annotations
@@ -232,6 +265,94 @@ from __future__ import annotations
 import enum
 import os
 from dataclasses import dataclass, field
+
+
+#: The loud-parse knob registry: every ``TEMPI_*`` name the framework
+#: consults, whether parsed into :class:`Environment` by
+#: ``read_environment`` or read per-call through the loud single-knob
+#: helpers (``int_env``/``bool_env``/``str_env``) below. The contract
+#: linter (``python -m tempi_tpu.analysis``) enforces that every
+#: ``TEMPI_*`` literal in package code appears here AND in the README
+#: knob tables — a knob that exists in code but not in the registry is
+#: exactly the silently-undocumented surface this registry exists to
+#: prevent.
+KNOWN_KNOBS = (
+    "TEMPI_DISABLE",
+    "TEMPI_NO_PACK",
+    "TEMPI_NO_TYPE_COMMIT",
+    "TEMPI_ALLTOALLV_REMOTE_FIRST",
+    "TEMPI_ALLTOALLV_STAGED",
+    "TEMPI_ALLTOALLV_ISIR_STAGED",
+    "TEMPI_ALLTOALLV_ISIR_REMOTE_STAGED",
+    "TEMPI_NO_ALLTOALLV",
+    "TEMPI_PLACEMENT_METIS",
+    "TEMPI_PLACEMENT_KAHIP",
+    "TEMPI_PLACEMENT_RANDOM",
+    "TEMPI_DATATYPE_ONESHOT",
+    "TEMPI_DATATYPE_DEVICE",
+    "TEMPI_DATATYPE_AUTO",
+    "TEMPI_CONTIGUOUS_STAGED",
+    "TEMPI_CONTIGUOUS_AUTO",
+    "TEMPI_CACHE_DIR",
+    "TEMPI_NO_COMPILE_CACHE",
+    "TEMPI_TRACE_DIR",
+    "TEMPI_PACK_KERNEL",
+    "TEMPI_RANKS_PER_NODE",
+    "TEMPI_TORUS",
+    "TEMPI_PROGRESS_THREAD",
+    "TEMPI_OUTPUT_LEVEL",
+    # fault injection & resilience (ISSUE 1)
+    "TEMPI_FAULTS",
+    "TEMPI_FAULT_DELAY_S",
+    "TEMPI_WAIT_TIMEOUT_S",
+    "TEMPI_INIT_RETRIES",
+    "TEMPI_INIT_BACKOFF_S",
+    # self-healing recovery (ISSUE 2)
+    "TEMPI_RETRY_ATTEMPTS",
+    "TEMPI_RETRY_BACKOFF_S",
+    "TEMPI_BREAKER_THRESHOLD",
+    "TEMPI_BREAKER_COOLDOWN_S",
+    "TEMPI_PUMP_HEARTBEAT_S",
+    "TEMPI_PUMP_STOP_TIMEOUT_S",
+    # observability (ISSUE 3)
+    "TEMPI_TRACE",
+    "TEMPI_TRACE_EVENTS",
+    "TEMPI_TRACE_PATH",
+    # online adaptation (ISSUE 4)
+    "TEMPI_TUNE",
+    "TEMPI_TUNE_DRIFT",
+    "TEMPI_TUNE_MIN_SAMPLES",
+    "TEMPI_TUNE_EXPLORE",
+    # persistent collectives (ISSUE 5) + hierarchy (ISSUE 10)
+    "TEMPI_COLL_CHUNK_BYTES",
+    "TEMPI_A2AV_SPLIT_OVERHEAD",
+    "TEMPI_COLL_HIER",
+    "TEMPI_COLL_CHUNK_BYTES_ICI",
+    "TEMPI_COLL_CHUNK_BYTES_DCN",
+    # multi-tenant QoS (ISSUE 7)
+    "TEMPI_QOS_DEFAULT",
+    "TEMPI_QOS_QUEUE_DEPTH",
+    "TEMPI_QOS_WEIGHTS",
+    # online re-placement (ISSUE 8)
+    "TEMPI_REPLACE",
+    "TEMPI_REPLACE_MIN_GAIN",
+    "TEMPI_REPLACE_PENALTY",
+    # fault-tolerant communicators (ISSUE 9)
+    "TEMPI_FT",
+    "TEMPI_FT_SUSPECT_TIMEOUTS",
+    "TEMPI_FT_HEARTBEAT_S",
+    "TEMPI_FT_AGREE_TIMEOUT_S",
+    # correctness tooling (ISSUE 11)
+    "TEMPI_LOCKCHECK",
+    # multi-host world coordinates (parallel/multihost.py)
+    "TEMPI_COORDINATOR",
+    "TEMPI_NUM_PROCESSES",
+    "TEMPI_PROCESS_ID",
+    # per-call escape hatches (bool_env/int_env call sites)
+    "TEMPI_NO_FUSED",
+    "TEMPI_NO_DONATE",
+    "TEMPI_PACK_SPLIT",
+)
 
 
 class PlacementMethod(enum.Enum):
@@ -374,6 +495,8 @@ class Environment:
     ft_suspect_timeouts: int = 2   # unmatched timeouts before suspicion
     ft_heartbeat_s: float = 0.0    # stale-heartbeat accelerant (0 = off)
     ft_agree_timeout_s: float = 5.0  # DCN agreement vote budget
+    # lock-order race detector (ISSUE 11) — see utils/locks.py
+    lockcheck_mode: str = "off"    # off | assert | log
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -705,6 +828,15 @@ class Environment:
         e.ft_heartbeat_s = _float_env("TEMPI_FT_HEARTBEAT_S", 0.0)
         e.ft_agree_timeout_s = _float_env("TEMPI_FT_AGREE_TIMEOUT_S", 5.0)
 
+        # the lock-order checker parses loudly too: a typo'd
+        # TEMPI_LOCKCHECK silently staying off would run the one chaos
+        # session that asked for race checking with the detector disarmed
+        lc = (getenv("TEMPI_LOCKCHECK") or "off").lower()
+        if lc not in ("off", "assert", "log"):
+            raise ValueError(
+                f"bad TEMPI_LOCKCHECK={lc!r}: want off | assert | log")
+        e.lockcheck_mode = lc
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -741,6 +873,11 @@ class Environment:
             # ...and the liveness layer: the underlying library has no
             # rank-failure semantics to emulate
             e.ft_mode = "off"
+            # TEMPI_LOCKCHECK deliberately survives the bail-out: the
+            # lock-order checker observes the framework's own locks (which
+            # exist regardless of interposition) and is developer tooling,
+            # not transport behavior — a TEMPI_DISABLE baseline run should
+            # still be race-checkable
         return e
 
 
@@ -771,3 +908,41 @@ def int_env(name: str, what: str = "an integer", environ=None
         return int(v)
     except ValueError as exc:
         raise ValueError(f"bad {name}={v!r}: want {what}") from exc
+
+
+def bool_env(name: str, environ=None) -> bool:
+    """Loud single-knob boolean parse for ``TEMPI_*`` escape hatches
+    consulted at CALL time rather than frozen into ``read_environment``
+    (``TEMPI_NO_FUSED``, ``TEMPI_NO_DONATE`` — benches and tests flip
+    them mid-session, so the read must be live). Unset or empty returns
+    False; ``1/true/yes/on`` returns True; ``0/false/no/off`` returns
+    False; anything else raises naming the knob. The historical
+    presence-check reads (``os.environ.get(name) is not None``) treated
+    ``NAME=0`` as SET — the exact silent surprise this helper replaces:
+    an operator writing ``TEMPI_NO_FUSED=0`` to keep fusion on was
+    turning it off."""
+    v = (environ if environ is not None else os.environ).get(name)
+    if v is None or v.strip() == "":
+        return False
+    s = v.strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(
+        f"bad {name}={v!r}: want a boolean (1/true/yes/on or "
+        "0/false/no/off; unset = off)")
+
+
+def str_env(name: str, environ=None) -> "str | None":
+    """Single-knob string read for free-form variables consulted outside
+    ``read_environment`` (``TEMPI_COORDINATOR``, jax's own
+    ``JAX_COORDINATOR_ADDRESS``). No validation is possible for a
+    free-form address, so this exists purely to keep raw ``os.environ``
+    access centralized here — the contract the linter
+    (``python -m tempi_tpu.analysis``) enforces package-wide. Unset or
+    empty returns None."""
+    v = (environ if environ is not None else os.environ).get(name)
+    if v is None or v.strip() == "":
+        return None
+    return v

@@ -1,0 +1,387 @@
+"""Named-lock factory and runtime lock-order race detector (ISSUE 11).
+
+The threaded runtime — progress pump, supervisor, deadline waiters,
+liveness votes, QoS scheduler — holds 16+ module locks with an ordering
+discipline that lived only in docstrings (e.g. ``liveness._declare_dead``:
+"never holds the module lock across the communicator's progress lock").
+This module makes that discipline machine-checked: every module lock is
+created through the factory here, carrying a NAME, and an optional runtime
+checker records per-thread held-lock sets into a global acquisition-order
+graph and flags a would-be inversion BEFORE it can deadlock — a
+ThreadSanitizer-lite for the pump/supervisor/waiter/vote threads. The
+static companion pass (``tempi_tpu/analysis/lockorder.py``) builds the
+same graph from ``with``-statement ASTs at lint time.
+
+Knob (parsed LOUDLY in utils/env.py, like every resilience knob)::
+
+    TEMPI_LOCKCHECK = off | assert | log      (default off)
+
+Modes:
+  off    — plain locking; every acquire costs one module-attribute truth
+           test over the underlying ``threading`` primitive (no tracking
+           state touched, no allocation — the zero-cost pattern of
+           ``runtime/faults.py``/``obs/trace.py``, pinned by the
+           ``counters.lockcheck`` group staying zero).
+  assert — a would-be inversion raises :class:`LockOrderError` BEFORE the
+           acquire (the offending thread never blocks, so the error is
+           observable instead of a deadlock). The chaos smoke runs under
+           this mode: every fault/recovery/FT/QoS scenario doubles as a
+           race regression test.
+  log    — inversions are recorded in the graph and logged once per
+           ordered pair; execution continues (production triage mode).
+           A self-reacquire of a held non-reentrant lock still raises
+           even here: it is a GUARANTEED hang, not a potential one, so
+           there is nothing meaningful to continue into.
+
+Ordering model: acquiring lock B while holding lock A establishes the
+directed edge A -> B in a global graph keyed by lock NAME. An acquisition
+that would close a cycle (B ->* A already recorded by any thread) is an
+inversion: two threads interleaving those two paths can deadlock. Edges
+between two holds of the SAME name are ignored — instances of one name
+class (per-communicator progress locks, per-allocator pool locks) have no
+global order to check, and re-entrant re-acquisition of one RLock is
+ordering-neutral.
+
+Condition-variable integration: :func:`named_condition` builds a
+``threading.Condition`` over a named re-entrant lock; ``wait()`` releases
+through the wrapper (``_release_save``/``_acquire_restore``), so the
+held-set stays truthful across a blocking wait.
+
+The checker's own internal mutex (``_graph_lock``) is a LEAF by
+construction — it is only ever held inside this module, never across a
+named-lock acquire — so the detector cannot deadlock the runtime it
+watches, and it deliberately is NOT a named lock itself.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import counters as ctr
+from . import env as envmod
+from . import logging as log
+
+MODES = ("off", "assert", "log")
+
+#: Module-level fast-path flag: True iff mode != off. Acquire/release
+#: test this before touching any tracking state (see module docstring).
+ENABLED = False
+MODE = "off"
+
+# acquisition-order graph: name -> set of names acquired while holding it.
+# _edge_witness remembers which thread first established each edge (the
+# diagnostic that turns "inversion" into a fixable report). _warned keeps
+# log-mode noise to one line per ordered pair. All three are guarded by
+# the leaf _graph_lock.
+_graph: Dict[str, Set[str]] = {}
+_edge_witness: Dict[Tuple[str, str], str] = {}
+_warned: Set[Tuple[str, str]] = set()
+_graph_lock = threading.Lock()
+
+# per-thread held-lock stack (list of _NamedLock, innermost last)
+_tls = threading.local()
+
+# every name ever created through the factory (introspection + the static
+# pass's cross-check that migrated modules really use the factory)
+_names: Set[str] = set()
+_names_lock = threading.Lock()
+
+
+class LockOrderError(RuntimeError):
+    """A would-be lock-order inversion (``TEMPI_LOCKCHECK=assert``).
+
+    Raised BEFORE the offending acquire: the reported thread is the one
+    whose nesting contradicts the recorded order, and it has NOT taken
+    the lock — the process stays live, unlike the deadlock this error
+    preempts. Carries ``holding`` (the held lock name), ``acquiring``
+    (the requested name), and ``path`` (the previously recorded
+    acquiring ->* holding chain that the new edge would close into a
+    cycle)."""
+
+    def __init__(self, holding: str, acquiring: str, path: List[str],
+                 witness: str):
+        if holding == acquiring:
+            msg = (f"self-deadlock: thread "
+                   f"{threading.current_thread().name!r} re-acquiring "
+                   f"non-reentrant lock {acquiring!r} it already holds "
+                   "(this acquire would block forever)")
+        else:
+            msg = (f"lock-order inversion: acquiring {acquiring!r} while "
+                   f"holding {holding!r}, but the opposite order "
+                   f"{' -> '.join(path)} was already established "
+                   f"(first witnessed on thread {witness!r}); two threads "
+                   "interleaving these paths can deadlock")
+        super().__init__(msg)
+        self.holding = holding
+        self.acquiring = acquiring
+        self.path = list(path)
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the checker. ``mode=None`` reads the parsed env's
+    ``lockcheck_mode`` (so call after ``read_environment``); an explicit
+    mode overrides (test convenience). Clears the acquisition-order graph
+    — recorded order is per-session evidence, like counters. Threads'
+    held-sets are NOT touched (they are transient critical-section state
+    owned by their threads; releases drain them regardless of mode)."""
+    global ENABLED, MODE
+    if mode is None:
+        mode = getattr(envmod.env, "lockcheck_mode", "off")
+    if mode not in MODES:
+        raise ValueError(
+            f"bad lockcheck mode {mode!r}: want one of {MODES}")
+    with _graph_lock:
+        MODE = mode
+        ENABLED = mode != "off"
+        _graph.clear()
+        _edge_witness.clear()
+        _warned.clear()
+    if ENABLED:
+        log.debug(f"lock-order checker armed: mode={mode}")
+
+
+def _held() -> List["_NamedLock"]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """A recorded ``src ->* dst`` chain, or None. Caller holds
+    ``_graph_lock``. Iterative DFS — the graph is small (one node per
+    lock NAME, not per instance), so this stays off no hot path's
+    complexity budget even when armed."""
+    stack: List[Tuple[str, List[str]]] = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _graph.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_edges(nl: "_NamedLock", held: List["_NamedLock"]) -> None:
+    """Record held -> ``nl`` edges and detect inversions. Runs BEFORE the
+    acquire, so an assert-mode raise leaves the lock untaken."""
+    b = nl.name
+    preds: List[str] = []
+    seen = {b}
+    for h in reversed(held):
+        if h.name not in seen:
+            seen.add(h.name)
+            preds.append(h.name)
+    if not preds:
+        return
+    inversion: Optional[Tuple[str, List[str], str]] = None
+    tname = threading.current_thread().name
+    with _graph_lock:
+        for a in preds:
+            succ = _graph.get(a)
+            if succ is not None and b in succ:
+                continue  # known-good edge: nothing to re-check
+            path = _find_path(b, a)
+            if path is not None:
+                ctr.counters.lockcheck.num_inversions += 1
+                witness = _edge_witness.get((path[0], path[1]), "?") \
+                    if len(path) > 1 else "?"
+                if MODE == "log":
+                    # record the (cyclic) edge so the graph keeps telling
+                    # the whole story, but warn once per ordered pair
+                    _graph.setdefault(a, set()).add(b)
+                    _edge_witness.setdefault((a, b), tname)
+                    ctr.counters.lockcheck.num_edges += 1
+                    if (a, b) not in _warned:
+                        _warned.add((a, b))
+                        inversion = (a, path, witness)
+                else:
+                    inversion = (a, path, witness)
+                break
+            _graph.setdefault(a, set()).add(b)
+            _edge_witness.setdefault((a, b), tname)
+            ctr.counters.lockcheck.num_edges += 1
+    if inversion is None:
+        return
+    a, path, witness = inversion
+    if MODE == "assert":
+        raise LockOrderError(a, b, path, witness)
+    log.warn(
+        f"lock-order inversion (TEMPI_LOCKCHECK=log): acquiring {b!r} "
+        f"while holding {a!r}, but {' -> '.join(path)} was already "
+        f"established (first witnessed on thread {witness!r})")
+
+
+class _NamedLock:
+    """A ``threading.Lock``/``RLock`` wrapper carrying a NAME for the
+    order checker. With the checker off, ``acquire``/``release`` cost one
+    module-flag truth test over the raw primitive and allocate nothing."""
+
+    __slots__ = ("name", "reentrant", "_lock")
+
+    def __init__(self, name: str, reentrant: bool):
+        self.name = name
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        with _names_lock:
+            _names.add(name)
+
+    def __repr__(self) -> str:  # diagnostics only
+        kind = "rlock" if self.reentrant else "lock"
+        return f"<named_{kind} {self.name!r}>"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not ENABLED:
+            return self._lock.acquire(blocking, timeout)
+        held = _held()
+        if held:
+            if any(h is self for h in held):
+                if not self.reentrant:
+                    # re-acquiring a held non-reentrant lock is a
+                    # GUARANTEED self-deadlock, not a potential one like
+                    # an order inversion — raising beats blocking forever
+                    # in EVERY armed mode (log mode's continue-and-warn
+                    # semantics only make sense when continuing can work)
+                    ctr.counters.lockcheck.num_inversions += 1
+                    raise LockOrderError(self.name, self.name,
+                                         [self.name], "self")
+            else:
+                _note_edges(self, held)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held.append(self)
+            ctr.counters.lockcheck.num_tracked_acquires += 1
+        return ok
+
+    def release(self) -> None:
+        held = getattr(_tls, "held", None)
+        if held:
+            # pop the innermost matching hold; tolerant of a mid-hold
+            # configure() flip (an untracked acquire released while
+            # tracking is on simply finds nothing to pop)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        self._lock.release()
+
+    def __enter__(self) -> "_NamedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._lock, "locked", None)
+        return bool(inner_locked()) if inner_locked is not None else False
+
+    # -- threading.Condition integration ----------------------------------
+    # Condition picks these up at construction; wait() then releases and
+    # reacquires THROUGH the wrapper, keeping the held-set truthful while
+    # the thread is parked.
+
+    def _is_owned(self) -> bool:
+        inner = self._lock
+        owned = getattr(inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        held = getattr(_tls, "held", None)
+        n = 0
+        if held:
+            keep = [h for h in held if h is not self]
+            n = len(held) - len(keep)
+            held[:] = keep
+        inner = self._lock
+        save = getattr(inner, "_release_save", None)
+        if save is not None:
+            return (save(), n)
+        inner.release()
+        return (None, n)
+
+    def _acquire_restore(self, state) -> None:
+        save, n = state
+        inner = self._lock
+        restore = getattr(inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(save)
+        else:
+            inner.acquire()
+        if n and ENABLED:
+            # re-tracking after a wait records no edges: the wait's
+            # reacquire restores a hold whose ordering was checked when
+            # it was first taken
+            _held().extend([self] * n)
+
+
+def named_lock(name: str) -> _NamedLock:
+    """A non-reentrant module lock registered with the order checker.
+    ``name`` is the checker's graph node — one per lock CLASS (module
+    singleton or per-instance family), dot-scoped like counter groups
+    (``"health"``, ``"faults.watchdog"``)."""
+    return _NamedLock(name, reentrant=False)
+
+
+def named_rlock(name: str) -> _NamedLock:
+    """Re-entrant variant of :func:`named_lock` (the communicator
+    progress lock's shape)."""
+    return _NamedLock(name, reentrant=True)
+
+
+def named_condition(name: str) -> threading.Condition:
+    """A ``threading.Condition`` over a named re-entrant lock. Shared-CV
+    designs (the QoS class lanes) pass the returned condition around
+    exactly as they would a raw one."""
+    return threading.Condition(named_rlock(name))
+
+
+# -- introspection -------------------------------------------------------------
+
+
+def known_names() -> List[str]:
+    """Every lock name created through the factory this process."""
+    with _names_lock:
+        return sorted(_names)
+
+
+def held_names() -> List[str]:
+    """The CALLING thread's current held-lock names, outermost first
+    (empty when the checker is off — nothing is tracked)."""
+    return [h.name for h in getattr(_tls, "held", ())]
+
+
+def order_graph() -> Dict[str, List[str]]:
+    """The recorded acquisition-order graph: ``{name: [successors]}``.
+    Pure data — safe to serialize (test assertions, diagnostics)."""
+    with _graph_lock:
+        return {a: sorted(bs) for a, bs in _graph.items()}
+
+
+def stats() -> dict:
+    """Checker bookkeeping: mode, known lock names, recorded edge count,
+    and the counters mirror (tracked acquires / edges / inversions)."""
+    with _graph_lock:
+        edges = sum(len(bs) for bs in _graph.values())
+    g = ctr.counters.lockcheck
+    return dict(mode=MODE, enabled=ENABLED, names=known_names(),
+                edges=edges,
+                tracked_acquires=g.num_tracked_acquires,
+                recorded_edges=g.num_edges,
+                inversions=g.num_inversions)
+
+
+# arm from the import-time env parse so locks created and used before
+# api.init() (module import order) honor an already-exported knob;
+# api.init()/conftest re-run configure() after each read_environment
+configure()

@@ -5,6 +5,13 @@ Re-design of the reference's compile-time logging macros
 gating, so the level is read once from TEMPI_OUTPUT_LEVEL (SPEW, DEBUG, INFO,
 WARN, ERROR, FATAL; default INFO) and checked per call. FATAL raises instead
 of exit(1) so callers/tests can observe it.
+
+An UNKNOWN level name warns loudly once (listing the valid names) and falls
+back to INFO — it cannot raise, because a broken level must not take the
+logging layer down with it, but it must not silently swallow the one DEBUG
+run that was asked for either (ISSUE 11 satellite; the knob is read through
+``utils/env.py`` like every other ``TEMPI_*`` variable, the contract the
+linter enforces package-wide).
 """
 
 from __future__ import annotations
@@ -13,12 +20,15 @@ import inspect
 import os
 import sys
 
+from . import env as _envmod
+
 SPEW, DEBUG, INFO, WARN, ERROR, FATAL = 0, 1, 2, 3, 4, 5
 _NAMES = {"SPEW": SPEW, "DEBUG": DEBUG, "INFO": INFO, "WARN": WARN,
           "ERROR": ERROR, "FATAL": FATAL}
 _LABELS = {v: k for k, v in _NAMES.items()}
 
-_level = _NAMES.get(os.environ.get("TEMPI_OUTPUT_LEVEL", "INFO").upper(), INFO)
+_raw_level = _envmod.str_env("TEMPI_OUTPUT_LEVEL")
+_level = _NAMES.get((_raw_level or "INFO").upper(), INFO)
 
 # set by tempi.init(); -1 = not initialized
 world_rank: int = -1
@@ -72,3 +82,11 @@ def error(msg: str) -> None:
 def fatal(msg: str) -> None:
     _emit(FATAL, msg)
     raise TempiFatal(msg)
+
+
+# module import runs once per process, so this warning fires ONCE: an
+# unknown level name must not silently become INFO in the session that
+# exported TEMPI_OUTPUT_LEVEL=DEBG expecting the debug stream
+if _raw_level is not None and _raw_level.upper() not in _NAMES:
+    warn(f"unknown TEMPI_OUTPUT_LEVEL={_raw_level!r}; falling back to "
+         f"INFO (valid level names: {', '.join(_NAMES)})")

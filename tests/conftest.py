@@ -51,6 +51,10 @@ def pytest_configure(config):
         "markers",
         "ft: fault-tolerant communicator tests — rank-failure detection, "
         "revocation, shrink (the <30s smoke is `pytest -m ft`)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: contract-linter + lock-order checker tests (the <30s "
+        "smoke is `pytest -m analysis`, incl. the self-run on the repo)")
 
 
 @pytest.fixture(autouse=True)
@@ -63,9 +67,12 @@ def _reset_globals():
     from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import faults, health, liveness, qos
     from tempi_tpu.tune import online as tune_online
-    from tempi_tpu.utils import counters, env
+    from tempi_tpu.utils import counters, env, locks
 
     env.read_environment()
+    locks.configure()  # re-arm TEMPI_LOCKCHECK with a fresh order graph:
+    # recorded acquisition order is per-test evidence (two tests' opposite
+    # but never-concurrent orders are not an inversion)
     faults.configure()
     obstrace.configure()
     tune_online.configure()
@@ -87,3 +94,4 @@ def _reset_globals():
     qos.disarm()
     replacement.configure("off")
     liveness.configure("off")
+    locks.configure("off")
