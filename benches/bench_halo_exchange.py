@@ -29,6 +29,14 @@ def main() -> int:
     p.add_argument("--no-phases", action="store_true",
                    help="skip the per-phase pack/comm/unpack attribution "
                         "pass (it compiles extra phase-isolated programs)")
+    p.add_argument("--step", choices=("capture", "eager"), default=None,
+                   help="A/B the whole-step persistent schedule (ISSUE "
+                        "12): 'eager' posts the per-direction batches "
+                        "through the engine every iteration; 'capture' "
+                        "records one iteration with api.capture_step and "
+                        "replays the fused PersistentStep — the CSV "
+                        "gains step_path/step_iters_per_s/"
+                        "step_launches_per_iter columns")
     args = p.parse_args()
     if args.engine:
         import os
@@ -89,11 +97,14 @@ def main() -> int:
     phases = _phase_split(ex, buf, min(iters, 10)) if not args.no_phases \
         else {}
 
+    step_ab = _step_ab(ex, args.step, min(iters, 50)) if args.step else {}
+
     halo_bytes = sum(e.cells for e in ex.edges) * 4
     emit_csv(("grid", "ranks", "iters", "path", "total_s", "iter_s",
               "iters_per_s", "exchange_s_per_iter", "compute_s_per_iter",
               "halo_MB_per_iter", "lcr_s", "pack_s", "comm_s", "unpack_s",
-              "self_s"),
+              "self_s", "step_path", "step_iters_per_s",
+              "step_launches_per_iter"),
              [(args.grid, comm.size, iters,
                # label the path actually TAKEN: external knobs
                # (TEMPI_NO_FUSED/DISABLE/DATATYPE_*) also deselect fused
@@ -102,9 +113,51 @@ def main() -> int:
                t_ex, t_comp, halo_bytes / 1e6,
                t_comp,  # lcr = local compute (the stencil), reference naming
                phases.get("pack_s", ""), phases.get("comm_s", ""),
-               phases.get("unpack_s", ""), phases.get("self_s", ""))])
+               phases.get("unpack_s", ""), phases.get("self_s", ""),
+               step_ab.get("path", ""), step_ab.get("ips", ""),
+               step_ab.get("launches", ""))])
     api.finalize()
     return 0
+
+
+def _step_ab(ex, mode: str, iters: int) -> dict:
+    """One arm of the whole-step A/B (ISSUE 12) over the per-direction
+    grouped exchange — the MPI-application posting shape. ``eager`` pays
+    one plan dispatch (one pack launch) per direction per iteration;
+    ``capture`` replays the fused PersistentStep: one batched
+    multi-descriptor pack launch per iteration and zero per-step
+    planning. Reports iters/s and the counter-measured device launches
+    per iteration."""
+    import time as _time
+
+    from tempi_tpu import api
+    from tempi_tpu.utils import counters as ctr
+
+    buf = ex.alloc_grid(fill=lambda rank, shape: float(rank))
+    if mode == "capture":
+        with api.capture_step(ex.comm) as rec:
+            ex.exchange_grouped(buf)
+        step = rec.compile()
+        step.start()
+        step.wait()  # warm the replay path
+
+        def one():
+            step.start()
+            step.wait()
+    else:
+        ex.exchange_grouped(buf)  # warm: build + compile the batches
+
+        def one():
+            ex.exchange_grouped(buf)
+
+    c0 = ctr.counters.device.num_launches
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        one()
+    dt = _time.perf_counter() - t0
+    launches = (ctr.counters.device.num_launches - c0) / iters
+    return {"path": f"step-{mode}", "ips": round(iters / dt, 2),
+            "launches": round(launches, 2)}
 
 
 def _phase_split(ex, buf, iters: int) -> dict:

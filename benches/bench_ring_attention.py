@@ -32,6 +32,13 @@ def main() -> int:
     p.add_argument("--causal", action="store_true")
     p.add_argument("--engine", action="store_true",
                    help="also run the persistent-p2p rotation path A/B")
+    p.add_argument("--step", choices=("capture", "eager"), default=None,
+                   help="A/B the whole-step persistent schedule (ISSUE "
+                        "12) over the ENGINE K/V rotation: 'eager' pays "
+                        "per-hop startall/waitall; 'capture' replays the "
+                        "captured double-buffer period (two hops) as a "
+                        "PersistentStep — emits a second CSV block with "
+                        "hops/s and launches per hop")
     p.add_argument("--iters", type=int, default=20)
     args = p.parse_args()
     setup_platform(args)
@@ -104,9 +111,59 @@ def main() -> int:
                          round(flops / et / 1e12, 3)))
         emit_csv(("S", "ranks", "heads", "dim", "block_k", "causal",
                   "path", "ms_per_step", "steps_per_s", "tflops"), rows)
+        if args.step:
+            emit_csv(("rot_path", "ranks", "kv_bytes", "hops",
+                      "hops_per_s", "launches_per_hop"),
+                     [_rotation_ab(comm, s_local, H, D, args.step,
+                                   20 if args.quick else 100)])
     finally:
         api.finalize()
     return 0
+
+
+def _rotation_ab(comm, lq: int, H: int, D: int, mode: str,
+                 pairs: int) -> tuple:
+    """One arm of the whole-step A/B (ISSUE 12) over the engine K/V
+    rotation. ``eager`` pays startall/waitall_persistent per hop;
+    ``capture`` replays the captured double-buffer period (two hops per
+    replay) with zero per-hop planning. Launches per hop come from the
+    device counter delta — the per-step pack-launch evidence."""
+    import time as _time
+
+    import numpy as np
+
+    from tempi_tpu.models import ring_attention as ra
+    from tempi_tpu.utils import counters as ctr
+
+    eng = ra.RingAttention(comm, lq, H, D)
+    rng = np.random.default_rng(7)
+    for r in range(comm.size):
+        eng.kv.set_rank(r, rng.integers(0, 256, eng.kv.nbytes, np.uint8))
+    if mode == "capture":
+        step = eng.capture_rotation_step()  # also warms the replay
+        step.start()
+        step.wait()
+
+        def one_pair():
+            step.start()
+            step.wait()
+    else:
+        eng.rotate()
+        eng.rotate()  # warm: build + compile both direction batches
+
+        def one_pair():
+            eng.rotate()
+            eng.rotate()
+
+    c0 = ctr.counters.device.num_launches
+    t0 = _time.perf_counter()
+    for _ in range(pairs):
+        one_pair()
+    dt = _time.perf_counter() - t0
+    hops = 2 * pairs
+    launches = (ctr.counters.device.num_launches - c0) / hops
+    return (f"rot-{mode}", comm.size, eng.kv.nbytes, hops,
+            round(hops / dt, 2), round(launches, 2))
 
 
 if __name__ == "__main__":

@@ -73,6 +73,16 @@ def trace_report(path: str) -> int:
               f"{_fmt_t(r['p50_us'] / 1e6):>10} "
               f"{_fmt_t(r['max_us'] / 1e6):>10} "
               f"{_fmt_t(r['total_us'] / 1e6):>10}")
+    # whole-step replay summary (ISSUE 12): the step.replay rows above
+    # split fused replays from eager fallbacks via the strategy column;
+    # this footer adds the ratio — a step mostly falling back to eager
+    # is not delivering its replay win
+    steps = [r for r in rows if r["name"] == "step.replay"]
+    if steps:
+        fused = sum(r["count"] for r in steps if r["strategy"] == "fused")
+        eager = sum(r["count"] for r in steps if r["strategy"] == "eager")
+        print(f"persistent steps: {fused + eager} replay(s) — "
+              f"{fused} fused, {eager} eager-fallback")
     print(f"(+ {instants} instant events; open the file in "
           "https://ui.perfetto.dev for the timeline)")
     return 0

@@ -10,6 +10,7 @@ counters, discover topology, pre-commit named types, load the perf cache.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import jax
@@ -513,6 +514,36 @@ def neighbor_alltoallv_init(*args, **kwargs):
     adjacency (matrix-expressible graphs only)."""
     from .coll.persistent import neighbor_alltoallv_init as _init
     return _init(*args, **kwargs)
+
+
+@contextmanager
+def capture_step(comm: Communicator):
+    """Record one iteration's exchanges on ``comm`` and compile them into
+    a replayable :class:`~tempi_tpu.coll.step.PersistentStep` (ISSUE 12;
+    see coll/step.py and the README "Persistent steps" section)::
+
+        with api.capture_step(comm) as rec:
+            run_one_iteration()          # executes normally, recorded
+        step = rec.compile()
+        for _ in range(iters):
+            step.start(); step.wait()    # zero per-step planning
+
+    The captured iteration runs EAGERLY and unchanged — capture observes
+    the engine's posts, persistent batches, and persistent collectives;
+    it never re-routes them. Exchanges that bypass the engine entirely
+    (halo3d's fused one-dispatch program, the fused ring-attention
+    program) are already a single compiled launch and are invisible to
+    capture — capture the engine paths, which are where per-step
+    planning cost lives. Captures are per-communicator and do not nest.
+    ``TEMPI_STEP=off`` keeps this context valid but degrades the
+    compiled step's ``start()`` to eager re-issue (the loud escape
+    hatch)."""
+    from .coll import step as stepmod
+    rec = stepmod.begin_capture(comm)
+    try:
+        yield rec
+    finally:
+        stepmod.end_capture(comm, rec)
 
 
 def neighbor_alltoallv(*args, **kwargs):

@@ -60,7 +60,7 @@ from ..measure import system as msys
 from ..obs import trace as obstrace
 from ..ops import dtypes
 from ..ops.dtypes import Datatype
-from ..runtime import faults, health, liveness
+from ..runtime import faults, health, invalidation, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..utils import counters as ctr
@@ -609,6 +609,18 @@ class PersistentColl:
         # applied rank re-placement (parallel/replacement.py) bumps the
         # communicator's epoch and start() recompiles before replaying
         self._mapping_epoch = comm.mapping_epoch
+        # shared plan-invalidation stamp (runtime/invalidation.py):
+        # start() re-validates the trigger-specific checks below ONLY
+        # when the global generation moved — one int compare per replay
+        # instead of four per-subsystem consults. Stamped BEFORE the
+        # compile reads any trigger state, so a trigger firing
+        # mid-compile is caught by the next start's compare.
+        self._inval_token = invalidation.current()
+        # AFTER the stamp: a handle built on a communicator that already
+        # carries a death verdict must refuse HERE — the verdict's bump
+        # predates the stamp, so start()'s compare alone would never
+        # re-walk the liveness check for it
+        self._check_alive()
         self._compile()
 
     # -- compile / recompile --------------------------------------------------
@@ -700,8 +712,8 @@ class PersistentColl:
         if recompile:
             ctr.counters.coll.num_recompiles += 1
             log.info(f"persistent collective recompiled onto "
-                     f"{self.method!r} (breaker opened on a scheduled "
-                     "link)")
+                     f"{self.method!r} (plan invalidated: breaker/tune "
+                     "state changed on a scheduled link)")
 
     def _build_lowering(self, method: str):
         addressable = all(
@@ -767,6 +779,51 @@ class PersistentColl:
         log.info(f"persistent collective recompiled onto {self.method!r} "
                  f"(rank re-placement epoch {comm.mapping_epoch})")
 
+    def _check_alive(self) -> None:
+        """ULFM semantics (ISSUE 9): a collective over a communicator
+        with dead members can never complete — refuse with the verdict
+        instead of wedging a round. The recovery path is
+        api.shrink(comm) + a fresh alltoallv_init on the survivor
+        communicator, whose schedule compiles over the survivor set.
+        Called at construction AND from _revalidate — raising before the
+        token re-stamps, so every later start refuses too."""
+        if liveness.ENABLED and self.comm.dead_ranks:
+            raise liveness.RankFailure(
+                self.comm.dead_ranks,
+                detail="persistent collective on a communicator with "
+                       "failed ranks; api.shrink(comm) and rebuild the "
+                       "handle on the survivor communicator")
+
+    def _revalidate(self, token: int) -> None:
+        """The shared invalidation generation (runtime/invalidation.py)
+        moved since this handle's last (re)compile: re-walk every
+        trigger-specific check. The FT check raises BEFORE the token is
+        re-stamped, so a communicator with dead members refuses every
+        start with the verdict — never a one-time refusal that later
+        replays into a dead peer."""
+        self._check_alive()
+        if self._mapping_epoch != self.comm.mapping_epoch:
+            # an applied re-placement invalidated everything mapping-
+            # derived; refresh BEFORE the health check so the breaker
+            # scan below consults the new link set
+            self._refresh_mapping()
+        if self._needs_recompile() or self._tune_may_rerank():
+            # _compile re-chooses against the live breaker/tune state and
+            # keeps the compiled lowering when the choice is unchanged —
+            # a drift verdict that does not move the winner costs one
+            # re-choice, never a rebuild
+            self._compile(recompile=True)
+        self._inval_token = token
+
+    def _tune_may_rerank(self) -> bool:
+        """True when a drift-proven tune overlay could re-rank this
+        handle's model-driven choice (the tune-drift trigger). Forced
+        methods — env knobs or TEMPI_COLL_HIER=hier — are never
+        overridden, mirroring the breaker path's contract."""
+        if not tune_online.ADAPTING or self._forced is not None:
+            return False
+        return not (self.method == "hier" and self._hier_mode == "hier")
+
     def _needs_recompile(self) -> bool:
         """True when the compiled plan's transport has been quarantined on
         one of the schedule's links — replaying it would ride exactly the
@@ -788,29 +845,33 @@ class PersistentColl:
         write disjoint regions). On failure the handle returns to the
         inactive, restartable state; delivered rounds stay applied and a
         restart re-delivers identical bytes."""
+        rec = self.comm._step_recorder
+        if rec is not None and rec.recording:
+            # step capture (coll/step.py): the collective replays AS
+            # ITSELF at this position in the compiled step; its internal
+            # p2p batches run with the hooks masked, and the entry is
+            # recorded only AFTER the start succeeded (a failed start
+            # the application retries must record once, not per attempt)
+            with rec.suspended():
+                self._start_impl()
+            rec.note_coll(self)
+            return
+        self._start_impl()
+
+    def _start_impl(self) -> None:
         if self._freed:
             raise RuntimeError("start() on a freed persistent collective")
         if self._active:
             raise RuntimeError("start() on an already-active persistent "
                                "collective (MPI: operation error)")
-        if liveness.ENABLED and self.comm.dead_ranks:
-            # ULFM semantics (ISSUE 9): a collective over a communicator
-            # with dead members can never complete — refuse with the
-            # verdict instead of wedging a round. The recovery path is
-            # api.shrink(comm) + a fresh alltoallv_init on the survivor
-            # communicator, whose schedule compiles over the survivor set
-            raise liveness.RankFailure(
-                self.comm.dead_ranks,
-                detail="persistent collective start() on a communicator "
-                       "with failed ranks; api.shrink(comm) and rebuild "
-                       "the handle on the survivor communicator")
-        if self._mapping_epoch != self.comm.mapping_epoch:
-            # an applied re-placement invalidated everything mapping-
-            # derived; refresh BEFORE the health check so the breaker
-            # scan below consults the new link set
-            self._refresh_mapping()
-        if self._needs_recompile():
-            self._compile(recompile=True)
+        tok = invalidation.current()
+        if tok != self._inval_token:
+            # ONE trigger consult for all four recompile causes (breaker
+            # open, tune drift, mapping epoch, FT verdict): the shared
+            # generation moved, so re-walk the trigger-specific checks.
+            # When nothing anywhere changed, a replay pays exactly this
+            # int compare — no per-subsystem flags on the hot path.
+            self._revalidate(tok)
         if self._started:
             ctr.counters.coll.num_replays += 1
             if isinstance(self._lowering, _HierLowering):
@@ -862,6 +923,15 @@ class PersistentColl:
     def wait(self) -> None:
         """Complete the active instance (MPI_Wait analog); the handle
         becomes startable again."""
+        rec = self.comm._step_recorder
+        if rec is not None and rec.recording:
+            with rec.suspended():
+                self._wait_impl()
+            rec.note_barrier()  # noted AFTER completion (see p2p.wait)
+            return
+        self._wait_impl()
+
+    def _wait_impl(self) -> None:
         if self._freed:
             raise RuntimeError("wait() on a freed persistent collective")
         if not self._active:

@@ -1,0 +1,548 @@
+"""Whole-step persistent schedules: capture one iteration, replay forever.
+
+TEMPI's core bet is that communication plans worth computing are worth
+caching — measure once, replay model-driven decisions forever. The repo
+proved the compile-once/run-many half at single-collective granularity
+(coll/persistent.py; p2p's ``_PersistentBatch``), but a training step is a
+*sequence* of exchanges — halo3d's per-face sends, ring_attention's
+per-hop K/V rotations — and each step still re-enters plan lookup,
+strategy choice, and a separate pack launch per posted batch.
+
+This module extends the persistent economics to the whole step::
+
+    with api.capture_step(comm) as rec:
+        model.exchange(buf)          # one normal iteration, run eagerly
+    step = rec.compile()             # -> PersistentStep
+    for _ in range(iters):
+        step.start(); step.wait()    # zero per-step planning
+
+Capture records the iteration's exchanges (order, buffers, counts,
+pinned strategies) while they execute normally through the engine;
+``compile()`` lowers the recording into a fixed dispatch program:
+
+  * adjacent exchange calls issued with no completion barrier between
+    them — e.g. six per-face ``startall`` batches before one
+    ``waitall`` — were concurrently in flight by the application's own
+    program order, so they COALESCE into one merged
+    :class:`~..parallel.plan.ExchangePlan`: every message a rank sends
+    in a round is packed by ONE batched multi-descriptor launch (the
+    plan's per-rank pack branches — the ``pack_batch_k`` batching the
+    pack benches size) whose output feeds the transport directly
+    (device: the fused pack->ppermute->unpack program; staged/oneshot:
+    one payload committed straight to the host staging / pinned-host
+    buffer), instead of one pack launch and one payload per posted
+    batch. ``TEMPI_STEP_FUSE=off`` disables only this coalescing.
+  * persistent collectives (``PersistentColl``) replay as themselves at
+    their recorded position — their own compiled machinery already
+    carries the single-collective replay win.
+  * completion barriers between segments are DROPPED from the replay
+    hot path: plans rebind the same buffers, so execution order is
+    enforced by data dependency on device, and the step pays ONE
+    completion drain (in ``wait()``) instead of one per batch.
+
+Replay honors the shared plan-invalidation contract
+(runtime/invalidation.py): ``start()`` compares one generation integer,
+and only when a trigger fired anywhere — breaker open, tune drift,
+mapping epoch, FT verdict — does it re-walk the liveness check and
+rebuild the program against the live mapping/breaker/tune state.
+
+Degradation ladder (all loud, README "Persistent steps" table):
+``TEMPI_STEP=off`` (or ``TEMPI_DISABLE``) keeps captures recording but
+``start()`` re-issues everything through the eager engine — application
+code unchanged, per-step cost identical to the uncaptured path. A
+replay that finds eager operations pending on the communicator takes
+the same eager path for THAT step (MPI non-overtaking order must hold
+across the interleaving), counted in ``step.num_eager_fallbacks``.
+Every replay is a ``step.replay`` fault site and obs span; the
+``step.*`` counter group stays zero when capture is unused (the
+byte-for-byte contract).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs import trace as obstrace
+from ..runtime import faults, invalidation, liveness
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import logging as log
+from ..parallel import p2p
+from ..parallel import plan as planmod
+from ..parallel.communicator import Communicator, DistBuffer
+
+
+# -- capture ------------------------------------------------------------------
+
+
+class StepRecorder:
+    """Records one iteration's exchanges on one communicator. Armed onto
+    ``comm._step_recorder`` by :func:`api.capture_step`; the p2p layer and
+    ``PersistentColl`` call the ``note_*`` hooks (suspending around their
+    own internal traffic so framework-issued posts are never recorded
+    twice)."""
+
+    def __init__(self, comm: Communicator):
+        self.comm = comm
+        self.entries: List[tuple] = []
+        self.armed = True
+        self._suspend = 0
+        self._compiled = False
+
+    # -- hook surface (called from p2p / coll.persistent) ---------------------
+
+    @property
+    def recording(self) -> bool:
+        return self.armed and self._suspend == 0
+
+    class _Suspended:
+        def __init__(self, rec):
+            self.rec = rec
+
+        def __enter__(self):
+            self.rec._suspend += 1
+            return self
+
+        def __exit__(self, *exc):
+            self.rec._suspend -= 1
+            return False
+
+    def suspended(self) -> "_Suspended":
+        """Context manager masking the hooks: internal traffic a recorded
+        call issues (a startall's posts, a collective's rounds, a retry's
+        repost) must not be recorded on top of the call itself."""
+        return self._Suspended(self)
+
+    def note_post(self, kind: str, app_rank: int, buf: DistBuffer,
+                  peer: int, datatype, count: int, tag: int,
+                  offset: int) -> None:
+        """One eager isend/irecv, recorded by envelope in APPLICATION
+        ranks (a later mapping-epoch rebuild re-translates against the
+        live permutation)."""
+        self.entries.append(("call", [(kind, app_rank, buf, peer, datatype,
+                                       count, tag, offset, False)], None))
+        ctr.counters.step.num_captured_calls += 1
+
+    def note_batch(self, preqs: Sequence, strategy: Optional[str]) -> None:
+        """One startall batch, recorded as a single call carrying its
+        pinned strategy (None = model-driven at compile time)."""
+        envs = [(p.kind, p.app_rank, p.buf, p.peer, p.datatype, p.count,
+                 p.tag, p.offset, p.internal) for p in preqs]
+        self.entries.append(("call", envs, strategy))
+        ctr.counters.step.num_captured_calls += 1
+
+    def note_coll(self, pcoll) -> None:
+        self.entries.append(("coll", pcoll))
+        ctr.counters.step.num_captured_calls += 1
+
+    def note_barrier(self) -> None:
+        if self.entries and self.entries[-1] == ("barrier",):
+            return  # consecutive waits collapse; only call edges matter
+        self.entries.append(("barrier",))
+
+    # -- compile ---------------------------------------------------------------
+
+    def compile(self) -> "PersistentStep":
+        """Lower the recording into a :class:`PersistentStep`. Refused on
+        an empty capture (a step that replays nothing is a bug at the
+        capture site, not a valid fast path) and while the capture is
+        still active (the recording is not yet complete)."""
+        if self.armed:
+            raise RuntimeError(
+                "StepRecorder.compile() inside the capture_step context — "
+                "compile after the captured iteration finishes")
+        if self._compiled:
+            raise RuntimeError("StepRecorder.compile() called twice — the "
+                               "recorder is single-shot; re-capture to "
+                               "build another step")
+        if not any(e[0] in ("call", "coll") for e in self.entries):
+            raise ValueError(
+                "capture_step recorded no exchanges on comm uid "
+                f"{self.comm.uid}: nothing to compile (did the iteration "
+                "run on a different communicator?)")
+        step = PersistentStep(self.comm, list(self.entries))
+        # only a SUCCESSFUL lowering consumes the recorder: a failed
+        # compile (conflicting pins, unmatched capture, dead-rank comm)
+        # must leave it retryable after the caller fixes the cause,
+        # re-raising its real diagnostic — not "compile() called twice"
+        self._compiled = True
+        return step
+
+
+def begin_capture(comm: Communicator) -> StepRecorder:
+    if comm._step_recorder is not None:
+        raise RuntimeError(
+            f"capture_step: a capture is already active on comm uid "
+            f"{comm.uid} (captures do not nest)")
+    rec = StepRecorder(comm)
+    comm._step_recorder = rec
+    return rec
+
+
+def end_capture(comm: Communicator, rec: StepRecorder) -> None:
+    comm._step_recorder = None
+    rec.armed = False
+    ctr.counters.step.num_captures += 1
+
+
+# -- compiled step ------------------------------------------------------------
+
+
+class PersistentStep:
+    """A compiled, replayable training-step communication schedule.
+
+    ``start()`` dispatches the whole recorded sequence (plans in program
+    order, persistent collectives at their recorded positions) with zero
+    per-step planning; ``wait()`` pays the step's ONE completion drain
+    and returns the handle to the startable state; ``test()`` is the
+    nonblocking completion query; ``free()`` releases the compiled state
+    (refused while active).
+
+    Failure contract (mirrors ``PersistentColl.start``): a raise before
+    or during dispatch leaves the handle inactive and restartable;
+    already-dispatched plans stay applied, and a restart over unchanged
+    input buffers re-delivers identical bytes."""
+
+    def __init__(self, comm: Communicator, entries: List[tuple]):
+        self.comm = comm
+        self._entries = entries
+        self._active = False
+        self._started = False
+        self._freed = False
+        # stamped BEFORE the build reads any trigger state (the same
+        # conservative ordering as PersistentColl): a trigger firing
+        # mid-build is caught by the next start's compare
+        self._inval_token = invalidation.current()
+        # AFTER the stamp: a step compiled on a communicator that
+        # already carries a death verdict must refuse HERE — the
+        # verdict's bump predates the stamp, so start()'s compare alone
+        # would never re-walk the liveness check for it
+        self._check_alive()
+        self._build()
+
+    # -- build / rebuild -------------------------------------------------------
+
+    def _build(self) -> None:
+        """Lower the recorded entries into the dispatch program: a list
+        of ``("plans", [(plan, strategy, binding)...], calls)`` items
+        (fused exchange segments) and ``("coll", pcoll)`` items, in
+        dispatch order. Recorded barriers bound the fusion segments
+        during lowering and are then dropped — the replay orders plans
+        by data dependency and drains once, in wait(), and the eager
+        fallback completes everything with one final waitall.
+
+        Matching spans the WHOLE capture — a pre-posted receive pairs
+        with a send issued segments later, exactly as the eager engine
+        would have paired them; barriers bound only fusion and dispatch
+        ordering. A matched pair is dispatched at the position of the
+        call that COMPLETED it (the later of its two posts) — the
+        engine's own dispatch-at-match-time semantics, so a late send's
+        exchange never runs before the program point where the captured
+        iteration made it possible."""
+        comm = self.comm
+        fuse = envmod.env.step_fuse
+        self._eager_only = envmod.env.step_mode == "off"
+        # 1. linearize: global call list + the program skeleton (which
+        # calls land in which barrier-delimited segment, colls, drains)
+        calls: List[tuple] = []      # [(envs, pin)] in recorded order
+        skeleton: List[tuple] = []   # ("seg", [ci...]) | ("coll", x) | ("drain",)
+        seg: List[int] = []
+        for e in self._entries:
+            if e[0] == "call":
+                seg.append(len(calls))
+                calls.append((e[1], e[2]))
+            elif e[0] == "coll":
+                if seg:
+                    skeleton.append(("seg", seg))
+                    seg = []
+                skeleton.append(("coll", e[1]))
+            else:  # barrier: closes the current fusion segment
+                if seg:
+                    skeleton.append(("seg", seg))
+                    seg = []
+        if seg:
+            skeleton.append(("seg", seg))
+        # 2. one capture-wide match (validates self-containment even when
+        # TEMPI_STEP=off, without compiling undispatchable plans)
+        messages, pair_call, msg_pin = self._match_capture(calls)
+        by_call: Dict[int, List[int]] = {}
+        for k, ci in enumerate(pair_call):
+            by_call.setdefault(ci, []).append(k)
+        # 3. lower each segment against its completed pairs
+        program: List[tuple] = []
+        for item in skeleton:
+            if item[0] != "seg":
+                program.append(item)
+                continue
+            cset = item[1]
+            midx = [k for ci in cset for k in by_call.get(ci, ())]
+            if fuse or len(cset) == 1:
+                if len(cset) > 1:
+                    ctr.counters.step.num_fused_calls += len(cset) - 1
+                plans = ([] if self._eager_only or not midx
+                         else self._plans_for(
+                             [messages[k] for k in midx],
+                             [msg_pin[k] for k in midx]))
+                program.append(("plans", plans,
+                                [calls[ci] for ci in cset]))
+            else:
+                # TEMPI_STEP_FUSE=off: one plan-set per recorded call
+                for ci in cset:
+                    ks = by_call.get(ci, [])
+                    plans = ([] if self._eager_only or not ks
+                             else self._plans_for(
+                                 [messages[k] for k in ks],
+                                 [msg_pin[k] for k in ks]))
+                    program.append(("plans", plans, [calls[ci]]))
+        self._program = program
+        self._mapping_epoch = comm.mapping_epoch
+        # distinct buffers the step touches — the wait() drain set
+        bufs: List[DistBuffer] = []
+        for e in self._entries:
+            if e[0] == "call":
+                for env in e[1]:
+                    b = env[2]
+                    if all(b is not x for x in bufs):
+                        bufs.append(b)
+            elif e[0] == "coll":
+                for b in (e[1].sendbuf, e[1].recvbuf):
+                    if all(b is not x for x in bufs):
+                        bufs.append(b)
+        self._bufs = bufs
+        ctr.counters.step.num_compiles += 1
+        if obstrace.ENABLED:
+            nplans = sum(len(i[1]) for i in program if i[0] == "plans")
+            obstrace.emit(
+                "step.compile", comm=comm.uid,
+                items=len(program), plans=nplans,
+                colls=sum(1 for i in program if i[0] == "coll"),
+                eager_only=self._eager_only, fused=fuse)
+
+    def _match_capture(self, calls: List[tuple]
+                       ) -> Tuple[list, List[int], List[Optional[str]]]:
+        """Match the WHOLE capture's envelopes in recorded order. Ranks
+        translate through the LIVE app->library mapping (a mapping-epoch
+        rebuild re-runs this). Returns ``(messages, pair_call,
+        msg_pin)``: ``pair_call[k]`` is the global index of the call
+        that COMPLETED pair k (the later of its two posts — where the
+        eager engine would have dispatched it), and ``msg_pin[k]`` its
+        pinned strategy (the completing side's pin wins; two sides
+        pinning conflicting strategies is refused). Raises when any
+        recorded operation never pairs inside the capture."""
+        comm = self.comm
+        ops, call_of = [], []
+        for ci, (envs, _pin) in enumerate(calls):
+            for kind, app_rank, buf, peer, datatype, count, tag, offset, \
+                    _int in envs:
+                packer, _rec = p2p._packer_for(datatype)
+                req = p2p.Request(0, comm)
+                ops.append(p2p.Op(
+                    kind=kind, rank=comm.library_rank(app_rank),
+                    peer=(p2p.ANY_SOURCE if peer == p2p.ANY_SOURCE
+                          else comm.library_rank(peer)),
+                    tag=tag, buf=buf, offset=offset, packer=packer,
+                    count=count, nbytes=count * datatype.size,
+                    request=req))
+                call_of.append(ci)
+        messages, consumed, leftover = p2p._match(ops)
+        if leftover:
+            stuck = "; ".join(
+                f"{op.kind} rank {op.rank}<->peer {op.peer} tag {op.tag} "
+                f"({op.nbytes}B)" for op in leftover[:8])
+            raise ValueError(
+                f"capture_step: {len(leftover)} recorded operation(s) "
+                f"never matched inside the capture — the step is not "
+                f"self-contained and cannot replay: [{stuck}]")
+        idx_of = {id(op): ci for op, ci in zip(ops, call_of)}
+        pair_call: List[int] = []
+        msg_pin: List[Optional[str]] = []
+        # consumed[2k], consumed[2k+1] are message k's send and recv ops
+        # (p2p._match appends the send before its matched recv)
+        for k in range(len(messages)):
+            cs = idx_of[id(consumed[2 * k])]
+            cr = idx_of[id(consumed[2 * k + 1])]
+            pair_call.append(max(cs, cr))
+            pins = {calls[c][1] for c in (cs, cr)
+                    if calls[c][1] is not None}
+            if len(pins) > 1:
+                m = messages[k]
+                raise ValueError(
+                    f"capture_step: the send and recv of pair "
+                    f"{m.src}->{m.dst} tag {m.tag} pin conflicting "
+                    f"strategies {sorted(pins)} — pin one side only")
+            msg_pin.append(next(iter(pins)) if pins else None)
+        return messages, pair_call, msg_pin
+
+    def _plans_for(self, messages: list, pins: List[Optional[str]]
+                   ) -> List[tuple]:
+        """Compile one exchange plan per strategy over ``messages``:
+        ``[(plan, strategy, binding), ...]``. Pinned messages keep their
+        pin; model-driven ones are chosen against the live breaker/tune
+        state (a breaker/tune rebuild re-runs this). Differently-pinned
+        messages in one fused segment simply land in different strategy
+        groups — one plan each, no pin ever silently dropped."""
+        comm = self.comm
+        groups: Dict[str, List] = {}
+        for m, pin in zip(messages, pins):
+            strat = pin or p2p.choose_strategy_message(comm, m)
+            groups.setdefault(strat, []).append(m)
+        items = []
+        with comm._progress_lock:
+            for strat, msgs in groups.items():
+                plan = planmod.get_plan(comm, msgs)
+                items.append((plan, strat,
+                              (plan.bufs, plan.messages, plan.rounds)))
+        return items
+
+    def _check_alive(self) -> None:
+        """A step over a communicator with dead members can never
+        complete — refuse with the verdict (called at construction AND
+        from _revalidate, raising before the token re-stamps so every
+        later start refuses too)."""
+        if liveness.ENABLED and self.comm.dead_ranks:
+            raise liveness.RankFailure(
+                self.comm.dead_ranks,
+                detail="PersistentStep on a communicator with failed "
+                       "ranks; api.shrink(comm), re-capture, and "
+                       "recompile the step on the survivor communicator")
+
+    def _revalidate(self, token: int) -> None:
+        """The shared invalidation generation moved since this step's
+        last (re)build: re-walk the liveness check (raising BEFORE the
+        token is re-stamped, so a dead-rank comm refuses every start)
+        and rebuild the program against the live mapping / breaker /
+        tune state. Rebuild cost is bounded by the plan cache: unchanged
+        signatures are cache hits, so an irrelevant trigger costs a
+        Python re-lowering, never an XLA recompile."""
+        self._check_alive()
+        self._build()
+        ctr.counters.step.num_recompiles += 1
+        log.info(f"persistent step rebuilt (plan invalidated: "
+                 f"generation {token}; mapping epoch "
+                 f"{self.comm.mapping_epoch})")
+        self._inval_token = token
+
+    # -- MPI persistent-request surface ---------------------------------------
+
+    def start(self) -> None:
+        """Dispatch the compiled step. One ``step.replay`` fault site
+        fires BEFORE anything dispatches (a raise leaves every buffer as
+        the previous step left it); the whole replay is one
+        ``step.replay`` obs span."""
+        if self._freed:
+            raise RuntimeError("start() on a freed persistent step")
+        if self._active:
+            raise RuntimeError("start() on an already-active persistent "
+                               "step (wait() it first)")
+        tok = invalidation.current()
+        if tok != self._inval_token:
+            self._revalidate(tok)
+        if faults.ENABLED:
+            faults.check("step.replay")
+        comm = self.comm
+        t0 = time.monotonic() if obstrace.ENABLED else 0.0
+        with comm._progress_lock:
+            if comm.freed:
+                raise RuntimeError("communicator has been freed")
+            eager = self._eager_only or bool(comm._pending)
+            if eager:
+                # pending eager traffic could FIFO-match into the step's
+                # exchanges: replaying the compiled pairing would overtake
+                # it — re-issue through the engine (MPI ordering holds)
+                ctr.counters.step.num_eager_fallbacks += 1
+                self._start_eager()
+            else:
+                if self._started:
+                    ctr.counters.step.num_replays += 1
+                dispatched = 0
+                for item in self._program:
+                    if item[0] == "plans":
+                        for plan, strat, binding in item[1]:
+                            plan.bufs, plan.messages, plan.rounds = binding
+                            plan.run(strat)
+                            dispatched += 1
+                    elif item[0] == "coll":
+                        pcoll = item[1]
+                        pcoll.start()
+                        pcoll.wait()
+                ctr.counters.step.num_plan_dispatches += dispatched
+        if obstrace.ENABLED:
+            # ``strategy`` carries the replay mode so the trace report's
+            # generic (span, strategy) grouping splits fused replays from
+            # eager fallbacks without special-casing the span name
+            obstrace.emit_span(
+                "step.replay", t0, comm=comm.uid,
+                strategy="eager" if eager else "fused",
+                replays=ctr.counters.step.num_replays)
+        self._started = True
+        self._active = True
+
+    def _start_eager(self) -> None:
+        """Re-issue the recorded step through the normal engine (caller
+        holds the progress lock — an RLock, so the posts and progress
+        drives below re-enter it). Posts run per call in recorded order
+        — FIFO matching reproduces the captured pairing, including pairs
+        whose two sides straddled a recorded barrier (a pre-posted
+        receive) — and ONE waitall completes everything posted; wait()
+        then finds it all done and only drains. The captured barriers
+        bounded what the ITERATION could observe mid-step; during
+        replay nothing observes the step before wait(), so they are not
+        re-waited (the compiled program does not even carry them)."""
+        comm = self.comm
+        posted: List = []
+        for item in self._program:
+            if item[0] == "plans":
+                for envs, pin in item[2]:
+                    for kind, app_rank, buf, peer, datatype, count, tag, \
+                            offset, internal in envs:
+                        posted.append(p2p._post(comm, kind, app_rank, buf,
+                                                peer, datatype, count, tag,
+                                                offset, internal=internal))
+                    if pin is not None:
+                        # a pinned batch dispatches under its pin the
+                        # moment it matches, like the startall it records
+                        p2p.try_progress(comm, pin)
+            elif item[0] == "coll":
+                item[1].start()
+                item[1].wait()
+        if posted:
+            p2p.waitall(posted)
+
+    def wait(self) -> None:
+        """Complete the active step: ONE completion drain over the
+        distinct buffers the whole step touched (the per-batch drains the
+        eager path pays are exactly what the compiled step elides)."""
+        if self._freed:
+            raise RuntimeError("wait() on a freed persistent step")
+        if not self._active:
+            raise RuntimeError("wait() on an inactive persistent step")
+        try:
+            p2p._sync_bufs(self._bufs, deadline=p2p._deadline())
+        finally:
+            self._active = False
+
+    def test(self) -> bool:
+        """Nonblocking completion query: True completes the step (the
+        handle becomes startable again); False leaves it active."""
+        if self._freed:
+            raise RuntimeError("test() on a freed persistent step")
+        if not self._active:
+            raise RuntimeError("test() on an inactive persistent step")
+        if not all(p2p._buf_ready(b) for b in self._bufs):
+            return False
+        self.wait()
+        return True
+
+    def free(self) -> None:
+        """Release the compiled state (refused while active). The
+        underlying exchange plans live in the communicator's plan cache
+        and stay valid for other holders; only this step's program and
+        binding snapshots are dropped."""
+        if self._active:
+            raise RuntimeError("free() on an active persistent step "
+                               "(wait() it first)")
+        self._program = []
+        self._entries = []
+        self._bufs = []
+        self._freed = True

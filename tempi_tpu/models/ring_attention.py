@@ -280,6 +280,32 @@ class RingAttention:
         p2p.waitall_persistent(batch)
         self._cur ^= 1
 
+    def capture_rotation_step(self):
+        """Capture the double-buffer PERIOD — two ring hops, kv ->
+        kv_next -> kv — as a :class:`~tempi_tpu.coll.step.PersistentStep`
+        (ISSUE 12). One replayed step advances the payload exactly two
+        hops with zero per-hop planning; N/2 replays complete an N-rank
+        ring rotation. Two hops, not one, because the rotation
+        alternates buffer bindings (`_batches`) and a compiled step
+        replays fixed bindings — capturing a single hop would replay
+        kv -> kv_next forever. Requires the payload to currently sit in
+        ``kv`` (``_cur == 0``), which the capture restores on exit; the
+        hops are barrier-separated in the capture (each hop waits), so
+        the compiled step preserves their order and never fuses them."""
+        if self._cur != 0:
+            raise RuntimeError(
+                "capture_rotation_step: payload must sit in the primary "
+                "buffer (rotate an odd number of times first)")
+        from ..coll import step as stepmod
+
+        rec = stepmod.begin_capture(self.comm)
+        try:
+            self.rotate()
+            self.rotate()
+        finally:
+            stepmod.end_capture(self.comm, rec)
+        return rec.compile()
+
     def run(self, q_rows, k_rows, v_rows):
         """Full engine-path ring attention from per-rank numpy blocks
         (lists of [lq,H,D]); returns per-rank outputs. One exchange

@@ -59,6 +59,11 @@ EVENTS = (
     "pump.quarantine_lifted",  # an abandoned thread exited; comm restored
     "qos.backpressure",  # a class lane refused a wakeup; caller drove
     "qos.quarantine",    # a wedge verdict attributed to a class lane
+    # runtime/invalidation.py — shared plan-invalidation contract
+    "invalidation.bump",  # a recompile trigger fired (generation, cause)
+    # coll/step.py — whole-step persistent schedules (ISSUE 12)
+    "step.compile",      # a captured step compiled (segments, plans, msgs)
+    "step.replay",       # one PersistentStep start() (span; plans, msgs)
     # runtime/events.py — leak-site tracker
     "events.leak",       # an unfreed buffer's allocation site at finalize
 )

@@ -123,6 +123,10 @@ class Communicator:
         # PersistentColl.start) never see a half-updated set; empty — and
         # inert — with TEMPI_FT unset
         self.dead_ranks: frozenset = frozenset()
+        # armed by api.capture_step (coll/step.py): the active step
+        # recorder, or None. Hot paths pay one attribute load + None
+        # test when no capture is running (the byte-for-byte contract)
+        self._step_recorder = None
         _all_comms.add(self)
 
     # -- rank translation (reference: src/comm_rank.cpp, topology.cpp) -------

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
 from ..obs import trace as obstrace
-from ..runtime import faults, health, liveness
+from ..runtime import faults, health, invalidation, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..ops import type_cache
@@ -246,6 +246,15 @@ def _post(comm: Communicator, kind: str, app_rank: int, buf: DistBuffer,
         # a plannable type forced onto the typemap fallback (TEMPI_NO_PACK
         # or backend gate) — the reference counts SendRecvFallback sends
         group.num_fallback += 1
+    srec = comm._step_recorder
+    if srec is not None and not internal and srec.recording:
+        # step capture (coll/step.py): record the APPLICATION-rank
+        # envelope (a mapping-epoch rebuild re-translates) AFTER the
+        # post succeeded — a refused post (bad rank/tag, liveness) must
+        # not be baked into the compiled step. Capture observes, never
+        # re-routes.
+        srec.note_post(kind, app_rank, buf, peer_app, datatype, count,
+                       tag, offset)
     return req
 
 
@@ -326,8 +335,21 @@ def _match(pending: List[Op]):
 
 _UNMEASURED = "__unmeasured__"  # cached "no curves" verdict (not a strategy)
 
+#: Module-level decision cache for model-driven strategy picks. It was
+#: per-communicator until round 12 — and that comm identity was a SPURIOUS
+#: key component: the verdict is a pure function of the model key
+#: ({colocated, nbytes, block}) and the active sheet generation, nothing
+#: per-comm, yet every derived dist-graph communicator (each HaloExchange,
+#: every replace/shrink/churn rebuild, every bench phase) started with a
+#: cold cache and re-modeled identical exchanges forever — the
+#: ``modeling_cache_hits: 0`` against 15034 misses BENCH_TPU_LAST recorded.
+#: Mutated without a lock like the per-comm dict was: the worst concurrent
+#: outcome is a duplicated model walk or a lost insert (the verdict is a
+#: pure function, so both are benign), never a wrong answer.
+_strategy_cache: dict = {"gen": -1, "map": {}}
 
-def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str]:
+
+def _cached_model_choice(key: tuple, models) -> Optional[str]:
     """Shared decision cache for model-driven strategy picks: ``models`` is
     an ordered {strategy: thunk-returning-seconds} dict (first entry wins
     ties). Returns the cached or freshly modeled winner, or None when every
@@ -336,13 +358,17 @@ def _cached_model_choice(comm: Communicator, key: tuple, models) -> Optional[str
     every model on every send. The whole cache is dropped when the sheet
     generation changes (curves loading later via measure_all + set_system
     invalidate every earlier conclusion), so superseded entries are freed
-    rather than stranded."""
+    rather than stranded. Shared across communicators (see
+    ``_strategy_cache``): identical repeated exchanges hit even when the
+    application derives a fresh dist-graph communicator per pattern."""
     gen = msys.generation()
-    store = comm.__dict__.setdefault("_strategy_cache", {"gen": gen,
-                                                         "map": {}})
+    store = _strategy_cache
     if store["gen"] != gen:
-        store["gen"] = gen
+        # map BEFORE gen: a concurrent reader may observe the fresh empty
+        # map with the old gen (a benign re-model) but never the new gen
+        # with stale entries (a verdict computed under superseded curves)
         store["map"] = {}
+        store["gen"] = gen
     cache = store["map"]
     hit = cache.get(key)
     if hit is not None:
@@ -374,7 +400,7 @@ def _auto_choice(comm: Communicator, m: Message, key: tuple,
                                           m.nbytes, models)
         if adapted is not None:
             return adapted
-    return _cached_model_choice(comm, key, models)
+    return _cached_model_choice(key, models)
 
 
 #: Demotion preference when a chosen strategy's breaker is open: toward the
@@ -804,6 +830,21 @@ def wait(req: Request, strategy: Optional[str] = None) -> None:
     WaitTimeout naming the stuck request — after exhausting the
     TEMPI_RETRY_ATTEMPTS cancel-and-repost recovery attempts, if any are
     configured (see :func:`_with_retry`)."""
+    rec = req.comm._step_recorder
+    if rec is not None and rec.recording:
+        # step capture: a completed wait is a completion barrier in the
+        # recorded program (noted AFTER success — an aborted wait is not
+        # a barrier the step may elide drains across); the retry layer's
+        # reposts run suspended so a recovery mid-capture is not
+        # recorded as extra exchanges
+        with rec.suspended():
+            _wait_retrying(req, strategy)
+        rec.note_barrier()
+        return
+    _wait_retrying(req, strategy)
+
+
+def _wait_retrying(req: Request, strategy: Optional[str] = None) -> None:
     _with_retry(lambda absorb: _wait_attempt(req, strategy, absorb),
                 lambda e: _note_stuck(e, [req], strategy),
                 lambda: _repost([req]),
@@ -971,6 +1012,29 @@ def waitall(reqs, strategy: Optional[str] = None) -> None:
     stuck edges, not the first one. TEMPI_RETRY_ATTEMPTS adds the
     cancel-and-repost recovery attempts on top (see :func:`_with_retry`);
     each attempt gets a fresh deadline."""
+    rec = _capture_rec(reqs)
+    if rec is not None:
+        with rec.suspended():
+            _waitall_retrying(reqs, strategy)
+        rec.note_barrier()  # barrier noted AFTER completion (see wait)
+        return
+    _waitall_retrying(reqs, strategy)
+
+
+def _capture_rec(reqs):
+    """The recording step recorder of ANY request's communicator, or
+    None. A waitall batch legitimately spans communicators — checking
+    only the first request would silently drop the captured comm's
+    completion barrier and let the compiled step fuse exchanges the
+    application ordered."""
+    for r in reqs:
+        rec = r.comm._step_recorder
+        if rec is not None and rec.recording:
+            return rec
+    return None
+
+
+def _waitall_retrying(reqs, strategy: Optional[str] = None) -> None:
     _with_retry(lambda absorb: _waitall_attempt(reqs, strategy, absorb),
                 lambda e: _note_stuck(e, reqs, strategy),
                 lambda: _repost([r for r in reqs
@@ -1165,7 +1229,8 @@ class PersistentRequest:
         thread — "full" is unbounded, False is a pure completion query."""
         act = self.active
         if act is None:
-            raise RuntimeError("test() on an inactive persistent request")
+            raise RuntimeError("test() on an inactive persistent "
+                               f"request: {_preq_desc(self)}")
         if not act.done and progress:
             _poll_progress(self.comm, None, progress)
         if not act.done:
@@ -1191,10 +1256,27 @@ class _PersistentBatch:
     eager exchange of the same shape would redirect it to foreign buffers.
     ``member_ids`` identifies the exact request set the cache is valid for:
     MPI_Start on a subset is legal and must move only that subset, so a
-    subset (or superset) start bypasses the replay."""
+    subset (or superset) start bypasses the replay. ``token`` stamps the
+    shared plan-invalidation generation (runtime/invalidation.py) at
+    build: a later trigger — breaker open, tune drift, mapping epoch, FT
+    verdict — moves the generation and the next start rebuilds through
+    the first-start pipeline (re-choosing strategies against the live
+    breaker/tune state, re-running the liveness post checks) instead of
+    replaying a plan the runtime has since invalidated."""
 
     plans: List  # [(ExchangePlan, strategy, (bufs, messages, rounds))]
     member_ids: frozenset  # id() of every PersistentRequest in the batch
+    token: int  # invalidation.current() when the batch was built
+
+
+def _preq_desc(p: "PersistentRequest") -> str:
+    """One-line envelope of a persistent request for error diagnostics
+    (the WaitTimeout naming style): kind, application ranks, tag, bytes,
+    and the owning communicator's uid — enough to pick the offender out
+    of a 52-request halo batch."""
+    peer = "ANY_SOURCE" if p.peer == ANY_SOURCE else p.peer
+    return (f"{p.kind} rank {p.app_rank}<->peer {peer} tag {p.tag} "
+            f"({p.count * p.datatype.size}B, comm uid {p.comm.uid})")
 
 
 def send_init(comm: Communicator, app_rank: int, buf: DistBuffer, dest: int,
@@ -1223,17 +1305,42 @@ def startall(preqs: Sequence[PersistentRequest],
     non-overtaking order holds across persistent/eager interleavings."""
     if not preqs:
         return
+    rec = preqs[0].comm._step_recorder
+    if rec is not None and rec.recording:
+        # step capture (coll/step.py): run the batch normally with the
+        # hooks masked (the posts this start issues ARE the batch), and
+        # record it only AFTER it succeeded — a failed start the
+        # application recovers from by retrying must contribute ONE
+        # recorded exchange, not one per attempt
+        with rec.suspended():
+            _startall_impl(preqs, strategy)
+        rec.note_batch(preqs, strategy)
+        return
+    _startall_impl(preqs, strategy)
+
+
+def _startall_impl(preqs: Sequence[PersistentRequest],
+                   strategy: Optional[str] = None) -> None:
     comm = preqs[0].comm
     for p in preqs:
         if p.comm is not comm:
-            raise ValueError("startall: requests span communicators")
+            # name the offender AND the batch's communicator: a 52-request
+            # halo batch with one foreign edge is undebuggable from the
+            # bare refusal (WaitTimeout-style diagnostics, ISSUE 12)
+            raise ValueError(
+                f"startall: requests span communicators — {_preq_desc(p)} "
+                f"does not belong to the batch's comm uid {comm.uid} "
+                f"(batch lead: {_preq_desc(preqs[0])})")
         if p.active is not None:
-            raise RuntimeError("start() on an already-active persistent "
-                               "request (MPI: operation error)")
+            raise RuntimeError(
+                "start() on an already-active persistent request "
+                f"(MPI: operation error): {_preq_desc(p)}")
     ids = frozenset(id(p) for p in preqs)
+    tok = invalidation.current()  # BEFORE the pipeline reads trigger state
     batch = preqs[0].batch
     if (batch is not None and all(p.batch is batch for p in preqs)
-            and ids == batch.member_ids):
+            and ids == batch.member_ids
+            and batch.token == tok):
         with comm._progress_lock:
             if comm.freed:
                 raise RuntimeError("communicator has been freed")
@@ -1326,7 +1433,7 @@ def startall(preqs: Sequence[PersistentRequest],
         for p in preqs:
             p.active = None  # inactive again; the start is retryable
         raise
-    batch = _PersistentBatch(plans=plans, member_ids=ids)
+    batch = _PersistentBatch(plans=plans, member_ids=ids, token=tok)
     for p, r in zip(preqs, reqs):
         p.active = r
         p.batch = batch
@@ -1580,6 +1687,17 @@ def waitall_persistent(preqs: Sequence[PersistentRequest],
     attempt already withdrew its instances, so the retry is simply
     startall + wait again (with backoff, failures recorded in the health
     registry, and AUTO decisions demoting once a breaker opens)."""
+    rec = _capture_rec(preqs)
+    if rec is not None:
+        with rec.suspended():
+            _waitall_persistent_retrying(preqs, strategy)
+        rec.note_barrier()  # barrier noted AFTER completion (see wait)
+        return
+    _waitall_persistent_retrying(preqs, strategy)
+
+
+def _waitall_persistent_retrying(preqs: Sequence[PersistentRequest],
+                                 strategy: Optional[str] = None) -> None:
     _with_retry(
         lambda absorb: _waitall_persistent_attempt(preqs, strategy, absorb),
         lambda e: _note_stuck_preqs(preqs, strategy, e),
@@ -1645,7 +1763,8 @@ def _waitall_persistent_attempt(preqs: Sequence[PersistentRequest],
     for p in preqs:
         act = p.active
         if act is None:
-            raise RuntimeError("wait() on an inactive persistent request")
+            raise RuntimeError("wait() on an inactive persistent "
+                               f"request: {_preq_desc(p)}")
         actives.append(act)
 
     def _restore_restartable() -> None:

@@ -280,6 +280,13 @@ def _apply_locked_steps(comm: Communicator, slot_of) -> None:
         # permutation; persistent-collective handles notice the epoch
         # bump on their next start() and recompile
         comm.invalidate_plans()
+        # mapping-epoch trigger of the shared plan-invalidation contract
+        # (runtime/invalidation.py): compiled artifacts stamp the
+        # generation and re-validate — the per-comm mapping_epoch is the
+        # trigger's DETAIL (which comm moved), the generation its signal
+        from ..runtime import invalidation
+        invalidation.bump("mapping",
+                          f"comm uid {comm.uid} epoch {comm.mapping_epoch}")
 
 
 def replace_ranks(comm: Communicator) -> dict:

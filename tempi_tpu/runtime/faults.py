@@ -131,6 +131,13 @@ SITES = (
                           # would deadlock every survivor's verdict, the
                           # exact divergent-conclusions outcome agreement
                           # exists to prevent)
+    "step.replay",        # each PersistentStep.start() replay dispatch
+                          # (coll/step.py — fires BEFORE any segment
+                          # dispatches, so a raise leaves every buffer
+                          # exactly as the previous step left it and the
+                          # step returns to the startable state; wedge
+                          # refused — the replay dispatches under the
+                          # progress lock)
     "qos.admit",          # each QoS admission decision at op-post notify
                           # (runtime/progress.notify, armed only while
                           # qos.ENABLED — a raise forces the refusal

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional, Tuple
 from ..obs import trace as obstrace
 from ..utils import env as envmod
 from ..utils import locks
+from . import invalidation
 
 CLOSED = "closed"
 OPEN = "open"
@@ -143,6 +144,11 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
                 b.last_transition_at = b.opened_at
         _recompute_flags_locked()
         consecutive = b.consecutive
+    if opened:
+        # breaker-open trigger of the shared plan-invalidation contract
+        # (runtime/invalidation.py): every compiled artifact riding this
+        # strategy re-validates before its next replay
+        invalidation.bump("breaker", f"{peer} {strategy}")
     if opened and obstrace.ENABLED:
         # outside the registry lock: the snapshot walks every thread's
         # ring and must not serialize breaker bookkeeping behind it
@@ -179,6 +185,8 @@ def force_open(peer: tuple, strategy: str, reason: str = "forced") -> None:
             b.times_opened += 1
             b.last_transition_at = b.opened_at
         _recompute_flags_locked()
+    if opened:
+        invalidation.bump("breaker", f"{peer} {strategy} pinned")
     if opened and obstrace.ENABLED:
         obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
                       forced=True, error=reason[:200])

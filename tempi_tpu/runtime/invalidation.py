@@ -1,0 +1,106 @@
+"""Shared plan-invalidation contract: one generation for every recompile
+trigger.
+
+The runtime grew four independent reasons a compiled communication plan
+must not be replayed as-is, each with its own ad-hoc plumbing at every
+replay site:
+
+  * a circuit breaker OPENING for a plan's transport on one of its links
+    (runtime/health.py) — replaying would ride the quarantined path;
+  * a drift-proven online-tune verdict (tune/online.py under
+    ``TEMPI_TUNE=adapt``) — the model that chose the plan's method has
+    been overruled by live evidence;
+  * an applied rank re-placement bumping a communicator's
+    ``mapping_epoch`` (parallel/replacement.py) — the compiled lowering
+    embeds the old app->library permutation;
+  * a fault-tolerance death verdict (runtime/liveness.py) — pending work
+    touching the dead rank can never complete and new starts must refuse
+    fast.
+
+This module collapses them into ONE monotonic generation: every trigger
+calls :func:`bump` with its cause, and every replayable artifact
+(``PersistentColl``, the p2p ``_PersistentBatch``, ``PersistentStep``)
+stamps :func:`current` at compile time and re-validates only when the
+stamp moved.  The replay hot path therefore pays exactly one module
+attribute read and one integer compare when nothing anywhere changed —
+instead of consulting four subsystems' module flags per start — and a
+new trigger added here invalidates every consumer at once instead of
+each replay site growing a fifth ad-hoc check.
+
+The generation is deliberately GLOBAL and COARSE: a breaker opening on a
+link a given plan never touches still moves it.  Consumers re-validate
+(cheap: re-walk their own trigger-specific checks) and re-stamp; only a
+check that actually bites costs a recompile.  False sharing costs a
+re-validation, never a wrong replay — and triggers are rare events
+(breaker transitions, drift verdicts, epoch bumps, death verdicts), not
+per-exchange traffic.
+
+The counter never resets mid-process (``reset()`` clears only the cause
+bookkeeping): a stamped token must never collide with a later
+generation, even across ``api.init``/``finalize`` cycles in one test
+process.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..obs import trace as obstrace
+from ..utils import locks
+
+#: Monotonic generation. Readers take the bare module attribute (an int
+#: read is atomic under the GIL); writers serialize under the lock.
+GENERATION = 0
+
+#: The trigger vocabulary (bookkeeping only — an unknown cause still
+#: bumps; the contract must fail open, never silently skip a trigger).
+CAUSES = ("breaker", "tune", "mapping", "ft")
+
+_lock = locks.named_lock("invalidation")
+_by_cause: Dict[str, int] = {}
+_audit: List[dict] = []
+_AUDIT_KEEP = 50
+
+
+def current() -> int:
+    """The live generation. Compile-time: stamp it BEFORE deriving any
+    state from the trigger subsystems, so a trigger firing mid-compile is
+    caught by the next replay's compare rather than lost."""
+    return GENERATION
+
+
+def bump(cause: str, detail: str = "") -> int:
+    """One trigger fired: advance the generation (every stamped consumer
+    re-validates before its next replay). Returns the new generation."""
+    global GENERATION
+    with _lock:
+        GENERATION += 1
+        gen = GENERATION
+        _by_cause[cause] = _by_cause.get(cause, 0) + 1
+        _audit.append(dict(generation=gen, cause=cause,
+                           detail=str(detail)[:200]))
+        del _audit[:-_AUDIT_KEEP]
+    if obstrace.ENABLED:
+        # outside the lock: the recorder walks per-thread rings and must
+        # not serialize trigger bookkeeping behind it
+        obstrace.emit("invalidation.bump", generation=gen, cause=cause,
+                      detail=str(detail)[:200])
+    return gen
+
+
+def snapshot() -> dict:
+    """Diagnostic snapshot: the live generation, per-cause bump counts,
+    and the bounded audit trail. Pure data — safe to serialize."""
+    with _lock:
+        return dict(generation=GENERATION, by_cause=dict(_by_cause),
+                    recent=[dict(d) for d in _audit])
+
+
+def reset() -> None:
+    """Forget the cause bookkeeping (session teardown / test isolation).
+    The generation itself is NOT rewound — a monotonic counter shared by
+    stamped artifacts must never revisit a value an earlier session's
+    stamp could still hold."""
+    with _lock:
+        _by_cause.clear()
+        _audit.clear()

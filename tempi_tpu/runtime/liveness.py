@@ -458,6 +458,14 @@ def _declare_dead(comm, dead_set: Set[int], provenance: dict) -> Set[int]:
                     for r in newly}
     comm.dead_ranks = dead_now
     ctr.counters.ft.num_verdicts += len(newly)
+    # FT-verdict trigger of the shared plan-invalidation contract
+    # (runtime/invalidation.py): every replayable artifact re-validates
+    # before its next start — a handle on THIS comm finds dead_ranks and
+    # refuses with the verdict instead of replaying into a dead peer.
+    # (force_open below also bumps per pinned breaker; this bump makes
+    # the verdict itself the trigger, not a side effect of its pins.)
+    from . import invalidation
+    invalidation.bump("ft", f"comm uid {comm.uid} dead {sorted(newly)}")
     # revoke: pending requests touching the dead set complete NOW with the
     # verdict — their ops leave the pending list (they can never match, and
     # finalize's leak check must not name them) and every waiter wakes on

@@ -271,6 +271,15 @@ def record(link: tuple, strategy: str, nbytes: int, block: int,
         event = _judge_drift_locked(link, strategy, b, st)
     if event is not None:
         phase = event["phase"]
+        if MODE == "adapt":
+            # drift-verdict trigger of the shared plan-invalidation
+            # contract (runtime/invalidation.py): under adapt mode a
+            # changed verdict can re-rank the choice a compiled plan was
+            # built on, so every replayable artifact re-validates.
+            # Observe mode never changes a choice — no bump.
+            from ..runtime import invalidation
+            invalidation.bump("tune", f"{phase} link {link} {strategy} "
+                                      f"2^{event['bin']}B")
         if obstrace.ENABLED:
             obstrace.emit("tune.drift", **event)
         lvl = log.info if phase == "drifted" else log.debug

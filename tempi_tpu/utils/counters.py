@@ -138,6 +138,23 @@ class FtCounters:
 
 
 @dataclass
+class StepCounters:
+    # whole-step persistent schedules (ISSUE 12; coll/step.py): pinned at
+    # zero when capture is unused — the counter-based byte-for-byte guard
+    # that an un-captured workload records, compiles, and replays nothing
+    num_captures: int = 0        # capture_step contexts completed
+    num_captured_calls: int = 0  # posts/batches/collectives recorded
+    num_compiles: int = 0        # StepRecorder.compile() builds
+    num_recompiles: int = 0      # invalidation-driven step rebuilds
+    num_replays: int = 0         # start() calls that replayed compiled plans
+    num_fused_calls: int = 0     # recorded calls coalesced into a neighbor's
+                                 # plan (k adjacent calls -> one plan = k-1)
+    num_plan_dispatches: int = 0  # exchange plans dispatched by replays
+    num_eager_fallbacks: int = 0  # start() re-issued through the engine
+                                  # (pending eager traffic / TEMPI_STEP=off)
+
+
+@dataclass
 class LockCheckCounters:
     # lock-order race detector (ISSUE 11; utils/locks.py): pinned at zero
     # with TEMPI_LOCKCHECK unset — the counter-based byte-for-byte guard
@@ -170,6 +187,7 @@ class Counters:
     irecv: P2PCounters = field(default_factory=P2PCounters)
     lib: LibCallCounters = field(default_factory=LibCallCounters)
     coll: CollCounters = field(default_factory=CollCounters)
+    step: StepCounters = field(default_factory=StepCounters)
     plan: PlanCacheCounters = field(default_factory=PlanCacheCounters)
     qos: QosCounters = field(default_factory=QosCounters)
     replace: ReplaceCounters = field(default_factory=ReplaceCounters)

@@ -220,6 +220,25 @@ the README "Fault tolerance" section):
                          verdict; processes that do not vote within it
                          abstain (default 5)
 
+Whole-step persistent schedule knobs (ISSUE 12; see coll/step.py and the
+README "Persistent steps" section):
+  TEMPI_STEP           = on | off — the capture/replay machinery behind
+                         ``api.capture_step`` (default on). ``off`` is
+                         the loud escape hatch: captures still record
+                         (so application code is unchanged) but
+                         ``compile()`` produces a step whose ``start()``
+                         re-issues every exchange through the normal
+                         eager engine — per-step cost identical to the
+                         uncaptured path, no fusion, no replay.
+  TEMPI_STEP_FUSE      = on | off — cross-batch pack fusion inside a
+                         compiled step (default on). ``off`` keeps the
+                         replay win (zero per-step planning) but
+                         compiles one exchange plan per recorded call
+                         instead of coalescing adjacent same-direction
+                         batches into one batched multi-descriptor pack
+                         launch — the A/B knob for attributing a
+                         regression to the fusion itself.
+
 Correctness-tooling knobs (ISSUE 11; see utils/locks.py,
 tempi_tpu/analysis/ and the README "Static analysis & race detection"
 section):
@@ -342,6 +361,9 @@ KNOWN_KNOBS = (
     "TEMPI_FT_SUSPECT_TIMEOUTS",
     "TEMPI_FT_HEARTBEAT_S",
     "TEMPI_FT_AGREE_TIMEOUT_S",
+    # whole-step persistent schedules (ISSUE 12)
+    "TEMPI_STEP",
+    "TEMPI_STEP_FUSE",
     # correctness tooling (ISSUE 11)
     "TEMPI_LOCKCHECK",
     # multi-host world coordinates (parallel/multihost.py)
@@ -495,6 +517,10 @@ class Environment:
     ft_suspect_timeouts: int = 2   # unmatched timeouts before suspicion
     ft_heartbeat_s: float = 0.0    # stale-heartbeat accelerant (0 = off)
     ft_agree_timeout_s: float = 5.0  # DCN agreement vote budget
+    # whole-step persistent schedules (ISSUE 12) — see coll/step.py
+    step_mode: str = "on"          # on | off (off = replay degrades to
+    #                                the eager per-step path, loudly)
+    step_fuse: bool = True         # cross-batch pack fusion in a step
     # lock-order race detector (ISSUE 11) — see utils/locks.py
     lockcheck_mode: str = "off"    # off | assert | log
 
@@ -828,6 +854,18 @@ class Environment:
         e.ft_heartbeat_s = _float_env("TEMPI_FT_HEARTBEAT_S", 0.0)
         e.ft_agree_timeout_s = _float_env("TEMPI_FT_AGREE_TIMEOUT_S", 5.0)
 
+        # step knobs parse loudly too: a typo'd TEMPI_STEP silently
+        # staying on would replay a compiled step in the one run that
+        # asked for the eager A/B baseline (and vice versa)
+        sm = (getenv("TEMPI_STEP") or "on").lower()
+        if sm not in ("on", "off"):
+            raise ValueError(f"bad TEMPI_STEP={sm!r}: want on | off")
+        e.step_mode = sm
+        sf = (getenv("TEMPI_STEP_FUSE") or "on").lower()
+        if sf not in ("on", "off"):
+            raise ValueError(f"bad TEMPI_STEP_FUSE={sf!r}: want on | off")
+        e.step_fuse = sf == "on"
+
         # the lock-order checker parses loudly too: a typo'd
         # TEMPI_LOCKCHECK silently staying off would run the one chaos
         # session that asked for race checking with the detector disarmed
@@ -873,6 +911,10 @@ class Environment:
             # ...and the liveness layer: the underlying library has no
             # rank-failure semantics to emulate
             e.ft_mode = "off"
+            # ...and step replay: captured steps degrade to the eager
+            # re-issue path — the bail-out measures the baseline engine,
+            # not the framework's fused replay
+            e.step_mode = "off"
             # TEMPI_LOCKCHECK deliberately survives the bail-out: the
             # lock-order checker observes the framework's own locks (which
             # exist regardless of interposition) and is developer tooling,

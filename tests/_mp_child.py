@@ -177,7 +177,7 @@ def main() -> int:
             p2p.isend(comm, 0, s2, half, ty2, tag=52),    # cross-boundary
             p2p.irecv(comm, half, r2, 0, ty2, tag=52)]
     p2p.waitall(reqs)
-    cache = comm.__dict__["_strategy_cache"]["map"]
+    cache = p2p._strategy_cache["map"]  # module-level since ISSUE 12
     assert cache.get((True, 512, 64)) == "device", \
         f"colocated verdict: {cache}"
     assert cache.get((False, 512, 64)) == "oneshot", \

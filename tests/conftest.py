@@ -55,6 +55,11 @@ def pytest_configure(config):
         "markers",
         "analysis: contract-linter + lock-order checker tests (the <30s "
         "smoke is `pytest -m analysis`, incl. the self-run on the repo)")
+    config.addinivalue_line(
+        "markers",
+        "step: whole-step persistent schedule tests — capture/replay, "
+        "pack fusion, the shared invalidation contract (the <30s smoke "
+        "is `pytest -m step`)")
 
 
 @pytest.fixture(autouse=True)

@@ -423,8 +423,14 @@ def test_hier_recompiles_on_mapping_epoch(make_world, monkeypatch):
     pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
     pc.start()
     pc.wait()
+    # emulate an APPLIED re-placement the way replacement.py performs it:
+    # epoch bump + plan-cache drop + the shared invalidation trigger
+    # (runtime/invalidation.py) that tells replayable artifacts to
+    # re-walk their mapping checks before the next start
+    from tempi_tpu.runtime import invalidation
     world.mapping_epoch += 1
     world.invalidate_plans()
+    invalidation.bump("mapping", f"test epoch {world.mapping_epoch}")
     compiles = ctr.counters.coll.hier_compiles
     pc.start()
     pc.wait()

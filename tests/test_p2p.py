@@ -310,7 +310,8 @@ def test_auto_picks_per_message_strategy(world, monkeypatch):
     sp.host_pingpong = [(1, 1e-9), (1 << 10, 1e-9), (1 << 11, 10.0),
                         (1 << 23, 10.0)]
     msys.set_system(sp)
-    world.__dict__.pop("_strategy_cache", None)
+    # (set_system bumped the sheet generation; the module-level
+    # decision cache self-clears on the next consult — ISSUE 12)
 
     small = dt.contiguous(64, dt.BYTE)
     big = dt.contiguous(1 << 20, dt.BYTE)
@@ -367,7 +368,8 @@ def test_contiguous_method_knobs(world, monkeypatch):
     sp.host_pingpong = [(1, 1.0), (1 << 23, 1.0)]
     sp.intra_node_pingpong = [(1, 1e-6), (1 << 23, 1e-6)]
     msys.set_system(sp)
-    world.__dict__.pop("_strategy_cache", None)
+    # (set_system bumped the sheet generation; the module-level
+    # decision cache self-clears on the next consult — ISSUE 12)
     d0 = ctr.counters.send.num_device
     api.isend(world, 2, sbuf, 3, ty)
     api.irecv(world, 3, rbuf, 2, ty)
@@ -960,3 +962,86 @@ def test_staged_plan_rebind_fresh_buffers(world):
     run(51, "staged")    # builds the plan + split rounds for binding A
     run(52, "oneshot")   # same signature, fresh buffers: rebound plan must
     run(53, "staged")    # rebuild round fns for the new binding, both kinds
+
+
+def test_persistent_error_diagnostics_name_the_request(world):
+    """ISSUE 12 satellite: the span-communicators and restartability
+    refusals identify the offending request — kind, ranks, tag, bytes,
+    and comm uid (WaitTimeout-style diagnostics) — instead of raising
+    bare."""
+    from tempi_tpu.parallel import p2p
+
+    ty = dt.contiguous(32, dt.BYTE)
+    sbuf, _ = fill(world, 32)
+    rbuf = world.alloc(32)
+    other = api.dist_graph_create_adjacent(
+        world, [[r] for r in range(world.size)],
+        [[r] for r in range(world.size)])
+    preqs = [p2p.send_init(world, 3, sbuf, 4, ty, tag=5),
+             p2p.recv_init(other, 4, rbuf, 3, ty, tag=5)]
+    with pytest.raises(ValueError) as ei:
+        p2p.startall(preqs)
+    msg = str(ei.value)
+    assert "span communicators" in msg
+    assert f"comm uid {world.uid}" in msg      # the batch's comm
+    assert f"comm uid {other.uid}" in msg      # the offender's comm
+    assert "recv rank 4<->peer 3 tag 5 (32B" in msg
+
+    good = [p2p.send_init(world, 3, sbuf, 4, ty, tag=6),
+            p2p.recv_init(world, 4, rbuf, 3, ty, tag=6)]
+    p2p.startall(good)
+    with pytest.raises(RuntimeError) as ei:
+        p2p.startall(good)
+    assert "already-active" in str(ei.value)
+    assert "send rank 3<->peer 4 tag 6 (32B" in str(ei.value)
+    p2p.waitall_persistent(good)
+    with pytest.raises(RuntimeError) as ei:
+        p2p.waitall_persistent(good)
+    assert "inactive" in str(ei.value)
+    assert f"comm uid {world.uid}" in str(ei.value)
+    with pytest.raises(RuntimeError) as ei:
+        good[1].test()
+    assert "recv rank 4<->peer 3 tag 6 (32B" in str(ei.value)
+
+
+def test_modeling_cache_hits_across_fresh_communicators(world):
+    """ISSUE 12 satellite (the dead-cache bug): the strategy decision
+    cache is a pure function of {colocated, nbytes, block} and the sheet
+    generation — NOT of communicator identity. Identical repeated
+    exchanges must hit even when the application derives a fresh
+    dist-graph communicator per pattern (each HaloExchange, every
+    replace/shrink/churn rebuild), which is exactly where
+    BENCH_TPU_LAST's `modeling_cache_hits: 0` against 15034 misses came
+    from: every derived comm restarted the old per-comm cache cold."""
+    from tempi_tpu.measure import system as msys
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.utils import counters as ctr
+
+    sp = msys.SystemPerformance()
+    sp.intra_node_pingpong = [(1 << i, 1e-6 * (i + 1)) for i in range(24)]
+    sp.host_pingpong = [(1 << i, 2e-6 * (i + 1)) for i in range(24)]
+    cheap = [[1e-6] * 9 for _ in range(9)]
+    host = [[5e-6] * 9 for _ in range(9)]
+    sp.pack_device = [r[:] for r in cheap]
+    sp.unpack_device = [r[:] for r in cheap]
+    sp.pack_host = [r[:] for r in host]
+    sp.unpack_host = [r[:] for r in host]
+    msys.set_system(sp)
+    try:
+        ty = dt.contiguous(4096, dt.BYTE)
+        adj = [[r] for r in range(world.size)]
+        hits = ctr.counters.modeling.cache_hit
+        misses = ctr.counters.modeling.cache_miss
+        for i in range(4):  # fresh derived comm per "pattern"
+            g = api.dist_graph_create_adjacent(world, adj, adj)
+            sbuf = g.alloc(4096)
+            rbuf = g.alloc(4096)
+            reqs = [p2p.isend(g, 0, sbuf, 1 % g.size, ty),
+                    p2p.irecv(g, 1 % g.size, rbuf, 0, ty)]
+            p2p.waitall(reqs)
+        assert ctr.counters.modeling.cache_hit > hits, \
+            "identical repeated exchanges never hit the decision cache"
+        # one modeled decision total, not one per derived communicator
+        assert ctr.counters.modeling.cache_miss - misses <= 2
+    finally:
+        msys.set_system(msys.SystemPerformance())

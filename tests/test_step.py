@@ -1,0 +1,620 @@
+"""Whole-step persistent schedules (ISSUE 12; coll/step.py) and the
+shared plan-invalidation contract (runtime/invalidation.py).
+
+Marker ``step`` is the tier-1-compatible <30s smoke (`pytest -m step`),
+like the coll/faults/obs markers. The seeded ``step.replay`` chaos
+variant is dual-marked ``faults`` so it rides the chaos smoke under
+``TEMPI_LOCKCHECK=assert``.
+"""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.measure import system as msys
+from tempi_tpu.models import halo3d, ring_attention as ra
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+from tempi_tpu.runtime import faults, health, invalidation, liveness
+from tempi_tpu.tune import online as tune_online
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.step
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def _filled(comm, nbytes, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = [rng.integers(0, 256, nbytes, np.uint8)
+            for _ in range(comm.size)]
+    return comm.buffer_from_host(rows), rows
+
+
+def _ring_batches(comm, sbuf, rbuf, ty, hops=(1, 2)):
+    """Two persistent neighbor batches over distinct tags/offsets — the
+    adjacent-batch shape that fuses."""
+    batches = []
+    for i, h in enumerate(hops):
+        preqs = []
+        for r in range(comm.size):
+            preqs.append(p2p.send_init(comm, r, sbuf, (r + h) % comm.size,
+                                       ty, tag=i, offset=i * ty.extent))
+            preqs.append(p2p.recv_init(comm, (r + h) % comm.size, rbuf, r,
+                                       ty, tag=i, offset=i * ty.extent))
+        batches.append(preqs)
+    return batches
+
+
+def _eager_oracle(comm, sbuf, nbytes, ty, hops=(1, 2)):
+    """The same exchange issued eagerly into a fresh recv buffer."""
+    out = comm.alloc(nbytes)
+    reqs = []
+    for i, h in enumerate(hops):
+        for r in range(comm.size):
+            reqs.append(p2p.isend(comm, r, sbuf, (r + h) % comm.size, ty,
+                                  tag=i, offset=i * ty.extent))
+            reqs.append(p2p.irecv(comm, (r + h) % comm.size, out, r, ty,
+                                  tag=i, offset=i * ty.extent))
+    p2p.waitall(reqs)
+    return out
+
+
+def _capture_two_batch_step(comm, nbytes=1024):
+    sbuf, _ = _filled(comm, nbytes, seed=3)
+    rbuf = comm.alloc(nbytes)
+    ty = dt.contiguous(nbytes // 4, dt.BYTE)
+    batches = _ring_batches(comm, sbuf, rbuf, ty)
+    with api.capture_step(comm) as rec:
+        for b in batches:
+            p2p.startall(b)
+        p2p.waitall_persistent([p for b in batches for p in b])
+    return rec.compile(), sbuf, rbuf, ty, nbytes
+
+
+# -- capture / replay core -----------------------------------------------------
+
+
+def test_adjacent_batches_fuse_and_replay_byte_exact(world):
+    """Acceptance: two adjacent startall batches (no barrier between)
+    coalesce into ONE fused plan — one pack launch per replay — and the
+    replayed step is byte-identical to eager re-issue."""
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    assert ctr.counters.step.num_fused_calls == 1
+    l0 = ctr.counters.device.num_launches
+    for _ in range(3):
+        step.start()
+        step.wait()
+    assert ctr.counters.device.num_launches - l0 == 3  # one launch/step
+    assert ctr.counters.step.num_replays == 2  # starts after the first
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+def test_halo_faces_capture_fewer_pack_launches(world):
+    """Acceptance workload 1: halo3d's per-direction sends. Captured,
+    the direction batches fuse into one batched multi-descriptor pack
+    launch per step — counter-asserted against the eager per-direction
+    path — and the replay is byte-exact vs the whole-set exchange."""
+    ex = halo3d.HaloExchange(world, X=16)
+    fill = lambda rank, shape: float(rank + 1)  # noqa: E731
+    ndirs = len({e.direction for e in ex.edges})
+    assert ndirs > 1
+    buf_cap = ex.alloc_grid(fill=fill)
+    with api.capture_step(ex.comm) as rec:
+        ex.exchange_grouped(buf_cap, strategy="device")
+    step = rec.compile()
+    l0 = ctr.counters.device.num_launches
+    step.start()
+    step.wait()
+    replay_launches = ctr.counters.device.num_launches - l0
+    buf_eager = ex.alloc_grid(fill=fill)
+    l0 = ctr.counters.device.num_launches
+    ex.exchange_grouped(buf_eager, strategy="device")
+    eager_launches = ctr.counters.device.num_launches - l0
+    assert replay_launches < eager_launches
+    assert replay_launches == 1
+    assert eager_launches == ndirs
+    # byte-exact vs the whole-set engine exchange (the repo's oracle)
+    buf_ref = ex.alloc_grid(fill=fill)
+    ex.exchange(buf_ref, strategy="device")
+    for r in range(world.size):
+        np.testing.assert_array_equal(buf_cap.get_rank(r),
+                                      buf_ref.get_rank(r))
+        np.testing.assert_array_equal(buf_eager.get_rank(r),
+                                      buf_ref.get_rank(r))
+
+
+def test_ring_rotation_capture_byte_exact(world):
+    """Acceptance workload 2: ring_attention's engine K/V rotation. The
+    captured double-buffer period (two hops) replays byte-identically to
+    eager rotate() calls — hops are barrier-separated, so the step
+    preserves their order instead of fusing dependent exchanges."""
+    lq, H, D = 8, 2, 4
+    eng = ra.RingAttention(world, lq, H, D)
+    payload = [np.arange(2 * lq * H * D, dtype=np.float32) * (r + 1)
+               for r in range(world.size)]
+    for r in range(world.size):
+        eng.kv.set_rank(r, payload[r].view(np.uint8))
+    step = eng.capture_rotation_step()  # capture itself advances 2 hops
+    step.start()
+    step.wait()                          # +2 more: 4 hops total
+    eng2 = ra.RingAttention(world, lq, H, D)
+    for r in range(world.size):
+        eng2.kv.set_rank(r, payload[r].view(np.uint8))
+    for _ in range(4):
+        eng2.rotate()
+    for r in range(world.size):
+        np.testing.assert_array_equal(eng.current().get_rank(r),
+                                      eng2.current().get_rank(r))
+
+
+def test_persistent_collective_replays_inside_step(world):
+    """A PersistentColl captured mid-step replays AS ITSELF at its
+    recorded position, delivering the same bytes as a direct
+    start/wait."""
+    from test_coll import make_case, _check
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=30)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    with api.capture_step(world) as rec:
+        pc.start()
+        pc.wait()
+    step = rec.compile()
+    _check(world, rbuf, want)
+    step.start()
+    step.wait()
+    _check(world, rbuf, want)
+    assert ctr.counters.coll.num_replays >= 1
+
+
+# -- degradation ladder --------------------------------------------------------
+
+
+def test_step_off_degrades_to_eager_reissue(world, monkeypatch):
+    """TEMPI_STEP=off: capture still records (application code
+    unchanged), replay re-issues through the eager engine — byte-exact,
+    zero fused plans dispatched, fallbacks counted."""
+    monkeypatch.setenv("TEMPI_STEP", "off")
+    envmod.read_environment()
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_eager_fallbacks == 1
+    assert ctr.counters.step.num_plan_dispatches == 0
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+def test_step_fuse_off_one_plan_per_call(world, monkeypatch):
+    """TEMPI_STEP_FUSE=off keeps the replay win but compiles one plan
+    per recorded call — the fusion-attribution A/B knob."""
+    monkeypatch.setenv("TEMPI_STEP_FUSE", "off")
+    envmod.read_environment()
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    assert ctr.counters.step.num_fused_calls == 0
+    d0 = ctr.counters.step.num_plan_dispatches
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_plan_dispatches - d0 == 2  # per call
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+def test_step_fuse_off_matches_across_eager_posts(world, monkeypatch):
+    """TEMPI_STEP_FUSE=off must not change MATCH scope: a lone eager
+    isend recorded by one call still pairs with the irecv of the next —
+    the knob controls plan granularity, never self-containment."""
+    monkeypatch.setenv("TEMPI_STEP_FUSE", "off")
+    envmod.read_environment()
+    sbuf, rows = _filled(world, 256, seed=9)
+    rbuf = world.alloc(256)
+    ty = dt.contiguous(256, dt.BYTE)
+    with api.capture_step(world) as rec:
+        r1 = p2p.isend(world, 0, sbuf, 1 % world.size, ty, tag=2)
+        r2 = p2p.irecv(world, 1 % world.size, rbuf, 0, ty, tag=2)
+        p2p.waitall([r1, r2])
+    step = rec.compile()  # must NOT raise "never matched"
+    step.start()
+    step.wait()
+    np.testing.assert_array_equal(rbuf.get_rank(1 % world.size), rows[0])
+
+
+def test_pending_eager_traffic_forces_engine_fallback(world):
+    """A replay that finds eager ops pending re-issues through the
+    engine for THAT step (MPI non-overtaking across the interleaving),
+    and recovers the fused path once the traffic drains."""
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    step.start()
+    step.wait()
+    interloper = p2p.isend(world, 0, sbuf, 1 % world.size, ty, tag=7)
+    f0 = ctr.counters.step.num_eager_fallbacks
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_eager_fallbacks == f0 + 1
+    p2p.cancel([interloper])
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_eager_fallbacks == f0 + 1  # fused again
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+def test_step_counters_pinned_zero_when_capture_unused(world):
+    """The byte-for-byte contract: an un-captured workload records,
+    compiles, and replays nothing — the step.* group stays zero."""
+    sbuf, _ = _filled(world, 512)
+    rbuf = world.alloc(512)
+    ty = dt.contiguous(512, dt.BYTE)
+    reqs = [p2p.isend(world, 0, sbuf, 1 % world.size, ty),
+            p2p.irecv(world, 1 % world.size, rbuf, 0, ty)]
+    p2p.waitall(reqs)
+    for name, v in ctr.counters.as_dict()["step"].items():
+        assert v == 0, f"step.{name} = {v} with capture unused"
+
+
+def test_step_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_STEP", "bogus")
+    with pytest.raises(ValueError, match="TEMPI_STEP"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_STEP", "on")
+    monkeypatch.setenv("TEMPI_STEP_FUSE", "maybe")
+    with pytest.raises(ValueError, match="TEMPI_STEP_FUSE"):
+        envmod.read_environment()
+
+
+# -- state machine & capture validation ---------------------------------------
+
+
+def test_state_machine_errors(world):
+    step, *_ = _capture_two_batch_step(world)
+    with pytest.raises(RuntimeError, match="inactive"):
+        step.wait()
+    step.start()
+    with pytest.raises(RuntimeError, match="already-active"):
+        step.start()
+    with pytest.raises(RuntimeError, match="active"):
+        step.free()
+    step.wait()
+    step.free()
+    with pytest.raises(RuntimeError, match="freed"):
+        step.start()
+
+
+def test_capture_validation_errors(world):
+    with pytest.raises(ValueError, match="no exchanges"):
+        with api.capture_step(world) as rec:
+            pass
+        rec.compile()
+    with api.capture_step(world) as rec2:
+        with pytest.raises(RuntimeError, match="do not nest"):
+            with api.capture_step(world):
+                pass
+        with pytest.raises(RuntimeError, match="inside the capture"):
+            rec2.compile()
+        sbuf, _ = _filled(world, 256)
+        rbuf = world.alloc(256)
+        ty = dt.contiguous(256, dt.BYTE)
+        reqs = [p2p.isend(world, 0, sbuf, 1 % world.size, ty),
+                p2p.irecv(world, 1 % world.size, rbuf, 0, ty)]
+        p2p.waitall(reqs)
+    step = rec2.compile()
+    with pytest.raises(RuntimeError, match="twice"):
+        rec2.compile()
+    step.free()
+
+
+def test_preposted_recv_matches_across_barriers(world):
+    """Matching spans the whole capture: a receive pre-posted before an
+    unrelated wait pairs with the send issued after it — the standard
+    MPI pre-posted-recv idiom — and the pair dispatches at the position
+    of the call that COMPLETED it (the send), never before."""
+    sbuf, rows = _filled(world, 512, seed=12)
+    rbuf = world.alloc(512)
+    other = world.alloc(512)
+    ty = dt.contiguous(256, dt.BYTE)
+    with api.capture_step(world) as rec:
+        rpre = p2p.irecv(world, 1 % world.size, rbuf, 0, ty, tag=5)
+        r1 = p2p.isend(world, 2 % world.size, sbuf, 3 % world.size, ty,
+                       tag=6)
+        r2 = p2p.irecv(world, 3 % world.size, other, 2 % world.size, ty,
+                       tag=6)
+        p2p.waitall([r1, r2])          # barrier with rpre still pending
+        rs = p2p.isend(world, 0, sbuf, 1 % world.size, ty, tag=5)
+        p2p.waitall([rpre, rs])
+    step = rec.compile()               # must NOT raise "never matched"
+    step.start()
+    step.wait()
+    np.testing.assert_array_equal(rbuf.get_rank(1 % world.size)[:256],
+                                  rows[0][:256])
+    np.testing.assert_array_equal(other.get_rank(3 % world.size)[:256],
+                                  rows[2 % world.size][:256])
+
+
+def test_compile_failure_leaves_recorder_retryable(world):
+    """A failed compile() must not consume the single-shot recorder: the
+    retry re-raises the REAL diagnostic, not 'compile() called twice'."""
+    sbuf, _ = _filled(world, 256)
+    ty = dt.contiguous(256, dt.BYTE)
+    with api.capture_step(world) as rec:
+        req = p2p.isend(world, 0, sbuf, 1 % world.size, ty, tag=9)
+    p2p.cancel([req])
+    with pytest.raises(ValueError, match="never matched"):
+        rec.compile()
+    with pytest.raises(ValueError, match="never matched"):
+        rec.compile()  # the real diagnostic again, not "called twice"
+
+
+def test_unmatched_capture_refused(world):
+    """A capture whose operations never pair inside it cannot replay —
+    compile names the stuck envelopes instead of building a step that
+    would deadlock."""
+    if world.size < 2:
+        pytest.skip("needs a peer rank")
+    sbuf, _ = _filled(world, 256)
+    ty = dt.contiguous(256, dt.BYTE)
+    with api.capture_step(world) as rec:
+        req = p2p.isend(world, 0, sbuf, 1, ty, tag=9)
+    p2p.cancel([req])
+    with pytest.raises(ValueError, match="never matched"):
+        rec.compile()
+
+
+# -- the shared invalidation contract -----------------------------------------
+
+
+def test_invalidation_generation_monotonic_and_audited():
+    g0 = invalidation.current()
+    g1 = invalidation.bump("breaker", "test")
+    g2 = invalidation.bump("ft", "test")
+    assert g0 < g1 < g2 == invalidation.current()
+    snap = invalidation.snapshot()
+    assert snap["by_cause"]["breaker"] >= 1
+    assert snap["by_cause"]["ft"] >= 1
+    assert snap["recent"][-1]["cause"] == "ft"
+    invalidation.reset()
+    assert invalidation.current() == g2  # never rewound
+    assert invalidation.snapshot()["by_cause"] == {}
+
+
+def test_step_recompiles_on_breaker_open(world):
+    """Trigger 1 (breaker open): the next start rebuilds the program
+    against the live breaker state and still delivers byte-exact."""
+    sbuf, _ = _filled(world, 1024, seed=3)
+    rbuf = world.alloc(1024)
+    ty = dt.contiguous(256, dt.BYTE)
+    batches = _ring_batches(world, sbuf, rbuf, ty)
+    with api.capture_step(world) as rec:
+        for b in batches:
+            p2p.startall(b)
+        p2p.waitall_persistent([p for b in batches for p in b])
+    step = rec.compile()
+    step.start()
+    step.wait()
+    lk = health.link(0, 1 % world.size)
+    for _ in range(envmod.env.breaker_threshold):
+        health.record_failure(lk, "device", error="synthetic")
+    assert health.TRIPPED
+    rc0 = ctr.counters.step.num_recompiles
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_recompiles == rc0 + 1
+    want = _eager_oracle(world, sbuf, 1024, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+def test_step_recompiles_on_tune_drift(world, monkeypatch):
+    """Trigger 2 (tune drift under adapt): a drift verdict bumps the
+    generation and the next start rebuilds (re-choosing strategies under
+    the tune overlay), byte-exact."""
+    monkeypatch.setenv("TEMPI_TUNE", "adapt")
+    monkeypatch.setenv("TEMPI_TUNE_MIN_SAMPLES", "5")
+    envmod.read_environment()
+    tune_online.configure()
+    from test_tune import _install_sheet
+    _install_sheet(device_cheap=True)
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    step.start()
+    step.wait()
+    rc0 = ctr.counters.step.num_recompiles
+    for _ in range(8):  # device observed ~1000x the swept prediction
+        tune_online.record(health.link(0, 1 % world.size), "device",
+                           4096, 512, False, True, 5e-2)
+    assert tune_online.ADAPTING
+    step.start()
+    step.wait()
+    assert ctr.counters.step.num_recompiles == rc0 + 1
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+    msys.set_system(msys.SystemPerformance())
+
+
+def test_step_recompiles_on_replace_epoch(monkeypatch):
+    """Trigger 3 (mapping epoch): an applied rank re-placement rebuilds
+    the step against the new app->library permutation, byte-exact."""
+    from test_replace import RING_ORDER, _open_breaker, _ring_graph
+    monkeypatch.setenv("TEMPI_TORUS", "4x2")
+    monkeypatch.setenv("TEMPI_REPLACE", "apply")
+    monkeypatch.setenv("TEMPI_PLACEMENT_KAHIP", "1")
+    envmod.read_environment()
+    comm = api.init()
+    try:
+        nb = 4096
+        _, sources, dests, ws = _ring_graph(RING_ORDER, nb)
+        g = api.dist_graph_create_adjacent(comm, sources, dests,
+                                           sweights=ws, dweights=ws,
+                                           reorder=False)
+        sbuf, _ = _filled(g, 1024, seed=5)
+        rbuf = g.alloc(1024)
+        ty = dt.contiguous(256, dt.BYTE)
+        batches = _ring_batches(g, sbuf, rbuf, ty)
+        with api.capture_step(g) as rec:
+            for b in batches:
+                p2p.startall(b)
+            p2p.waitall_persistent([p for b in batches for p in b])
+        step = rec.compile()
+        step.start()
+        step.wait()
+        _open_breaker((0, 3))  # degrade a link the frozen ring crosses
+        dec = api.replace_ranks(g)
+        assert dec["applied"], dec
+        epoch0 = g.mapping_epoch
+        rc0 = ctr.counters.step.num_recompiles
+        step.start()
+        step.wait()
+        assert ctr.counters.step.num_recompiles == rc0 + 1
+        assert step._mapping_epoch == epoch0
+        want = _eager_oracle(g, sbuf, 1024, ty)
+        for r in range(g.size):
+            np.testing.assert_array_equal(rbuf.get_rank(r),
+                                          want.get_rank(r))
+    finally:
+        api.finalize()
+
+
+def test_step_refuses_on_ft_verdict(monkeypatch):
+    """Trigger 4 (FT verdict): a death verdict on the step's
+    communicator makes every later start refuse with RankFailure — not
+    a one-time refusal that later replays into the dead peer. A step
+    COMPILED after the verdict refuses at compile too (the verdict's
+    generation bump predates the fresh stamp, so the construction-time
+    check is the only line of defense)."""
+    monkeypatch.setenv("TEMPI_FT", "detect")
+    envmod.read_environment()
+    liveness.configure()
+    comm = api.init()
+    try:
+        if comm.size < 2:
+            pytest.skip("needs a rank to kill")
+        step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(comm)
+        step.start()
+        step.wait()
+        # a second recording taken while the comm is still healthy...
+        batches = _ring_batches(comm, sbuf, rbuf, ty)
+        with api.capture_step(comm) as rec2:
+            for b in batches:
+                p2p.startall(b)
+            p2p.waitall_persistent([p for b in batches for p in b])
+        api.mark_failed(comm, comm.size - 1)
+        for _ in range(2):  # EVERY start refuses, not just the first
+            with pytest.raises(liveness.RankFailure):
+                step.start()
+        # ...refuses at compile time after the verdict
+        with pytest.raises(liveness.RankFailure):
+            rec2.compile()
+        # and a PersistentColl built after the verdict refuses at init
+        from test_coll import make_case
+        counts, sd, rc, rd, sb2, rb2, _ = make_case(comm, seed=33)
+        with pytest.raises(liveness.RankFailure):
+            api.alltoallv_init(comm, sb2, counts, sd, rb2, rc, rd)
+    finally:
+        api.finalize()
+
+
+def test_persistent_coll_recompiles_on_tune_drift(world, monkeypatch):
+    """The PersistentColl side of the tune-drift trigger: a drift
+    verdict under adapt re-runs the method choice before the next
+    start (the re-choice is observable; the lowering only rebuilds when
+    the winner changed)."""
+    monkeypatch.setenv("TEMPI_TUNE", "adapt")
+    monkeypatch.setenv("TEMPI_TUNE_MIN_SAMPLES", "5")
+    envmod.read_environment()
+    tune_online.configure()
+    from test_coll import make_case, _check
+    from test_tune import _install_sheet
+    _install_sheet(device_cheap=True)
+    counts, sd, rc, rd, sbuf, rbuf, want = make_case(world, seed=31)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rbuf, rc, rd)
+    pc.start()
+    pc.wait()
+    chosen = []
+    orig = pc._choose
+    monkeypatch.setattr(pc, "_choose",
+                        lambda: chosen.append(1) or orig())
+    pc.start()  # no trigger since last start: no re-choice
+    pc.wait()
+    assert not chosen
+    for _ in range(8):
+        tune_online.record(health.link(0, 1 % world.size), "device",
+                           4096, 512, False, True, 5e-2)
+    assert tune_online.ADAPTING
+    pc.start()  # drift bumped the generation: method re-chosen
+    pc.wait()
+    assert chosen
+    _check(world, rbuf, want)
+    msys.set_system(msys.SystemPerformance())
+
+
+def test_persistent_batch_rebuilds_on_invalidation(world):
+    """The p2p _PersistentBatch side of the contract: a breaker opening
+    between replays drops the cached batch — the next start re-chooses
+    strategies through the first-start pipeline instead of replaying a
+    quarantined plan."""
+    sbuf, _ = _filled(world, 512, seed=8)
+    rbuf = world.alloc(512)
+    ty = dt.contiguous(512, dt.BYTE)
+    preqs = [p2p.send_init(world, 0, sbuf, 1 % world.size, ty),
+             p2p.recv_init(world, 1 % world.size, rbuf, 0, ty)]
+    p2p.startall(preqs)
+    p2p.waitall_persistent(preqs)
+    batch0 = preqs[0].batch
+    assert batch0 is not None
+    p2p.startall(preqs)  # healthy replay keeps the cached batch
+    p2p.waitall_persistent(preqs)
+    assert preqs[0].batch is batch0
+    invalidation.bump("breaker", "synthetic")
+    p2p.startall(preqs)  # stale token: rebuilt via the first-start path
+    p2p.waitall_persistent(preqs)
+    assert preqs[0].batch is not batch0
+    assert preqs[0].batch.token == invalidation.current()
+
+
+# -- chaos (dual-marked: rides the faults smoke under LOCKCHECK) ---------------
+
+
+@pytest.mark.faults
+def test_step_replay_fault_restartable(world, monkeypatch):
+    """Seeded ``step.replay`` faults: a raise fires BEFORE any segment
+    dispatches, the handle stays restartable, and a later healthy start
+    delivers byte-exact (delivered plans are re-delivered identically
+    over unchanged inputs)."""
+    step, sbuf, rbuf, ty, nbytes = _capture_two_batch_step(world)
+    monkeypatch.setenv("TEMPI_FAULTS", "step.replay:raise:0.5:11")
+    envmod.read_environment()
+    faults.configure()
+    done = 0
+    for _ in range(12):
+        try:
+            step.start()
+        except faults.InjectedFault:
+            continue  # restartable: nothing dispatched, nothing active
+        step.wait()
+        done += 1
+    assert done  # the seeded schedule fires ~half the passes
+    faults.reset()
+    step.start()
+    step.wait()
+    want = _eager_oracle(world, sbuf, nbytes, ty)
+    for r in range(world.size):
+        np.testing.assert_array_equal(rbuf.get_rank(r), want.get_rank(r))
+
+
+@pytest.mark.faults
+def test_step_replay_wedge_refused(monkeypatch):
+    """step.replay dispatches under the progress lock: the wedge kind is
+    refused at configure time like every non-engine site."""
+    monkeypatch.setenv("TEMPI_FAULTS", "step.replay:wedge:1:1")
+    envmod.read_environment()
+    with pytest.raises(faults.FaultSpecError, match="wedge"):
+        faults.configure()
