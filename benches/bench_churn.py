@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Full churn-cycle latency: kill -> detect -> shrink -> keep serving ->
+rejoin -> grow -> verify (ISSUE 13; runtime/elastic.py — the companion
+to bench_shrink.py, closing the loop bench_shrink leaves open).
+
+No reference analog (TEMPI trusts a healthy, fixed-size MPI world). The
+scenario is a long-running service riding a capacity change with no
+restart: one victim rank wedges permanently, the survivors' bounded
+waits attribute the timeouts, the agreement vote lands a verdict,
+``api.shrink`` rebuilds the survivor communicator — which KEEPS SERVING
+— then the replacement device announces itself (``api.announce_join``),
+the survivors vote it in (``api.grow``), and a byte-verified persistent
+alltoallv recompiles and replays over the re-expanded world.
+
+Reported (CSV): detection latency (first post to the victim -> verdict),
+shrink time, whether the survivor world served mid-churn (serve_ok),
+join-announcement time, grow time (vote + topology rediscovery +
+re-partition + construction), how many rank_failed-pinned breakers the
+rejoin reset, and the post-grow alltoallv's correctness + replay
+throughput over the full-size world.
+
+    python benches/bench_churn.py --cpu --quick
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from _common import base_parser, devices_or_die, emit_csv, setup_platform
+
+
+def main() -> int:
+    p = base_parser("kill/detect/shrink/serve/rejoin/grow churn cycle",
+                    multirank=True)
+    p.add_argument("--wait-timeout", type=float, default=0.3,
+                   help="TEMPI_WAIT_TIMEOUT_S for the detection waits")
+    p.add_argument("--suspect-timeouts", type=int, default=2,
+                   help="TEMPI_FT_SUSPECT_TIMEOUTS evidence threshold")
+    p.add_argument("--bytes", type=int, default=1 << 12,
+                   help="per-pair alltoallv payload on the grown comm")
+    p.add_argument("--reps", type=int, default=20,
+                   help="post-grow alltoallv replays to time")
+    args = p.parse_args()
+    if args.quick:
+        args.wait_timeout, args.reps = 0.15, 5
+    setup_platform(args)
+
+    import os
+    os.environ["TEMPI_FT"] = "shrink"
+    os.environ["TEMPI_ELASTIC"] = "grow"
+    os.environ["TEMPI_WAIT_TIMEOUT_S"] = str(args.wait_timeout)
+    os.environ["TEMPI_FT_SUSPECT_TIMEOUTS"] = str(args.suspect_timeouts)
+
+    from tempi_tpu import api
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+
+    devices_or_die(min_devices=2)
+    comm = api.init()
+    size = comm.size
+    victim = size - 1
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf = comm.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(size)])
+
+    # -- kill + detect: the victim wedges (its ops never post) --------------
+    trigger = p2p.isend(comm, 0, sbuf, victim, ty)
+    t_post = time.monotonic()
+    t_verdict = None
+    while t_verdict is None:
+        try:
+            p2p.waitall([trigger])
+            print("victim completed?! detection never fired",
+                  file=sys.stderr)
+            return 1
+        except api.RankFailure:
+            t_verdict = time.monotonic()
+        except api.WaitTimeout:
+            continue  # suspicion accumulating toward the threshold
+    detect_s = t_verdict - t_post
+
+    # -- shrink, then KEEP SERVING on the survivor world --------------------
+    t0 = time.monotonic()
+    surv = api.shrink(comm)
+    shrink_s = time.monotonic() - t0
+    ss = surv.buffer_from_host(
+        [np.full(64, r + 1, np.uint8) for r in range(surv.size)])
+    sr = surv.alloc(64)
+    p2p.waitall([p2p.isend(surv, 0, ss, 1, ty),
+                 p2p.irecv(surv, 1, sr, 0, ty)])
+    serve_ok = bool((sr.get_rank(1) == 1).all())
+
+    # -- rejoin: the replacement device announces, the survivors admit -----
+    victim_dev = comm.devices[comm.library_rank(victim)]
+    t0 = time.monotonic()
+    out = api.announce_join(surv, [victim_dev])
+    join_s = time.monotonic() - t0
+    if out["outcome"] != "announced":
+        print(f"announce_join {out['outcome']}?!", file=sys.stderr)
+        return 1
+    t0 = time.monotonic()
+    grown = api.grow(surv)
+    grow_s = time.monotonic() - t0
+    if grown is None or grown.size != size:
+        print("grow did not re-expand the world?!", file=sys.stderr)
+        return 1
+    led = api.elastic_snapshot()["ledger"][-1]
+    unpinned = led.get("breakers_unpinned", 0)
+
+    # -- post-grow persistent alltoallv over the re-expanded world:
+    #    compile, byte-verify once, then time replays
+    k = grown.size
+    nb = args.bytes
+    counts = np.full((k, k), nb, np.int64)
+    np.fill_diagonal(counts, 0)
+    disp = np.tile(np.arange(k) * nb, (k, 1))
+    gb = grown.buffer_from_host(
+        [np.full(k * nb, r + 1, np.uint8) for r in range(k)])
+    rb = grown.alloc(k * nb)
+    pc = api.alltoallv_init(grown, gb, counts, disp, rb, counts.T, disp)
+    pc.start(); pc.wait()
+    ok = True
+    for r in range(k):
+        expect = np.repeat(np.arange(1, k + 1), nb).astype(np.uint8)
+        expect[r * nb:(r + 1) * nb] = 0
+        ok = ok and bool((rb.get_rank(r) == expect).all())
+    t0 = time.monotonic()
+    for _ in range(args.reps):
+        pc.start(); pc.wait()
+    rep_s = (time.monotonic() - t0) / max(args.reps, 1)
+    moved = int(counts.sum())
+
+    emit_csv(
+        ["size", "survivors", "victim", "detect_s", "shrink_s",
+         "serve_ok", "join_s", "grow_s", "regrown", "unpinned",
+         "a2av_ok", "a2av_replay_s", "a2av_GBps"],
+        [[size, surv.size, victim, detect_s, shrink_s, int(serve_ok),
+          join_s, grow_s, grown.size, unpinned, int(ok), rep_s,
+          moved / rep_s / 1e9 if rep_s > 0 else 0.0]])
+    api.finalize()
+    return 0 if ok and serve_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,11 @@ def init(devices=None) -> Communicator:
     from .runtime import liveness
     liveness.configure()  # arm TEMPI_FT (knobs loud-parsed above; this
     # clears any prior session's dead sets, suspicion, and verdict ledger)
+    from .runtime import elastic
+    elastic.configure()  # arm TEMPI_ELASTIC (knobs loud-parsed above;
+    # this clears any prior session's pending joins and join/admit
+    # ledger — and bumps the session ordinal scoping admission keys, so
+    # a stale session's join can never be replayed into this one)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -199,6 +204,10 @@ def finalize() -> None:
         from .runtime import liveness
         liveness.configure()  # dead sets and the verdict ledger are
         # per-session too (a new session's world has no dead ranks)
+        from .runtime import elastic
+        elastic.configure()  # pending joins and the join/admit ledger
+        # are per-session too (a joiner must re-announce into the new
+        # session's scoped keys)
         _world = None
 
 
@@ -306,6 +315,51 @@ def shrink(comm: Communicator) -> Communicator:
     and an epoch boundary (no survivor operations in flight)."""
     from .runtime import liveness
     return liveness.shrink(comm)
+
+
+def announce_join(comm: Communicator, devices) -> dict:
+    """Register joiner ``devices`` as PENDING admission on ``comm``
+    (ISSUE 13; runtime/elastic.py) — the joiner side of the grow
+    protocol, the inverse of the shrink path. Nothing changes until the
+    survivors vote the joiners in via :func:`grow`. Requires
+    ``TEMPI_ELASTIC=grow``; the ``elastic.join`` fault site defers (drops
+    whole, caller retries) a chaosed announcement. Returns the
+    announcement record; see the README "Elastic communicators"
+    section."""
+    from .runtime import elastic
+    return elastic.announce_join(comm, devices)
+
+
+def grow(comm: Communicator) -> Optional[Communicator]:
+    """Admit every pending joiner of ``comm`` and build a NEW, enlarged
+    communicator (ISSUE 13; the grow/rejoin inverse of
+    :func:`shrink`). The pending join set first passes an agreement vote
+    (in-process trivially; multi-process over the coordinator-KV seam,
+    UNANIMOUS within ``TEMPI_GROW_AGREE_TIMEOUT_S`` — an abstention or
+    channel loss DEFERS, returning None with the joiners retained,
+    never a divergent world). On admission: topology rediscovers over
+    the enlarged device list, the placement re-partitions seeded with
+    the current mapping, a rejoining device's ``rank_failed``-pinned
+    breakers reset, the admitted ranks' liveness starts clean, the
+    parent's plan caches drop, and ONE bump of the shared
+    plan-invalidation generation (cause ``grow``) re-validates every
+    persistent handle. Requires ``TEMPI_ELASTIC=grow``, no dead ranks
+    (``api.shrink`` first), and an epoch boundary (no operations in
+    flight). Rebuild buffers and persistent handles on the returned
+    communicator."""
+    from .runtime import elastic
+    return elastic.grow(comm)
+
+
+def elastic_snapshot() -> dict:
+    """Diagnostic snapshot of the elastic-communicator layer (ISSUE 13):
+    mode and knobs, pending joiners per communicator (with announcement
+    ages), and the bounded join/admit ledger — announcements, admitted
+    grows (sizes, uids, rejoined slots, breakers unpinned, agreement
+    provenance), and deferrals with their causes. Pure data — safe to
+    serialize. Callable before init and after finalize (reads empty)."""
+    from .runtime import elastic
+    return elastic.snapshot()
 
 
 def ft_snapshot() -> dict:

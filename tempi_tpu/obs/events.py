@@ -48,11 +48,18 @@ EVENTS = (
     "breaker.close",     # breaker closed after a successful probe
     "breaker.half_open",  # cooldown elapsed; probe allowed
     "breaker.demotion",  # retry demoted the strategy toward STAGED
+    "breaker.unpin",     # rank_failed pins reset by an elastic rejoin
     # runtime/liveness.py — fault-tolerant communicators
     "ft.rank_failure",   # a RankFailure was raised (dead set)
     "ft.suspect",        # local suspicion recorded (rank, count, source)
     "ft.verdict",        # agreed death verdict applied
     "ft.shrink",         # survivor communicator built
+    # runtime/elastic.py — elastic communicators (grow/rejoin)
+    "elastic.join",      # a joiner's devices registered as pending
+    "elastic.admit",     # admission vote passed (admitted, rejoined)
+    "elastic.grow",      # enlarged communicator built (sizes, uids)
+    "elastic.deferred",  # a join/admit step deferred (chaos, channel
+                         # loss, non-unanimous vote) — never diverged
     # runtime/progress.py — pump, supervisor, QoS admission
     "pump.step",         # one background pump service (span; outcome)
     "pump.replaced",     # supervisor replaced a wedged/dead pump

@@ -16,7 +16,6 @@ mesh rows.
 
 from __future__ import annotations
 
-import itertools
 import threading
 import weakref
 from collections import OrderedDict
@@ -62,8 +61,43 @@ _all_comms: "weakref.WeakSet[Communicator]" = weakref.WeakSet()
 # communicators in program order, so the ordinal names the SAME
 # communicator on every process — the liveness agreement (ISSUE 9;
 # runtime/liveness.py) scopes its cross-process vote keys on it so two
-# communicators' votes can never collide
-_comm_seq = itertools.count(1)
+# communicators' votes can never collide. Elastic grow (ISSUE 13;
+# runtime/elastic.py) extends the contract across the epoch boundary: a
+# JOINER process constructs none of the survivors' history, so its
+# counter starts behind — the admit record carries the survivors' value
+# and sync_uid() fast-forwards to it, making the enlarged communicator's
+# uid (and every later agreement key derived from it) identical on
+# joiner and survivors. Lock-guarded (not itertools.count) so the value
+# can be observed and advanced, never rewound.
+_uid_lock = locks.named_lock("communicator.uid")
+_next_uid = 1
+
+
+def _alloc_uid() -> int:
+    global _next_uid
+    with _uid_lock:
+        uid = _next_uid
+        _next_uid += 1
+        return uid
+
+
+def peek_uid() -> int:
+    """The uid the NEXT constructed communicator will receive (the value
+    an elastic admit record carries to the joiner)."""
+    with _uid_lock:
+        return _next_uid
+
+
+def sync_uid(floor: int) -> int:
+    """Fast-forward the creation ordinal to at least ``floor`` (elastic
+    grow: the joiner aligns with the survivors before the enlarged
+    communicator is constructed). Monotone only — a counter shared by
+    live uids must never rewind, so a ``floor`` at or below the current
+    value is a no-op. Returns the (possibly advanced) next uid."""
+    global _next_uid
+    with _uid_lock:
+        _next_uid = max(_next_uid, int(floor))
+        return _next_uid
 
 
 def free_all() -> None:
@@ -77,7 +111,7 @@ class Communicator:
                  parent=None, topology=None):
         self.devices = list(devices)
         self.size = len(self.devices)
-        self.uid = next(_comm_seq)  # SPMD-aligned creation ordinal
+        self.uid = _alloc_uid()  # SPMD-aligned creation ordinal
         self.mesh = Mesh(np.array(self.devices), (AXIS,))
         # callers that already discovered the topology over this exact
         # device list (liveness.shrink re-partitions against it before

@@ -222,29 +222,121 @@ def allgather_suspects(bitmap: int, scope: str,
     caller DEFERS the verdict: a local verdict would diverge from the
     other processes', and a crash here must not masquerade as an engine
     failure on the waiter's thread)."""
-    import jax
+    from . import tags
 
-    if jax.process_count() <= 1:
-        return {0: int(bitmap)}
+    return _allgather_kv_ints(f"tempi/ft/{tags.FT_AGREE}/{scope}",
+                              int(bitmap), timeout_s,
+                              what="rank-death agreement")
+
+
+def allgather_join_acks(digest: int, scope: str,
+                        timeout_s: float) -> Optional[dict]:
+    """DCN admission seam for the elastic layer (ISSUE 13;
+    runtime/elastic._agree_admit): publish this process's pending-join
+    digest and collect every other process's for one grow admission
+    vote. Same transport as :func:`allgather_suspects` — the coordinator
+    KV store — but namespaced under the reserved ``tags.ELASTIC_JOIN``
+    id so a death vote and a join vote on the same communicator can
+    never read each other's values. ``scope`` carries the caller's
+    session / communicator-uid / round ordinals (SPMD-aligned, the
+    ISSUE 9 key-scoping discipline), so a stale session's join can never
+    be replayed into this one. The UNANIMITY requirement — unlike the
+    union semantics of the death vote — lives in the caller: collecting
+    fewer than ``process_count`` votes, or mismatched digests, defers
+    the admission there."""
+    from . import tags
+
+    return _allgather_kv_ints(f"tempi/elastic/{tags.ELASTIC_JOIN}/{scope}",
+                              int(digest), timeout_s,
+                              what="grow admission")
+
+
+def publish_join_commit(scope: str, decision: int) -> bool:
+    """Durably record that this process's grow admission vote PASSED
+    (runtime/elastic._agree_admit): write the packed decision (join-set
+    digest + agreed uid floor) under the vote scope's ``commit`` key.
+    The marker is what makes the decision atomic-commit-like over the
+    shared KV store: a survivor whose own vote collection timed out
+    reads the marker (:func:`read_join_commit`) and admits the SAME
+    decision instead of deferring into a divergent world. Idempotent
+    across publishers — every committer holds the full vote set and so
+    writes the same value, and a duplicate-key failure counts as
+    success when the stored value matches. Returns False when no marker
+    could be written or confirmed (the caller defers)."""
+    client = _kv_client()
+    if client is None:
+        return False
+    from . import tags
+
+    key = f"tempi/elastic/{tags.ELASTIC_JOIN}/{scope}/commit"
     try:
-        from jax._src.distributed import global_state
-        client = global_state.client
-    except Exception as e:  # pragma: no cover - jax-version dependent
-        log.warn(f"no distributed KV client for rank-death agreement: "
-                 f"{e!r}")
-        return None
+        client.key_value_set(key, str(int(decision)))
+        return True
+    except Exception:
+        # the key may already exist (a peer committed first) — a
+        # matching stored decision IS the confirmation we wanted
+        return read_join_commit(scope, 0.2) == int(decision)
+
+
+def read_join_commit(scope: str, budget_s: float) -> Optional[int]:
+    """Read a grow vote's commit marker (or None within ``budget_s``):
+    the deferring-survivor side of :func:`publish_join_commit`."""
+    client = _kv_client()
     if client is None:
         return None
     from . import tags
 
-    base = f"tempi/ft/{tags.FT_AGREE}/{scope}"
+    key = f"tempi/elastic/{tags.ELASTIC_JOIN}/{scope}/commit"
+    try:
+        return int(client.blocking_key_value_get(
+            key, max(1, int(budget_s * 1000))))
+    except Exception:
+        return None
+
+
+def _kv_client():
+    """The coordinator KV client of the ``jax.distributed`` world, or
+    None when no usable one exists (single-process, older jax, or the
+    service is gone)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client
+    except Exception:  # pragma: no cover - jax-version dependent
+        return None
+
+
+def _allgather_kv_ints(base: str, value: int, timeout_s: float,
+                       what: str) -> Optional[dict]:
+    """Shared coordinator-KV allgather mechanics for the control votes
+    (death verdicts, grow admissions): publish ``value`` under
+    ``{base}/{process}``, then collect every other process's entry
+    within ``timeout_s`` (a process that never publishes ABSTAINS — it
+    may be the very failure being voted on). Returns None when no
+    usable channel exists or our own publish failed — the caller defers
+    its verdict."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return {0: int(value)}
+    try:
+        from jax._src.distributed import global_state
+        client = global_state.client
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        log.warn(f"no distributed KV client for {what}: {e!r}")
+        return None
+    if client is None:
+        return None
     me = jax.process_index()
     try:
-        client.key_value_set(f"{base}/{me}", str(int(bitmap)))
+        client.key_value_set(f"{base}/{me}", str(int(value)))
     except Exception as e:
-        log.warn(f"rank-death agreement publish failed: {e!r}")
+        log.warn(f"{what} publish failed: {e!r}")
         return None
-    votes = {me: int(bitmap)}
+    votes = {me: int(value)}
     deadline = time.monotonic() + max(timeout_s, 0.001)
     for p in range(jax.process_count()):
         if p == me:

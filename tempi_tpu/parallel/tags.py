@@ -22,3 +22,9 @@ FT_AGREE = RESERVED_BASE + 3
 # persistent round (or application traffic) interleaved on the same
 # communicator
 COLL_HIER = RESERVED_BASE + 4
+# elastic-communicator join/admission control channel (runtime/elastic.py):
+# the multi-process join-digest allgather backing a grow admission vote
+# namespaces its coordinator-KV keys under this reserved id — distinct
+# from FT_AGREE, so a death vote and a join vote on the same communicator
+# can never read each other's bitmaps
+ELASTIC_JOIN = RESERVED_BASE + 5

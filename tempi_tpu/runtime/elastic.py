@@ -1,0 +1,556 @@
+"""Elastic communicators: grow and rank-rejoin — the inverse of shrink
+(ISSUE 13).
+
+The FT subsystem (ISSUE 9; runtime/liveness.py) closes half the churn
+loop: detect → agree → revoke → shrink keeps a service alive when a rank
+dies. A production deployment riding autoscaling or hardware swaps needs
+the OTHER half: a replacement (or additional) process joins and the
+world re-expands — with no restart. MPI's answer never got ergonomic
+(``MPI_Comm_spawn`` + ULFM revoke/agree compose poorly); this module is
+that direction for the single-controller SPMD world, mode-gated as
+``TEMPI_ELASTIC=off|grow`` (house pattern: module ``ENABLED`` flag, the
+off path inert and counter-pinned byte-for-byte).
+
+Join — :func:`announce_join` (``api.announce_join``) registers a
+joiner's devices as PENDING for one communicator. The announcement is an
+``elastic.join`` fault site: a chaos raise DEFERS it (nothing is
+registered, the caller retries; never a half-announced joiner), and the
+wedge kind is refused. Announcements are per-communicator and
+per-session — a stale session's join can never be replayed, because the
+admission vote below scopes its keys on the session ordinal exactly like
+ISSUE 9's agreement hardening.
+
+Admit — :func:`grow` (``api.grow``) is the survivors' epoch-boundary
+step. Before anything mutates, the pending join set goes through an
+agreement vote (the ``ft.agree`` contract): in-process worlds admit
+trivially (one controller drives every rank); multi-process worlds
+allgather a digest of the join set over the coordinator-KV seam
+(``multihost.allgather_join_acks``, keyed under the reserved
+``tags.ELASTIC_JOIN`` id, scoped session/comm-uid/round). The vote must
+be UNANIMOUS within ``TEMPI_GROW_AGREE_TIMEOUT_S``: an abstaining
+process or a lost channel DEFERS the admission — joiners stay pending,
+the next ``grow`` retries — never a divergent world where one survivor
+enlarged and another did not. The vote is an ``elastic.admit`` fault
+site with the same raise-defers / wedge-refused contract.
+
+Grow — on an admitted vote, the enlarged world is built through the
+SAME seams shrink established, in the other direction:
+
+  * topology is rediscovered over the enlarged device list;
+  * the placement re-partitions via ``process_mapping`` seeded with the
+    CURRENT mapping (``extra_starts`` — survivors keep their locality,
+    joiners take the fresh slots, and the candidate can only refine what
+    is installed); a dist-graph parent's adjacency carries over with
+    empty neighborhoods for the new ranks;
+  * the SPMD-aligned ``Communicator.uid`` ordinal is synchronized
+    (``communicator.sync_uid`` with the counter value the admit record
+    carries) so agreement keys can never collide across the epoch
+    boundary — the joiner's counter fast-forwards to the survivors';
+  * a joiner whose device reoccupies a slot an ancestor declared DEAD is
+    a REJOIN: every breaker force-opened PINNED with
+    ``reason=rank_failed`` on that slot's links RESETS to a fresh closed
+    state (``health.unpin_rank`` — not a half-open probe: the dead
+    link's failure history is not evidence about the replacement's
+    healthy hardware), and the liveness registry stamps the new rank's
+    heartbeat at admit with suspicion zeroed
+    (``liveness.note_admit``) so pre-failure evidence cannot instantly
+    re-convict the replacement;
+  * the parent's plan caches drop and ONE bump of the shared
+    plan-invalidation generation (``runtime/invalidation.py``, new
+    ``grow`` cause) makes every persistent handle — ``PersistentColl``,
+    the p2p ``_PersistentBatch``, ``PersistentStep`` — re-validate
+    before its next start. No new per-subsystem plumbing.
+
+Epoch-boundary contract (same as shrink/replace): no operations in
+flight on the communicator, and buffers/persistent handles must be
+rebuilt on the returned enlarged communicator.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import trace as obstrace
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import locks
+from ..utils import logging as log
+from . import faults, health, liveness
+
+MODES = ("off", "grow")
+
+#: Module-level fast-path flag: True iff mode != off. With
+#: ``TEMPI_ELASTIC`` unset the whole subsystem is one refused api call —
+#: no registry, no counters, no trace events (the byte-for-byte guard).
+ENABLED = False
+MODE = "off"
+
+_LEDGER_KEEP = 100  # bounded join/admit ledger (diagnostics, not logs)
+
+#: The admission vote publishes ONE int per process: the low
+#: ``_DIGEST_BITS`` carry the crc32 join-set digest (the unanimity
+#: check), the high bits carry the publisher's next communicator uid
+#: (the alignment floor sync_uid fast-forwards to). crc32 is bounded by
+#: exactly this span.
+_DIGEST_BITS = 32
+
+_lock = locks.named_lock("elastic")
+_pending: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_rounds: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_ledger: List[dict] = []
+_ledger_entries = 0
+# session ordinal (bumped by every configure()): scopes the DCN admission
+# keys so a join vote from a PREVIOUS session — the jax.distributed world
+# and its KV store outlive api.finalize — can never be read as this
+# session's. Every process runs the same SPMD program, so the count is
+# aligned (the ISSUE 9 agreement-hardening discipline).
+_session = 0
+
+
+@dataclass
+class _JoinRequest:
+    """One pending joiner: the devices it contributes and when it
+    announced (ledger diagnostics; age also bounds KV-key staleness
+    debugging)."""
+
+    devices: list
+    announced_at: float = field(default_factory=time.monotonic)
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the elastic layer. ``mode=None`` reads the parsed env's
+    ``elastic_mode`` (so call after ``read_environment``); an explicit
+    mode overrides (test convenience). Clears pending joins and the
+    join/admit ledger — elasticity history is per-session state, like
+    counters."""
+    global ENABLED, MODE, _ledger_entries, _session
+    if mode is None:
+        mode = getattr(envmod.env, "elastic_mode", "off")
+    if mode not in MODES:
+        raise ValueError(
+            f"bad TEMPI_ELASTIC mode {mode!r}: want one of {MODES}")
+    with _lock:
+        _session += 1
+        MODE = mode
+        ENABLED = mode != "off"
+        _pending.clear()
+        _rounds.clear()
+        _ledger.clear()
+        _ledger_entries = 0
+    if ENABLED:
+        log.debug(
+            f"elastic communicators armed: mode={mode} grow_agree_timeout_s="
+            f"{getattr(envmod.env, 'grow_agree_timeout_s', 5.0)}")
+
+
+def _require_enabled(what: str) -> None:
+    if not ENABLED:
+        raise RuntimeError(
+            f"{what} requires TEMPI_ELASTIC=grow (TEMPI_ELASTIC is off)")
+
+
+def _ledger_append(entry: dict) -> None:
+    global _ledger_entries
+    with _lock:
+        _ledger_entries += 1
+        entry["at_monotonic"] = time.monotonic()
+        _ledger.append(entry)
+        del _ledger[:-_LEDGER_KEEP]
+
+
+# -- join ----------------------------------------------------------------------
+
+
+def announce_join(comm, devices: Sequence) -> dict:
+    """Register ``devices`` as a pending joiner of ``comm``
+    (``api.announce_join``). The joiner side of the grow protocol: in the
+    single-controller world the controller announces on the joiner's
+    behalf; in a multi-process world each process announces the joiner it
+    hosts, and the ADMISSION vote in :func:`grow` is what aligns every
+    survivor on the same join set. The ``elastic.join`` fault site fires
+    BEFORE registration: a chaos raise DEFERS the announcement (nothing
+    pends; the caller retries), never a half-announced joiner."""
+    _require_enabled("api.announce_join")
+    if comm.freed:
+        raise RuntimeError("announce_join() on a freed communicator")
+    devices = list(devices)
+    if not devices:
+        raise ValueError("announce_join: no devices to join with")
+    if len({id(d) for d in devices}) != len(devices):
+        # a duplicate INSIDE one announcement would give the same
+        # physical device two library ranks in the grown world — refuse
+        # like the already-a-member case, never build an aliased mesh
+        raise ValueError(
+            "announce_join: duplicate device(s) in one announcement")
+    present = set(map(id, comm.devices))
+    dup = [d for d in devices if id(d) in present]
+    if dup:
+        raise ValueError(
+            f"announce_join: device(s) {[str(d) for d in dup]} are already "
+            "members of the communicator")
+    if faults.ENABLED:
+        try:
+            faults.check("elastic.join")
+        except faults.InjectedFault as e:
+            # DEFER: the announcement is dropped whole — the registry
+            # never holds a half-announced joiner, and the caller
+            # retries exactly like a lost control message
+            ctr.counters.elastic.num_join_deferred += 1
+            if obstrace.ENABLED:
+                obstrace.emit("elastic.deferred", stage="join",
+                              devices=len(devices))
+            log.warn(f"elastic join announcement deferred: {e}")
+            return dict(outcome="deferred", stage="join",
+                        error=repr(e)[:200])
+    with _lock:
+        pend = _pending.setdefault(comm, [])
+        already = {id(d) for req in pend for d in req.devices}
+        fresh = [d for d in devices if id(d) not in already]
+        if fresh:
+            pend.append(_JoinRequest(devices=fresh))
+    if not fresh:
+        return dict(outcome="already_pending",
+                    devices=[str(d) for d in devices])
+    ctr.counters.elastic.num_announced += 1
+    if obstrace.ENABLED:
+        obstrace.emit("elastic.join", comm_uid=comm.uid,
+                      devices=len(fresh))
+    _ledger_append(dict(kind="join", comm_uid=comm.uid, size=comm.size,
+                        devices=[str(d) for d in fresh]))
+    log.debug(f"elastic: {len(fresh)} device(s) announced for comm uid "
+              f"{comm.uid} ({comm.size} ranks)")
+    return dict(outcome="announced", devices=[str(d) for d in fresh])
+
+
+def pending_joiners(comm) -> int:
+    """How many devices are pending admission on ``comm`` (0 when the
+    subsystem is off — the registry cannot hold entries then)."""
+    with _lock:
+        return sum(len(req.devices) for req in _pending.get(comm, ()))
+
+
+# -- admission vote ------------------------------------------------------------
+
+
+def _join_digest(reqs: Sequence[_JoinRequest]) -> int:
+    """Deterministic cross-process digest of one pending join set (the
+    value every survivor publishes in the admission vote). Python's
+    ``hash`` is salted per process, so the digest rides crc32 of the
+    canonical device-string list instead."""
+    canon = ",".join(sorted(str(d) for req in reqs for d in req.devices))
+    return zlib.crc32(canon.encode())
+
+
+def _agree_admit(comm, reqs: Sequence[_JoinRequest]) -> dict:
+    """Turn a pending join set into an agreed admission. In-process
+    worlds admit trivially (the controller's pending set IS every rank's
+    pending set). Multi-process worlds allgather the join-set digest
+    over the coordinator-KV seam and require UNANIMITY within
+    ``TEMPI_GROW_AGREE_TIMEOUT_S``: a missing or mismatched vote DEFERS
+    the admission — an abstaining survivor may be mid-failure itself,
+    and admitting a rank it never heard of would fork the world (the
+    exact divergence the ft.agree contract exists to prevent). The
+    ``elastic.admit`` fault site fires BEFORE the vote in :func:`grow`;
+    a raise defers, never half-admits.
+
+    The returned provenance carries ``uid_floor`` — the MAX of every
+    participant's creation-ordinal counter, packed into the published
+    value above the 32-bit digest — so :func:`grow` can fast-forward a
+    lagging participant's counter (``communicator.sync_uid``) before
+    construction: the enlarged communicator's uid is identical on
+    joiner and survivors."""
+    from ..parallel import communicator as comm_mod
+    with _lock:
+        rnd = _rounds.get(comm, 0) + 1
+        _rounds[comm] = rnd
+    import jax
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return dict(method="in-process", participants=1, round=rnd,
+                    uid_floor=comm_mod.peek_uid())
+    digest = _join_digest(reqs)
+    from ..parallel import multihost
+    timeout = float(getattr(envmod.env, "grow_agree_timeout_s", 5.0))
+    scope = f"{_session}/{comm.uid}/{rnd}"
+    # one int per vote: low 32 bits = the crc32 join-set digest (the
+    # unanimity check), high bits = this process's next uid (the
+    # alignment floor) — the counter value must actually cross the wire
+    # or a joiner whose history is shorter than the survivors' would
+    # mint a different uid for the same communicator
+    votes = multihost.allgather_join_acks(
+        (comm_mod.peek_uid() << _DIGEST_BITS) | digest, scope, timeout)
+    if votes is None:
+        raise liveness.AgreementError(
+            "no usable DCN agreement channel for the join vote; "
+            "admission deferred (joiners retained)")
+    span = 1 << _DIGEST_BITS
+    uid_floor = max(int(v) >> _DIGEST_BITS for v in votes.values())
+    if len(votes) >= nproc and all(int(v) % span == digest
+                                   for v in votes.values()):
+        # unanimity observed locally. Make the decision DURABLE before
+        # acting on it: the commit marker is what a peer whose own
+        # collection timed out (vote-arrival skew around the deadline)
+        # reads to admit the SAME decision instead of deferring — the
+        # atomic-commit step that keeps "deferral, never divergence"
+        # true across processes, not just within one. The marker packs
+        # the agreed uid_floor above the digest (every committer holds
+        # ALL votes, so every committer computes the same value), so a
+        # follower with a partial vote set still aligns its counter.
+        if not multihost.publish_join_commit(
+                scope, (uid_floor << _DIGEST_BITS) | digest):
+            raise liveness.AgreementError(
+                "join vote unanimous but the commit marker could not "
+                "be published; admission deferred (joiners retained)")
+        return dict(method="dcn-kv", participants=len(votes),
+                    responders=sorted(int(p) for p in votes),
+                    round=rnd, uid_floor=uid_floor)
+    # not unanimous from HERE — but a peer that collected every vote in
+    # time may already have committed this round's admission; follow
+    # the durable decision rather than splitting the world
+    committed = multihost.read_join_commit(scope, min(timeout, 1.0))
+    if committed is not None and int(committed) % span == digest:
+        return dict(method="dcn-kv-commit", participants=len(votes),
+                    responders=sorted(int(p) for p in votes),
+                    round=rnd,
+                    uid_floor=max(uid_floor,
+                                  int(committed) >> _DIGEST_BITS))
+    raise liveness.AgreementError(
+        "join vote not unanimous within TEMPI_GROW_AGREE_TIMEOUT_S and "
+        "no peer committed it; admission deferred (an abstention "
+        "defers, never diverges)")
+
+
+# -- grow ----------------------------------------------------------------------
+
+
+def _dead_slots(comm) -> Dict[int, tuple]:
+    """``id(device) -> (device, ancestor lib rank)`` for every rank this
+    communicator's ancestry declared DEAD — the rejoin-detection map: a
+    joiner contributing one of these devices is a replacement reoccupying
+    that slot, so its pinned ``rank_failed`` breakers must reset."""
+    out: Dict[int, tuple] = {}
+    node = comm
+    while node is not None:
+        for lr in getattr(node, "dead_ranks", frozenset()) or ():
+            dev = node.devices[lr]
+            out.setdefault(id(dev), (dev, int(lr)))
+        node = getattr(node, "parent", None)
+    return out
+
+
+def grow(comm):
+    """``MPI_Comm_spawn``-in-spirit, shrink-in-reverse (``api.grow``):
+    admit every pending joiner of ``comm`` and build a NEW communicator
+    over the enlarged world. Returns the new
+    :class:`~tempi_tpu.parallel.communicator.Communicator` (or ``None``
+    when there was nothing to admit or the admission deferred); the
+    decision record lands in the ledger (``api.elastic_snapshot``). A
+    deferred
+    admission (chaos at ``elastic.admit``, channel loss, non-unanimous
+    vote) returns ``None`` with the joiners retained — the frozen world
+    is never half-enlarged. Requires ``TEMPI_ELASTIC=grow``, a
+    communicator with NO dead ranks (``api.shrink`` first — grow
+    re-expands a compacted survivor world), and an epoch boundary (no
+    operations in flight)."""
+    _require_enabled("api.grow")
+    from ..parallel import partition as part_mod
+    from ..parallel import topology as topo_mod
+    from ..parallel import communicator as comm_mod
+    t0 = time.monotonic()
+    if comm.freed:
+        raise RuntimeError("grow() on a freed communicator")
+    if comm.dead_ranks:
+        raise RuntimeError(
+            f"grow: communicator has dead rank(s) "
+            f"{sorted(comm.dead_ranks)} — api.shrink(comm) first (grow "
+            "re-expands a compacted survivor world, it does not resurrect "
+            "a revoked rank in place)")
+    with _lock:
+        reqs = list(_pending.get(comm, ()))
+    if not reqs:
+        ctr.counters.elastic.num_no_joiners += 1
+        _ledger_append(dict(kind="grow", outcome="no_joiners",
+                            comm_uid=comm.uid, size=comm.size))
+        return None
+    # epoch-boundary check BEFORE the vote: every process's pending list
+    # is SPMD-aligned, so checking here makes a caller error raise
+    # SYMMETRICALLY on all survivors before any of them consumes a vote
+    # round — a post-vote raise on one process while the others enlarge
+    # would be exactly the divergence the vote exists to prevent
+    with comm._progress_lock:
+        if comm._pending:
+            raise RuntimeError(
+                f"grow: {len(comm._pending)} operation(s) still in "
+                "flight on the communicator — complete (waitall) or "
+                "cancel them first; grow is an epoch-boundary step")
+    try:
+        if faults.ENABLED:
+            # BEFORE the vote: a raise defers the WHOLE admission —
+            # joiners stay pending, nothing mutates, the next grow
+            # retries (the ft.agree deferral contract)
+            faults.check("elastic.admit")
+        prov = _agree_admit(comm, reqs)
+    except (liveness.AgreementError, faults.InjectedFault) as e:
+        ctr.counters.elastic.num_admit_deferred += 1
+        if obstrace.ENABLED:
+            obstrace.emit("elastic.deferred", stage="admit",
+                          comm_uid=comm.uid,
+                          devices=sum(len(r.devices) for r in reqs))
+        _ledger_append(dict(kind="grow", outcome="deferred",
+                            comm_uid=comm.uid, size=comm.size,
+                            error=repr(e)[:200]))
+        log.warn(f"elastic admission deferred; joiners retained: {e}")
+        return None
+    joiner_devices = [d for req in reqs for d in req.devices]
+    join_age_s = time.monotonic() - min(r.announced_at for r in reqs)
+    dead_slots = _dead_slots(comm)
+    with comm._progress_lock:
+        if comm._pending:
+            # raced between the pre-vote check and admission: still a
+            # caller error (the epoch-boundary contract), re-checked so
+            # construction can never interleave with live traffic
+            raise RuntimeError(
+                f"grow: {len(comm._pending)} operation(s) still in "
+                "flight on the communicator — complete (waitall) or "
+                "cancel them first; grow is an epoch-boundary step")
+        k_old = comm.size
+        devices = list(comm.devices) + joiner_devices
+        k = len(devices)
+        # uid alignment (SPMD contract): the admission vote carried
+        # every participant's creation-ordinal counter and uid_floor is
+        # their MAX — a joiner (or lagging survivor) fast-forwards to it
+        # BEFORE constructing, so the enlarged communicator gets the
+        # SAME uid everywhere and later agreement keys
+        # (session/uid/round) can never collide across the epoch
+        next_uid = comm_mod.sync_uid(prov["uid_floor"])
+        new_topo = topo_mod.discover(devices)
+        # seed: survivors keep their installed slots, joiners take the
+        # fresh ones — the re-partition can only refine what is running
+        seed = np.asarray(
+            [comm.library_rank(a) for a in range(k_old)]
+            + list(range(k_old, k)), dtype=np.int64)
+        graph = edges = None
+        placement = None
+        if comm.graph is not None and comm.graph_edges is not None:
+            # adjacency carries over; new ranks join with EMPTY
+            # neighborhoods (the application declares their traffic by
+            # rebuilding its dist-graph when it is ready — an empty
+            # neighborhood is correct, an invented one is not)
+            graph = {a: (list(s), list(d))
+                     for a, (s, d) in comm.graph.items()}
+            for a in range(k_old, k):
+                graph[a] = ([], [])
+            edges = dict(comm.graph_edges)
+            if edges and k > 1:
+                from ..parallel.dist_graph import _to_csr
+                slot_of, obj = part_mod.process_mapping(
+                    _to_csr(edges, k), new_topo.distance_matrix(),
+                    extra_starts=(seed,))
+                if list(slot_of) != list(range(k)):
+                    placement = topo_mod.Placement.from_slot_of(slot_of)
+                log.debug(f"grow re-placement objective = {obj}")
+        if placement is None and list(seed) != list(range(k)):
+            # no graph to re-partition over: carry the inherited locality
+            placement = topo_mod.Placement.from_slot_of(seed)
+        new = comm_mod.Communicator(devices, placement=placement,
+                                    graph=graph, parent=comm,
+                                    topology=new_topo)
+        if edges is not None:
+            new.graph_edges = edges
+        # the parent stays alive for old-world traffic, but its cached
+        # plans embed a world that is no longer THE world; recompile
+        # clean on next use
+        comm.invalidate_plans()
+    # rejoins: a joiner device reoccupying a slot an ancestor declared
+    # dead resets that slot's pinned rank_failed breakers — the dead
+    # link's history is not evidence about the replacement's hardware
+    rejoined = []
+    unpinned = 0
+    for d in joiner_devices:
+        hit = dead_slots.get(id(d))
+        if hit is not None:
+            rejoined.append(hit[1])
+            unpinned += health.unpin_rank(hit[1])
+    if rejoined:
+        ctr.counters.elastic.num_rejoins += len(rejoined)
+        ctr.counters.elastic.num_breakers_unpinned += unpinned
+    if liveness.ENABLED:
+        # admitted ranks start CLEAN: heartbeat stamped now, suspicion
+        # zeroed — pre-failure evidence cannot instantly re-convict the
+        # replacement (ISSUE 13 satellite; covered in tests/test_ft.py)
+        liveness.note_admit(
+            new, [new.library_rank(a) for a in range(k_old, k)])
+    with _lock:
+        # retire ONLY the snapshotted requests: a joiner announced while
+        # the admission vote was in flight is not part of this verdict —
+        # it stays pending (on the parent, which may grow again) instead
+        # of being silently discarded
+        cur = _pending.get(comm)
+        if cur is not None:
+            left = [r for r in cur if all(r is not q for q in reqs)]
+            if left:
+                _pending[comm] = left
+            else:
+                _pending.pop(comm, None)
+    ctr.counters.elastic.num_grows += 1
+    ctr.counters.elastic.num_admitted += len(joiner_devices)
+    # grow trigger of the shared plan-invalidation contract
+    # (runtime/invalidation.py): ONE bump and every persistent handle —
+    # PersistentColl, p2p _PersistentBatch, PersistentStep — re-validates
+    # before its next start. No per-subsystem plumbing.
+    from . import invalidation
+    invalidation.bump(
+        "grow", f"comm uid {comm.uid} -> {new.uid} size {k_old}->{k}")
+    grow_s = time.monotonic() - t0
+    entry = dict(kind="grow", outcome="admitted", comm_uid=comm.uid,
+                 new_uid=new.uid, next_uid=next_uid, parent_size=k_old,
+                 size=k, admitted=[str(d) for d in joiner_devices],
+                 rejoined_slots=sorted(rejoined),
+                 breakers_unpinned=unpinned, join_age_s=join_age_s,
+                 grow_s=grow_s, provenance=dict(prov))
+    _ledger_append(entry)
+    if obstrace.ENABLED:
+        obstrace.emit("elastic.admit", comm_uid=comm.uid,
+                      admitted=len(joiner_devices),
+                      rejoined=len(rejoined),
+                      method=prov.get("method"))
+        obstrace.emit("elastic.grow", comm_uid=comm.uid,
+                      new_uid=new.uid, parent_size=k_old, size=k)
+    log.warn(f"grow: {k_old}-rank communicator re-expanded to {k} "
+             f"(admitted {len(joiner_devices)} device(s)"
+             + (f", rejoined dead slot(s) {sorted(rejoined)}, "
+                f"{unpinned} pinned breaker(s) reset" if rejoined else "")
+             + ")")
+    return new
+
+
+# -- introspection -------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Diagnostic snapshot (``api.elastic_snapshot``): mode and knobs,
+    pending joiners per communicator, and the bounded join/admit ledger.
+    Pure data — safe to serialize. Callable before init and after
+    finalize (reads empty)."""
+    now = time.monotonic()
+    with _lock:
+        pending = []
+        for comm, reqs in list(_pending.items()):
+            pending.append(dict(
+                comm_uid=comm.uid, size=comm.size,
+                joiners=[dict(devices=[str(d) for d in r.devices],
+                              age_s=float(now - r.announced_at))
+                         for r in reqs]))
+        return dict(
+            mode=MODE,
+            grow_agree_timeout_s=float(
+                getattr(envmod.env, "grow_agree_timeout_s", 5.0)),
+            entries=_ledger_entries,
+            pending=pending,
+            ledger=[dict(e) for e in _ledger])

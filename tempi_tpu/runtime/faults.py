@@ -131,6 +131,20 @@ SITES = (
                           # would deadlock every survivor's verdict, the
                           # exact divergent-conclusions outcome agreement
                           # exists to prevent)
+    "elastic.join",       # each join announcement registration
+                          # (runtime/elastic.announce_join — fires
+                          # BEFORE anything pends: a raise DEFERS the
+                          # announcement whole, the registry never holds
+                          # a half-announced joiner and the caller
+                          # retries like any lost control message; wedge
+                          # refused like every non-engine site)
+    "elastic.admit",      # each grow admission vote
+                          # (runtime/elastic.grow — fires BEFORE the
+                          # vote: a raise DEFERS the admission, joiners
+                          # stay pending and the frozen world is never
+                          # half-enlarged, exactly the ft.agree deferral
+                          # contract; wedge refused — a wedged vote
+                          # would deadlock every survivor's grow)
     "step.replay",        # each PersistentStep.start() replay dispatch
                           # (coll/step.py — fires BEFORE any segment
                           # dispatches, so a raise leaves every buffer

@@ -95,6 +95,13 @@ class _Breaker:
     # rank-failure verdict (ISSUE 9): a dead rank's links are not flaky,
     # they are gone, and probing them would just burn wait deadlines
     pinned: bool = False
+    # WHY the breaker was pinned (force_open's reason), immutable for
+    # the pin's lifetime — unlike last_error, which later record_failure
+    # calls on the same link overwrite. unpin_rank (elastic rejoin,
+    # ISSUE 13) keys on THIS field: a pin whose provenance could be
+    # clobbered by one in-flight failure would quarantine the
+    # replacement's healthy link forever
+    pin_reason: str = ""
 
 
 _lock = locks.named_lock("health")
@@ -180,6 +187,7 @@ def force_open(peer: tuple, strategy: str, reason: str = "forced") -> None:
         opened = b.state != OPEN
         b.state = OPEN
         b.pinned = True
+        b.pin_reason = reason
         b.opened_at = time.monotonic()
         if opened:
             b.times_opened += 1
@@ -190,6 +198,41 @@ def force_open(peer: tuple, strategy: str, reason: str = "forced") -> None:
     if opened and obstrace.ENABLED:
         obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
                       forced=True, error=reason[:200])
+
+
+def unpin_rank(rank: int, reason: str = "rank_failed") -> int:
+    """A dead rank's slot was reoccupied by an admitted joiner (elastic
+    grow, runtime/elastic.py): every breaker force-opened PINNED with
+    ``reason`` on a link touching ``rank`` RESETS to a fresh closed
+    state — the entry is REMOVED, not half-opened. A half-open probe
+    would carry the dead link's failure history onto the replacement's
+    healthy hardware (first wobble re-opens instantly, with the
+    quarantine's full demotion cost); the old endpoint is gone, so its
+    evidence is too. Ordinary (unpinned, or differently-pinned) breakers
+    on the same links are untouched — live failure evidence about a
+    SURVIVOR stays. Returns how many breakers were reset.
+
+    Scope caveat: the registry's key space is the GLOBAL library-rank
+    pair, exactly as :func:`force_open` pins it — a sibling
+    communicator whose verdict named the same rank NUMBER shares these
+    keys by design (the pre-existing breaker-registry contract). A
+    rejoin therefore also lifts a same-numbered sibling's pins; that
+    sibling's dead rank still refuses fast through its own
+    ``comm.dead_ranks`` gate (liveness.check_alive), and its next
+    timeout re-pins the breakers."""
+    dropped = 0
+    with _lock:
+        for key in [k for k, b in _table.items()
+                    if rank in k[0] and b.pinned
+                    and b.pin_reason == reason]:
+            del _table[key]
+            dropped += 1
+        if dropped:
+            _recompute_flags_locked()
+    if dropped and obstrace.ENABLED:
+        obstrace.emit("breaker.unpin", rank=int(rank), reset=dropped,
+                      reason=reason[:200])
+    return dropped
 
 
 def record_success(peer: tuple, strategy: str) -> None:
@@ -301,7 +344,7 @@ def snapshot() -> dict:
                 consecutive_failures=b.consecutive, failures=b.failures,
                 successes=b.successes, times_opened=b.times_opened,
                 probes=b.probes, last_error=b.last_error,
-                pinned=b.pinned,
+                pinned=b.pinned, pin_reason=b.pin_reason,
                 # monotonic age of the CURRENT state (seconds since the
                 # last transition; 0 for a closed breaker that never
                 # transitioned) — open/half-open duration is what the

@@ -15,7 +15,11 @@ replay site:
     embeds the old app->library permutation;
   * a fault-tolerance death verdict (runtime/liveness.py) — pending work
     touching the dead rank can never complete and new starts must refuse
-    fast.
+    fast;
+  * an elastic grow (runtime/elastic.py, ISSUE 13) — the world
+    re-expanded around the communicator, so every replayable artifact
+    re-validates against the post-grow breaker/mapping/liveness state
+    before its next start.
 
 This module collapses them into ONE monotonic generation: every trigger
 calls :func:`bump` with its cause, and every replayable artifact
@@ -54,7 +58,10 @@ GENERATION = 0
 
 #: The trigger vocabulary (bookkeeping only — an unknown cause still
 #: bumps; the contract must fail open, never silently skip a trigger).
-CAUSES = ("breaker", "tune", "mapping", "ft")
+#: ``grow`` is the elastic re-expansion trigger (runtime/elastic.py,
+#: ISSUE 13): the world enlarged, so every replayable artifact
+#: re-validates before its next start.
+CAUSES = ("breaker", "tune", "mapping", "ft", "grow")
 
 _lock = locks.named_lock("invalidation")
 _by_cause: Dict[str, int] = {}

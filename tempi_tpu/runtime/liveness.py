@@ -362,6 +362,26 @@ def mark_failed(comm, rank: int) -> dict:
                 already=False, provenance=prov)
 
 
+def note_admit(comm, ranks: Sequence[int]) -> None:
+    """An elastic grow (runtime/elastic.py, ISSUE 13) admitted ``ranks``
+    (library ranks of the NEW communicator): stamp their heartbeats NOW
+    and zero any suspicion, so the replacement starts CLEAN — the
+    stale-heartbeat accelerant measures silence from the admit instant,
+    never from evidence the DEAD predecessor left behind, and a suspect
+    count can only grow from post-admit events. Callers guard with
+    ``liveness.ENABLED`` (the off path must not materialize registry
+    state for a world that records no liveness)."""
+    now = time.monotonic()
+    st = _state(comm)
+    with _lock:
+        for r in ranks:
+            r = int(r)
+            st.heartbeats[r] = now
+            st.suspect_counts.pop(r, None)
+            st.suspect_sources.pop(r, None)
+            st.dead.discard(r)
+
+
 def check_alive(comm, *ranks: int) -> None:
     """Refuse-fast gate for new posts (``p2p._post``): any library rank in
     the communicator's dead set raises :class:`RankFailure` immediately —

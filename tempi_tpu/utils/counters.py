@@ -138,6 +138,25 @@ class FtCounters:
 
 
 @dataclass
+class ElasticCounters:
+    # elastic communicators (ISSUE 13; runtime/elastic.py): pinned at
+    # zero with TEMPI_ELASTIC unset — the counter-based byte-for-byte
+    # guard that the off path registers, votes, and rebuilds nothing
+    num_announced: int = 0       # join announcements registered
+    num_join_deferred: int = 0   # elastic.join chaos: announcements
+                                 # dropped whole (caller retries)
+    num_grows: int = 0           # enlarged communicators built
+    num_admitted: int = 0        # joiner devices admitted across grows
+    num_rejoins: int = 0         # admitted devices reoccupying a slot an
+                                 # ancestor declared dead
+    num_breakers_unpinned: int = 0  # rank_failed-pinned breakers RESET
+                                    # (not probed) by a rejoin
+    num_admit_deferred: int = 0  # admission votes failed/chaosed
+                                 # (joiners retained, next grow retries)
+    num_no_joiners: int = 0      # grow called with nothing pending
+
+
+@dataclass
 class StepCounters:
     # whole-step persistent schedules (ISSUE 12; coll/step.py): pinned at
     # zero when capture is unused — the counter-based byte-for-byte guard
@@ -192,6 +211,7 @@ class Counters:
     qos: QosCounters = field(default_factory=QosCounters)
     replace: ReplaceCounters = field(default_factory=ReplaceCounters)
     ft: FtCounters = field(default_factory=FtCounters)
+    elastic: ElasticCounters = field(default_factory=ElasticCounters)
     lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
 
     def as_dict(self) -> dict:

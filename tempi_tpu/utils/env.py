@@ -220,6 +220,28 @@ the README "Fault tolerance" section):
                          verdict; processes that do not vote within it
                          abstain (default 5)
 
+Elastic communicator knobs (ISSUE 13; see runtime/elastic.py and the
+README "Elastic communicators" section):
+  TEMPI_ELASTIC        = off | grow — grow/rank-rejoin, the inverse of
+                         shrink (default off = the api surface refuses
+                         with a pointer at this knob; no join registry,
+                         no counters, no trace events — byte-for-byte
+                         inert). ``grow`` arms ``api.announce_join``
+                         (register a joiner's devices as pending) and
+                         ``api.grow`` (vote the pending joiners in and
+                         rebuild an enlarged communicator at an epoch
+                         boundary, rediscovering topology, re-seeding
+                         the placement, and bumping the shared plan-
+                         invalidation generation with the ``grow``
+                         cause).
+  TEMPI_GROW_AGREE_TIMEOUT_S  budget for the multi-process (DCN)
+                         join-digest allgather backing an admission
+                         vote; the vote must be UNANIMOUS within it — a
+                         process that does not vote (or votes a
+                         different join set) DEFERS the admission, the
+                         joiners stay pending, and the next grow
+                         retries (default 5)
+
 Whole-step persistent schedule knobs (ISSUE 12; see coll/step.py and the
 README "Persistent steps" section):
   TEMPI_STEP           = on | off — the capture/replay machinery behind
@@ -361,6 +383,9 @@ KNOWN_KNOBS = (
     "TEMPI_FT_SUSPECT_TIMEOUTS",
     "TEMPI_FT_HEARTBEAT_S",
     "TEMPI_FT_AGREE_TIMEOUT_S",
+    # elastic communicators (ISSUE 13)
+    "TEMPI_ELASTIC",
+    "TEMPI_GROW_AGREE_TIMEOUT_S",
     # whole-step persistent schedules (ISSUE 12)
     "TEMPI_STEP",
     "TEMPI_STEP_FUSE",
@@ -517,6 +542,9 @@ class Environment:
     ft_suspect_timeouts: int = 2   # unmatched timeouts before suspicion
     ft_heartbeat_s: float = 0.0    # stale-heartbeat accelerant (0 = off)
     ft_agree_timeout_s: float = 5.0  # DCN agreement vote budget
+    # elastic communicators (ISSUE 13) — see runtime/elastic.py
+    elastic_mode: str = "off"      # off | grow
+    grow_agree_timeout_s: float = 5.0  # DCN join-admission vote budget
     # whole-step persistent schedules (ISSUE 12) — see coll/step.py
     step_mode: str = "on"          # on | off (off = replay degrades to
     #                                the eager per-step path, loudly)
@@ -854,6 +882,17 @@ class Environment:
         e.ft_heartbeat_s = _float_env("TEMPI_FT_HEARTBEAT_S", 0.0)
         e.ft_agree_timeout_s = _float_env("TEMPI_FT_AGREE_TIMEOUT_S", 5.0)
 
+        # elastic-communicator knobs parse loudly too: a typo'd
+        # TEMPI_ELASTIC silently staying off would hand the one
+        # deployment that asked for grow/rejoin the restart-the-world
+        # behavior the mode exists to remove
+        el = (getenv("TEMPI_ELASTIC") or "off").lower()
+        if el not in ("off", "grow"):
+            raise ValueError(f"bad TEMPI_ELASTIC={el!r}: want off | grow")
+        e.elastic_mode = el
+        e.grow_agree_timeout_s = _float_env("TEMPI_GROW_AGREE_TIMEOUT_S",
+                                            5.0)
+
         # step knobs parse loudly too: a typo'd TEMPI_STEP silently
         # staying on would replay a compiled step in the one run that
         # asked for the eager A/B baseline (and vice versa)
@@ -911,6 +950,9 @@ class Environment:
             # ...and the liveness layer: the underlying library has no
             # rank-failure semantics to emulate
             e.ft_mode = "off"
+            # ...and the elastic layer for the same reason: no grow/
+            # rejoin semantics exist beneath the interposition
+            e.elastic_mode = "off"
             # ...and step replay: captured steps degrade to the eager
             # re-issue path — the bail-out measures the baseline engine,
             # not the framework's fused replay

@@ -53,6 +53,10 @@ def pytest_configure(config):
         "revocation, shrink (the <30s smoke is `pytest -m ft`)")
     config.addinivalue_line(
         "markers",
+        "elastic: elastic-communicator tests — join announcement, grow "
+        "admission, rank rejoin (the <30s smoke is `pytest -m elastic`)")
+    config.addinivalue_line(
+        "markers",
         "analysis: contract-linter + lock-order checker tests (the <30s "
         "smoke is `pytest -m analysis`, incl. the self-run on the repo)")
     config.addinivalue_line(
@@ -70,7 +74,7 @@ def _reset_globals():
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.parallel import replacement
-    from tempi_tpu.runtime import faults, health, liveness, qos
+    from tempi_tpu.runtime import elastic, faults, health, liveness, qos
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env, locks
 
@@ -84,6 +88,7 @@ def _reset_globals():
     qos.configure()
     replacement.configure()
     liveness.configure()
+    elastic.configure()
     counters.init()
     health.reset()
     yield
@@ -99,4 +104,5 @@ def _reset_globals():
     qos.disarm()
     replacement.configure("off")
     liveness.configure("off")
+    elastic.configure("off")
     locks.configure("off")

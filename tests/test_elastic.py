@@ -1,0 +1,644 @@
+"""Elastic-communicator suite (ISSUE 13; runtime/elastic.py).
+
+The FT layer (ISSUE 9) closes half the churn loop — detect, agree,
+revoke, shrink. This suite pins the other half: a joiner announces
+itself (``api.announce_join``), the survivors vote it in
+(``api.grow``), the world re-expands over rediscovered topology with
+the placement seeded from the installed mapping, a rejoining device's
+``rank_failed``-pinned breakers reset (not probe), the SPMD uid
+ordinal stays aligned across the epoch boundary, and every persistent
+handle re-validates through ONE bump of the shared invalidation
+generation. Chaos at ``elastic.join``/``elastic.admit`` DEFERS — the
+frozen world is never half-enlarged — and the off path is inert and
+counter-pinned byte-for-byte."""
+
+import contextlib
+import time
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+from tempi_tpu.parallel import communicator as comm_mod
+from tempi_tpu.runtime import elastic, faults, health, invalidation
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.elastic
+
+TY = lambda: dt.contiguous(64, dt.BYTE)  # noqa: E731
+
+
+@contextlib.contextmanager
+def _world(monkeypatch, **env):
+    """An initialized world with the elastic (and FT, for the churn
+    stories) knobs armed; value None deletes the variable."""
+    defaults = dict(TEMPI_ELASTIC="grow", TEMPI_FT="shrink",
+                    TEMPI_WAIT_TIMEOUT_S="0.3",
+                    TEMPI_FT_SUSPECT_TIMEOUTS="1")
+    defaults.update(env)
+    for k, v in defaults.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+    comm = api.init()  # re-reads env and configures elastic + liveness
+    try:
+        yield comm
+    finally:
+        api.finalize()
+
+
+def _fill(comm, value):
+    return comm.buffer_from_host(
+        [np.full(64, value, np.uint8) for _ in range(comm.size)])
+
+
+def _sub_comm(world, n):
+    """A derived communicator over the first ``n`` world devices — the
+    shrunk-world stand-in grow re-expands in tests that do not need a
+    real verdict first."""
+    return comm_mod.Communicator(world.devices[:n])
+
+
+def _exchange_ok(comm, value=9):
+    s, r = _fill(comm, value), comm.alloc(64)
+    p2p.waitall([p2p.isend(comm, 0, s, 1, TY()),
+                 p2p.irecv(comm, 1, r, 0, TY())])
+    np.testing.assert_array_equal(r.get_rank(1),
+                                  np.full(64, value, np.uint8))
+
+
+def _verify_a2av(comm):
+    """Persistent alltoallv on ``comm``, byte-verified against the dense
+    reference pattern (every rank sends its rank+1 to everyone else)."""
+    k = comm.size
+    counts = np.full((k, k), 8, np.int64)
+    np.fill_diagonal(counts, 0)
+    disp = np.tile(np.arange(k) * 8, (k, 1))
+    sb = comm.buffer_from_host(
+        [np.full(k * 8, r + 1, np.uint8) for r in range(k)])
+    rb = comm.alloc(k * 8)
+    pc = api.alltoallv_init(comm, sb, counts, disp, rb, counts.T, disp)
+    pc.start(); pc.wait()
+    for r in range(k):
+        expect = np.repeat(np.arange(1, k + 1), 8).astype(np.uint8)
+        expect[r * 8:(r + 1) * 8] = 0
+        np.testing.assert_array_equal(rb.get_rank(r), expect)
+    pc.free()
+
+
+# -- knob parsing --------------------------------------------------------------
+
+
+def test_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_ELASTIC", "spawn")
+    with pytest.raises(ValueError, match="TEMPI_ELASTIC="):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_ELASTIC", "grow")
+    monkeypatch.setenv("TEMPI_GROW_AGREE_TIMEOUT_S", "-1")
+    with pytest.raises(ValueError, match="TEMPI_GROW_AGREE_TIMEOUT_S"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_GROW_AGREE_TIMEOUT_S", "later")
+    with pytest.raises(ValueError, match="TEMPI_GROW_AGREE_TIMEOUT_S"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_GROW_AGREE_TIMEOUT_S", "2.5")
+    e = envmod.read_environment()
+    assert (e.elastic_mode, e.grow_agree_timeout_s) == ("grow", 2.5)
+
+
+def test_tempi_disable_forces_elastic_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_ELASTIC", "grow")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    assert envmod.read_environment().elastic_mode == "off"
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="bad TEMPI_ELASTIC mode"):
+        elastic.configure("shrink")
+
+
+# -- off path: inert and counter-pinned ---------------------------------------
+
+
+def test_off_path_is_inert_and_counter_pinned(monkeypatch):
+    """With TEMPI_ELASTIC unset: the api surface refuses with a pointer
+    at the knob, no registry state materializes, no elastic counters
+    move, and no elastic trace events land — the byte-for-byte guard
+    (counter + trace + choice identity) the acceptance criteria pin."""
+    with _world(monkeypatch, TEMPI_ELASTIC=None, TEMPI_FT=None,
+                TEMPI_WAIT_TIMEOUT_S=None, TEMPI_FT_SUSPECT_TIMEOUTS=None,
+                TEMPI_TRACE="flight") as comm:
+        assert not elastic.ENABLED
+        _exchange_ok(comm, 7)
+        with pytest.raises(RuntimeError, match="TEMPI_ELASTIC is off"):
+            api.announce_join(comm, [comm.devices[0]])
+        with pytest.raises(RuntimeError, match="TEMPI_ELASTIC is off"):
+            api.grow(comm)
+        assert all(v == 0
+                   for v in api.counters_snapshot()["elastic"].values())
+        snap = api.elastic_snapshot()
+        assert snap["mode"] == "off"
+        assert snap["pending"] == [] and snap["ledger"] == []
+        assert not any(e.get("name", "").startswith("elastic.")
+                       for e in api.trace_snapshot())
+
+
+# -- announce ------------------------------------------------------------------
+
+
+def test_announce_validation(monkeypatch):
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        with pytest.raises(ValueError, match="no devices"):
+            api.announce_join(sub, [])
+        with pytest.raises(ValueError, match="already members"):
+            api.announce_join(sub, [sub.devices[0]])
+        # a duplicate INSIDE one call would alias one physical device to
+        # two library ranks of the grown mesh — refused like a member
+        with pytest.raises(ValueError, match="duplicate device"):
+            api.announce_join(sub, [world.devices[6], world.devices[6]])
+        out = api.announce_join(sub, [world.devices[6]])
+        assert out["outcome"] == "announced"
+        assert elastic.pending_joiners(sub) == 1
+        # a duplicate announcement coalesces instead of double-pending
+        again = api.announce_join(sub, [world.devices[6]])
+        assert again["outcome"] == "already_pending"
+        assert elastic.pending_joiners(sub) == 1
+        assert api.counters_snapshot()["elastic"]["num_announced"] == 1
+        sub.free()
+        with pytest.raises(RuntimeError, match="freed"):
+            api.announce_join(sub, [world.devices[7]])
+
+
+def test_grow_without_joiners_is_a_recorded_noop(monkeypatch):
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        assert api.grow(sub) is None
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_no_joiners"] == 1 and c["num_grows"] == 0
+        assert api.elastic_snapshot()["ledger"][-1]["outcome"] == \
+            "no_joiners"
+
+
+# -- grow ----------------------------------------------------------------------
+
+
+def test_grow_admits_new_device(monkeypatch):
+    """Pure growth (no failure anywhere): a brand-new device joins a
+    6-rank world; the enlarged communicator exchanges byte-exact and the
+    ledger carries the admission provenance."""
+    with _world(monkeypatch, TEMPI_TRACE="flight") as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        grown = api.grow(sub)
+        assert grown is not None and grown.size == 7
+        assert grown.parent is sub
+        assert elastic.pending_joiners(sub) == 0
+        _exchange_ok(grown)
+        _verify_a2av(grown)
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_grows"] == 1 and c["num_admitted"] == 1
+        assert c["num_rejoins"] == 0 and c["num_breakers_unpinned"] == 0
+        led = api.elastic_snapshot()["ledger"][-1]
+        assert led["outcome"] == "admitted"
+        assert led["parent_size"] == 6 and led["size"] == 7
+        assert led["provenance"]["method"] == "in-process"
+        names = [e.get("name") for e in api.trace_snapshot()]
+        for ev in ("elastic.join", "elastic.admit", "elastic.grow"):
+            assert ev in names
+
+
+def test_grow_refuses_dead_ranks_with_shrink_pointer(monkeypatch):
+    with _world(monkeypatch) as comm:
+        api.mark_failed(comm, comm.size - 1)
+        with pytest.raises(RuntimeError, match="api.shrink"):
+            api.grow(comm)
+
+
+def test_grow_refuses_inflight_ops_and_retains_joiners(monkeypatch):
+    """The epoch-boundary refusal is a caller error (raise), not a
+    deferral — and it must leave the pending joiners intact so the
+    caller can drain and retry."""
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        s = _fill(sub, 1)
+        req = p2p.isend(sub, 0, s, 1, TY())
+        with pytest.raises(RuntimeError, match="epoch-boundary"):
+            api.grow(sub)
+        assert elastic.pending_joiners(sub) == 1
+        p2p.cancel([req])
+        assert api.grow(sub).size == 7
+
+
+def test_grow_dist_graph_carries_adjacency(monkeypatch):
+    """A dist-graph parent's declared adjacency carries over; the new
+    rank joins with an EMPTY neighborhood (its traffic is declared by
+    the application, never invented), and the placement re-partition is
+    seeded with the installed mapping."""
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        k = sub.size
+        ring_s = [[(r - 1) % k] for r in range(k)]
+        ring_d = [[(r + 1) % k] for r in range(k)]
+        g = api.dist_graph_create_adjacent(sub, ring_s, ring_d,
+                                           reorder=False)
+        api.announce_join(g, [world.devices[6]])
+        grown = api.grow(g)
+        assert grown.size == 7
+        assert sorted(grown.graph) == list(range(7))
+        assert grown.graph[6] == ([], [])
+        assert grown.graph[2] == ([1], [3])  # survivors' ring intact
+        assert grown.graph_edges == g.graph_edges
+        _exchange_ok(grown)
+
+
+def test_grow_invalidation_cause_and_persistent_revalidate(monkeypatch):
+    """ONE bump of the shared generation with the ``grow`` cause: a
+    persistent collective compiled on the PARENT before the grow
+    re-validates (one int compare + trigger re-walk) and replays
+    byte-exact — no per-subsystem plumbing, no stale refusal."""
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        k = sub.size
+        counts = np.full((k, k), 8, np.int64)
+        np.fill_diagonal(counts, 0)
+        disp = np.tile(np.arange(k) * 8, (k, 1))
+        sb = sub.buffer_from_host(
+            [np.full(k * 8, r + 1, np.uint8) for r in range(k)])
+        rb = sub.alloc(k * 8)
+        pc = api.alltoallv_init(sub, sb, counts, disp, rb, counts.T, disp)
+        pc.start(); pc.wait()
+        before = invalidation.snapshot()["by_cause"].get("grow", 0)
+        api.announce_join(sub, [world.devices[6]])
+        grown = api.grow(sub)
+        assert grown.size == 7
+        snap = invalidation.snapshot()
+        assert snap["by_cause"].get("grow", 0) == before + 1
+        assert any(d["cause"] == "grow" for d in snap["recent"])
+        # the parent handle survives the epoch: re-validates and replays
+        pc.start(); pc.wait()
+        for r in range(k):
+            expect = np.repeat(np.arange(1, k + 1), 8).astype(np.uint8)
+            expect[r * 8:(r + 1) * 8] = 0
+            np.testing.assert_array_equal(rb.get_rank(r), expect)
+
+
+def test_joiner_announced_mid_vote_is_retained(monkeypatch):
+    """A joiner that announces while the admission vote is in flight is
+    NOT part of that vote's verdict: the grow admits only the
+    snapshotted set and the late announcement stays pending (never
+    silently discarded) — the next grow picks it up."""
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        orig = elastic._agree_admit
+
+        def racing(comm, reqs):
+            out = orig(comm, reqs)
+            # arrives after the snapshot, during the (here: trivial)
+            # vote — the exact window a DCN vote holds open for seconds
+            api.announce_join(sub, [world.devices[7]])
+            return out
+
+        monkeypatch.setattr(elastic, "_agree_admit", racing)
+        grown = api.grow(sub)
+        monkeypatch.setattr(elastic, "_agree_admit", orig)
+        assert grown.size == 7  # only the voted-on joiner admitted
+        assert world.devices[6] in grown.devices
+        assert world.devices[7] not in grown.devices
+        assert elastic.pending_joiners(sub) == 1  # late joiner retained
+        grown2 = api.grow(sub)  # the next epoch admits it
+        assert grown2.size == 7
+        assert world.devices[7] in grown2.devices
+
+
+# -- uid alignment (ISSUE 13 satellite) ---------------------------------------
+
+
+def test_uid_monotone_across_shrink_grow(monkeypatch):
+    """The SPMD-aligned creation ordinal advances identically across the
+    whole shrink→grow cycle — KV agreement keys (scoped session/uid/
+    round) can never collide across the epoch boundary."""
+    with _world(monkeypatch) as comm:
+        api.mark_failed(comm, comm.size - 1)
+        shrunk = api.shrink(comm)
+        assert shrunk.uid > comm.uid
+        victim_dev = comm.devices[comm.library_rank(comm.size - 1)]
+        api.announce_join(shrunk, [victim_dev])
+        grown = api.grow(shrunk)
+        assert grown.uid > shrunk.uid > comm.uid
+        led = api.elastic_snapshot()["ledger"][-1]
+        assert led["new_uid"] == grown.uid
+        # the admit record carries the counter the joiner fast-forwards
+        # to; the uid actually minted must match it
+        assert led["next_uid"] == grown.uid
+
+
+def test_sync_uid_is_monotone_fast_forward_only():
+    """communicator.sync_uid: a joiner fast-forwards to the survivors'
+    counter; a floor at or below the live value is a no-op (a shared
+    ordinal must never rewind — a rewound counter would mint a uid an
+    older communicator still holds, colliding their agreement keys)."""
+    cur = comm_mod.peek_uid()
+    assert comm_mod.sync_uid(cur - 1) == cur      # rewind refused
+    assert comm_mod.sync_uid(0) == cur            # no-op floor
+    assert comm_mod.sync_uid(cur + 5) == cur + 5  # fast-forward
+    assert comm_mod.peek_uid() == cur + 5
+
+
+# -- breaker un-pinning (ISSUE 13 satellite) ----------------------------------
+
+
+def test_rejoin_resets_pinned_breakers(monkeypatch):
+    """The pin→admit→reset cycle: a verdict pins every breaker on the
+    dead rank's links with reason=rank_failed; a grow whose joiner
+    reoccupies that slot RESETS them to fresh closed state (no half-open
+    probe, no failure history) — while pins with other reasons and
+    ordinary open breakers on unrelated links survive untouched."""
+    with _world(monkeypatch) as comm:
+        size = comm.size
+        victim = size - 1
+        api.mark_failed(comm, victim)
+        lk = health.link(victim, 0)
+        assert health.state(lk, "device") == health.OPEN
+        assert health.allowed(lk, "device") is False  # pinned: no probe
+        # unrelated evidence that must SURVIVE the rejoin: a non-rank
+        # pin on a healthy link, and an ordinary (unpinned) open breaker
+        health.force_open(health.link(0, 1), "staged", reason="operator")
+        shrunk = api.shrink(comm)
+        api.announce_join(shrunk, [comm.devices[victim]])
+        grown = api.grow(shrunk)
+        assert grown.size == size
+        # every rank_failed pin on the victim's links is GONE — fresh
+        # closed state, zero recorded history, no half-open probe debt
+        for s in range(size - 1):
+            for strat in health.STRATEGIES:
+                assert health.state(health.link(victim, s),
+                                    strat) == health.CLOSED
+        snap = api.health_snapshot()
+        assert [b for b in snap["breakers"]
+                if b["pinned"] and b["last_error"] == "rank_failed"] == []
+        # the operator pin on (0, 1) survived
+        assert health.state(health.link(0, 1), "staged") == health.OPEN
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_rejoins"] == 1
+        assert c["num_breakers_unpinned"] == (size - 1) * len(
+            health.STRATEGIES)
+        assert api.elastic_snapshot()["ledger"][-1][
+            "rejoined_slots"] == [victim]
+
+
+def test_unpin_survives_last_error_overwrite(monkeypatch):
+    """Pin provenance is its own field: a failure recorded on a pinned
+    link (an exchange already in flight when the verdict landed)
+    overwrites ``last_error`` but must NOT hide the pin from the rejoin
+    — else the replacement's healthy link stays quarantined forever."""
+    with _world(monkeypatch) as comm:
+        victim = comm.size - 1
+        api.mark_failed(comm, victim)
+        lk = health.link(victim, 0)
+        # in-flight failure attribution lands on the pinned breaker and
+        # clobbers last_error — exactly what p2p's retry path records
+        health.record_failure(lk, "device", error="WaitTimeout: stuck")
+        snap = next(b for b in api.health_snapshot()["breakers"]
+                    if tuple(b["peer"]) == lk and b["strategy"] == "device")
+        assert snap["last_error"] != "rank_failed"  # overwritten...
+        assert snap["pin_reason"] == "rank_failed"  # ...but not the pin
+        shrunk = api.shrink(comm)
+        api.announce_join(shrunk, [comm.devices[victim]])
+        api.grow(shrunk)
+        assert health.state(lk, "device") == health.CLOSED  # still reset
+
+
+def test_multiprocess_vote_protocol_simulated(monkeypatch):
+    """The DCN admission protocol, simulated at the seam: (1) a partial
+    vote with NO commit marker defers; (2) a partial vote with a peer's
+    durable commit marker admits the SAME decision (digest checked, uid
+    floor inherited from the marker); (3) a unanimous vote publishes the
+    marker BEFORE acting and fast-forwards the uid counter to the max
+    across voters — the joiner/survivor alignment satellite, exercised
+    end to end without a second OS process."""
+    import jax
+
+    from tempi_tpu.parallel import multihost
+
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        with elastic._lock:
+            reqs = list(elastic._pending.get(sub, ()))
+        digest = elastic._join_digest(reqs)
+        bits = elastic._DIGEST_BITS
+        orig_pc = jax.process_count
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        committed = {}
+
+        def partial_votes(value, scope, timeout):
+            return {0: value}  # the peer's vote missed our deadline
+
+        # (1) skewed vote, no durable decision anywhere: DEFER
+        monkeypatch.setattr(multihost, "allgather_join_acks",
+                            partial_votes)
+        monkeypatch.setattr(multihost, "read_join_commit",
+                            lambda scope, budget: None)
+        assert api.grow(sub) is None
+        assert elastic.pending_joiners(sub) == 1
+        assert api.counters_snapshot()["elastic"]["num_admit_deferred"] \
+            == 1
+
+        # (2) same skew, but a peer that collected every vote committed:
+        # follow the durable decision — same digest, its uid floor
+        peer_floor = comm_mod.peek_uid() + 7
+        monkeypatch.setattr(
+            multihost, "read_join_commit",
+            lambda scope, budget: (peer_floor << bits) | digest)
+        grown = api.grow(sub)
+        assert grown is not None and grown.size == 7
+        assert grown.uid == peer_floor  # counter fast-forwarded
+        prov = api.elastic_snapshot()["ledger"][-1]["provenance"]
+        assert prov["method"] == "dcn-kv-commit"
+        assert prov["uid_floor"] == peer_floor
+
+        # (3) unanimous vote: the decision is made durable BEFORE any
+        # mutation, and the floor is the max across ALL voters
+        api.announce_join(sub, [world.devices[7]])
+        with elastic._lock:
+            reqs2 = list(elastic._pending.get(sub, ()))
+        digest2 = elastic._join_digest(reqs2)
+        peer2_floor = comm_mod.peek_uid() + 11
+
+        def unanimous(value, scope, timeout):
+            return {0: value, 1: (peer2_floor << bits) | digest2}
+
+        def publish(scope, decision):
+            committed[scope] = decision
+            return True
+
+        monkeypatch.setattr(multihost, "allgather_join_acks", unanimous)
+        monkeypatch.setattr(multihost, "publish_join_commit", publish)
+        grown2 = api.grow(sub)
+        assert grown2 is not None and grown2.size == 7
+        assert grown2.uid == peer2_floor
+        assert len(committed) == 1
+        (decision,) = committed.values()
+        assert decision % (1 << bits) == digest2
+        assert decision >> bits == peer2_floor
+        assert api.elastic_snapshot()["ledger"][-1]["provenance"][
+            "method"] == "dcn-kv"
+        monkeypatch.setattr(jax, "process_count", orig_pc)
+        _exchange_ok(grown2)
+
+
+# -- the churn acceptance story -----------------------------------------------
+
+
+def test_acceptance_churn_story(monkeypatch):
+    """The ISSUE 13 acceptance bench as a test: kill a rank (wedged —
+    its ops never post), detect via attributed timeouts, shrink, KEEP
+    SERVING on the survivor world, rejoin the replacement device, grow,
+    and run a byte-exact persistent alltoallv over the re-expanded
+    world — no restart anywhere."""
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="2") as comm:
+        size = comm.size
+        victim = size - 1
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, victim, TY())
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        with pytest.raises(api.RankFailure):
+            p2p.waitall([req])  # threshold crossed: verdict
+        assert comm.dead_ranks == frozenset({victim})
+        shrunk = api.shrink(comm)
+        assert shrunk.size == size - 1
+        _exchange_ok(shrunk, 3)  # the service keeps serving
+        # the replacement arrives: rejoin the dead slot's device
+        api.announce_join(shrunk, [comm.devices[comm.library_rank(
+            victim)]])
+        grown = api.grow(shrunk)
+        assert grown is not None and grown.size == size
+        assert grown.dead_ranks == frozenset()
+        _verify_a2av(grown)  # byte-exact over the re-expanded world
+        c = api.counters_snapshot()
+        assert c["ft"]["num_verdicts"] == 1
+        assert c["ft"]["num_shrinks"] == 1
+        assert c["elastic"]["num_grows"] == 1
+        assert c["elastic"]["num_rejoins"] == 1
+        kinds = [(e.get("kind"), e.get("outcome"))
+                 for e in api.elastic_snapshot()["ledger"]]
+        assert kinds == [("join", None), ("grow", "admitted")]
+
+
+# -- chaos (dual-marked for the -m faults smoke) ------------------------------
+
+
+@pytest.mark.faults
+def test_join_chaos_defers_announcement(monkeypatch):
+    """A raise at elastic.join DEFERS the announcement whole: nothing
+    pends, the counter records the drop, and a retry once the chaos
+    clears registers normally."""
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        faults.configure("elastic.join:raise:1.0:31")
+        out = api.announce_join(sub, [world.devices[6]])
+        assert out["outcome"] == "deferred"
+        assert elastic.pending_joiners(sub) == 0
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_join_deferred"] == 1 and c["num_announced"] == 0
+        faults.reset()
+        assert api.announce_join(
+            sub, [world.devices[6]])["outcome"] == "announced"
+        assert elastic.pending_joiners(sub) == 1
+
+
+@pytest.mark.faults
+def test_admit_chaos_defers_grow_never_diverges(monkeypatch):
+    """A raise at elastic.admit fails THE VOTE, never half-enlarges the
+    world: grow returns None, the joiners stay pending, the frozen
+    communicator is untouched, and the retried vote converges once the
+    chaos clears — the ft.agree deferral contract."""
+    with _world(monkeypatch, TEMPI_TRACE="flight") as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        faults.configure("elastic.admit:raise:1.0:43")
+        assert api.grow(sub) is None
+        assert sub.size == 6 and not sub.freed
+        assert elastic.pending_joiners(sub) == 1  # retained
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_admit_deferred"] == 1 and c["num_grows"] == 0
+        assert api.elastic_snapshot()["ledger"][-1]["outcome"] == \
+            "deferred"
+        assert any(e.get("name") == "elastic.deferred"
+                   for e in api.trace_snapshot())
+        _exchange_ok(sub, 5)  # the frozen world keeps serving meanwhile
+        faults.reset()
+        grown = api.grow(sub)  # retried vote converges
+        assert grown is not None and grown.size == 7
+        _exchange_ok(grown)
+
+
+@pytest.mark.faults
+def test_wedge_refused_at_elastic_sites():
+    """A wedged announcement blocks the operator thread; a wedged vote
+    would deadlock every survivor's grow. Both refuse the kind."""
+    for site in ("elastic.join", "elastic.admit"):
+        with pytest.raises(faults.FaultSpecError, match="wedge"):
+            faults.configure(f"{site}:wedge:1.0:1")
+
+
+@pytest.mark.faults
+def test_churn_chaos_variant(monkeypatch):
+    """The seeded elastic.join chaos churn: with chaos on the ft AND
+    elastic sites at once (votes failing half the time, announcements
+    dropping half the time), the kill→detect→shrink→rejoin→grow cycle
+    still converges — every deferral leaves the world exactly as it
+    was, never diverged or half-grown."""
+    with _world(monkeypatch, TEMPI_WAIT_TIMEOUT_S="0.15") as comm:
+        faults.configure("ft.agree:raise:0.5:7,elastic.join:raise:0.5:11,"
+                         "elastic.admit:raise:0.5:13")
+        size = comm.size
+        victim = size - 2
+        s = _fill(comm, 1)
+        req = p2p.isend(comm, 0, s, victim, TY())
+        deadline = time.monotonic() + 10.0
+        while not comm.dead_ranks and time.monotonic() < deadline:
+            with pytest.raises((p2p.WaitTimeout, api.RankFailure)):
+                p2p.waitall([req])
+        assert comm.dead_ranks == frozenset({victim})
+        shrunk = api.shrink(comm)
+        victim_dev = comm.devices[comm.library_rank(victim)]
+        grown = None
+        deadline = time.monotonic() + 10.0
+        while grown is None and time.monotonic() < deadline:
+            if elastic.pending_joiners(shrunk) == 0:
+                api.announce_join(shrunk, [victim_dev])  # may defer
+                continue
+            grown = api.grow(shrunk)  # may defer; never diverges
+            assert shrunk.size == size - 1 and not shrunk.freed
+        assert grown is not None and grown.size == size
+        faults.reset()
+        _exchange_ok(grown)
+        c = api.counters_snapshot()["elastic"]
+        assert c["num_grows"] == 1
+
+
+# -- registry lifecycle -------------------------------------------------------
+
+
+def test_snapshot_reads_empty_outside_sessions():
+    snap = api.elastic_snapshot()
+    assert snap["mode"] == "off"
+    assert snap["pending"] == [] and snap["ledger"] == []
+
+
+def test_ledger_resets_per_session(monkeypatch):
+    with _world(monkeypatch) as world:
+        sub = _sub_comm(world, 6)
+        api.announce_join(sub, [world.devices[6]])
+        assert api.elastic_snapshot()["entries"] == 1
+    # finalize reset the registry (per-session, like counters); a stale
+    # session's pending join can never leak into the next world
+    assert api.elastic_snapshot()["entries"] == 0
+    assert api.elastic_snapshot()["pending"] == []

@@ -414,6 +414,69 @@ def test_shrink_renumbers_dist_graph(monkeypatch):
                                       np.full(64, 1, np.uint8))
 
 
+def test_readmitted_rank_liveness_starts_clean(monkeypatch):
+    """ISSUE 13 satellite: a rank re-admitted by an elastic grow starts
+    CLEAN — heartbeat stamped at admit, suspect counters zeroed, not in
+    any dead set — so the pre-failure evidence that convicted its
+    predecessor (accumulated suspicion, a stale heartbeat) can never
+    instantly re-convict the replacement. With the stale-heartbeat
+    accelerant armed, a first timeout on the rejoined rank is ordinary
+    suspicion (count 1), not an immediate verdict."""
+    monkeypatch.setenv("TEMPI_ELASTIC", "grow")
+    with _world(monkeypatch, TEMPI_FT_SUSPECT_TIMEOUTS="3",
+                TEMPI_FT_HEARTBEAT_S="300") as comm:
+        size = comm.size
+        victim = size - 1
+        s = _fill(comm, 1)
+        # pre-failure evidence: the victim heartbeats once, then wedges
+        # and accumulates suspicion before the operator convicts it
+        r = comm.alloc(64)
+        p2p.waitall([p2p.isend(comm, 0, s, victim, TY()),
+                     p2p.irecv(comm, victim, r, 0, TY())])
+        req = p2p.isend(comm, 0, s, victim, TY(), tag=1)
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req])
+        assert api.ft_snapshot()["comms"][0]["suspects"] == {victim: 1}
+        p2p.cancel([req])
+        api.mark_failed(comm, victim)
+        shrunk = api.shrink(comm)
+        api.announce_join(shrunk, [comm.devices[comm.library_rank(
+            victim)]])
+        from tempi_tpu.runtime import elastic  # noqa: PLC0415
+        assert elastic.ENABLED
+        grown = api.grow(shrunk)
+        assert grown.size == size
+        # the grown comm's registry entry is CLEAN for the rejoined
+        # rank: heartbeat stamped at admit (age ~0), zero suspicion,
+        # empty dead set
+        snap = api.ft_snapshot()
+        entry = next(c for c in snap["comms"] if c["size"] == size
+                     and c["dead"] == [] and victim
+                     in c["heartbeat_age_s"])
+        assert entry["suspects"] == {}
+        assert entry["heartbeat_age_s"][victim] < 5.0
+        assert grown.dead_ranks == frozenset()
+        # first timeout on the replacement: ordinary suspicion, never an
+        # accelerated verdict off the admit-fresh heartbeat
+        req2 = p2p.isend(grown, 0, _fill(grown, 2), victim, TY(), tag=2)
+        with pytest.raises(p2p.WaitTimeout):
+            p2p.waitall([req2])
+        snap = api.ft_snapshot()
+        entry = next(c for c in snap["comms"] if c["size"] == size
+                     and c["dead"] == []
+                     and victim in c["heartbeat_age_s"])
+        assert entry["suspects"] == {victim: 1}
+        assert entry["suspect_sources"] == {victim: "wait-timeout"}
+        assert grown.dead_ranks == frozenset()
+        p2p.cancel([req2])
+        # ...and a completed exchange with the replacement clears it
+        r2 = grown.alloc(64)
+        p2p.waitall([p2p.isend(grown, 0, _fill(grown, 3), victim, TY(),
+                               tag=3),
+                     p2p.irecv(grown, victim, r2, 0, TY(), tag=3)])
+        assert api.ft_snapshot()["comms"][0]["suspects"] != {victim: 2}
+
+
 def test_acceptance_shrink_story(monkeypatch):
     """The ISSUE 9 acceptance story end-to-end: a permanently wedged
     victim rank is detected via attributed timeouts, all survivors agree
