@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Mixture-of-experts dispatch/combine workload (ISSUE 14 acceptance).
+
+The real-world shape the sparse/skewed alltoallv benches approximate:
+capacity-factor token routing. Every rank hosts one expert and T tokens;
+a router assigns each token an expert (``uniform`` — balanced — or
+``skewed`` — a zipf-like concentration on a few hot experts, the regime
+that stresses the skew-split and hierarchical machinery); each (rank,
+expert) lane is clipped at ``capacity = ceil(T * capacity_factor /
+num_experts)`` tokens. One step is then:
+
+  dispatch — alltoallv of the routed token bytes (counts[s, d] = clipped
+             tokens rank s routes to expert d x token bytes);
+  combine  — the return alltoallv (counts.T: every token goes home);
+  grads    — an allreduce of the expert-gradient accumulator (the
+             reduction half of the traffic, sized --grad-bytes).
+
+Measured one-shot (api.alltoallv + api.allreduce per step) vs persistent
+(`alltoallv_init` dispatch + combine handles and an `allreduce_init`
+handle, replayed per step), per routing pattern — and with
+`--ranks-per-node` the flat-vs-hier plan A/B on top (cpu-mesh-32 with
+`--ranks-per-node 4` is the judged shape). Per-pattern speedup lines
+print to stderr like bench_persistent_alltoallv's, and the nonzero
+counters (coll.* including coll.reduce_*) via _common.report_counters.
+
+CSV columns: pattern, mode (oneshot|persistent), hier (flat|hier|-),
+step_s, dispatch_bytes, dropped_tokens.
+"""
+
+import os
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def route(size, tokens, capacity, pattern, token_bytes, seed):
+    """The routing matrix of one pattern: counts[s, d] = bytes rank s
+    dispatches to expert d after the capacity clip, plus how many tokens
+    the clip dropped (the capacity-factor overflow the workload is named
+    for)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    if pattern == "uniform":
+        probs = np.full(size, 1.0 / size)
+    else:  # skewed: zipf-like mass on a few hot experts
+        probs = 1.0 / np.arange(1, size + 1) ** 1.5
+        probs /= probs.sum()
+        rng.shuffle(probs)
+    counts = np.zeros((size, size), np.int64)
+    for s in range(size):
+        assign = rng.choice(size, size=tokens, p=probs)
+        lane = np.bincount(assign, minlength=size)
+        counts[s] = np.minimum(lane, capacity)
+    dropped = tokens * size - int(counts.sum())
+    return counts * token_bytes, dropped
+
+
+def make_displs(counts):
+    import numpy as np
+
+    sd = np.zeros_like(counts)
+    rd = np.zeros_like(counts)
+    for r in range(counts.shape[0]):
+        sd[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rd[r] = np.concatenate([[0], np.cumsum(counts.T[r])[:-1]])
+    return sd, rd
+
+
+def main() -> int:
+    p = base_parser("MoE dispatch/combine workload")
+    p.add_argument("--tokens", type=int, default=256,
+                   help="tokens per rank per step")
+    p.add_argument("--token-bytes", type=int, default=64,
+                   help="bytes per routed token")
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--grad-bytes", type=int, default=1 << 16,
+                   help="expert-gradient accumulator reduced per step")
+    p.add_argument("--ranks-per-node", type=int, default=0,
+                   help="synthetic TEMPI_RANKS_PER_NODE topology enabling "
+                        "the flat-vs-hier A/B on a CPU mesh")
+    args = p.parse_args()
+    if args.ranks_per_node:
+        os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+    setup_platform(args)
+
+    import math
+
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import env as envmod
+
+    devices_or_die(2)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    capacity = math.ceil(args.tokens * args.capacity_factor / size)
+    hier_modes = ["flat"] + (["hier"] if comm.num_nodes > 1 else [])
+
+    rows = []
+    best = {}  # pattern -> {label: step trimean}
+    for pattern in ("uniform", "skewed"):
+        counts, dropped = route(size, args.tokens, capacity, pattern,
+                                args.token_bytes, seed=7)
+        sdispls, rdispls = make_displs(counts)
+        nb_s = max(1, int(counts.sum(1).max()))
+        nb_r = max(1, int(counts.sum(0).max()))
+        tok_out = comm.alloc(nb_s)   # routed tokens leaving each rank
+        tok_in = comm.alloc(nb_r)    # tokens arriving at each expert
+        tok_back = comm.alloc(nb_s)  # expert outputs returned home
+        grads = comm.alloc(args.grad_bytes)
+
+        def oneshot_step():
+            api.alltoallv(comm, tok_out, counts, sdispls, tok_in,
+                          counts.T, rdispls)                    # dispatch
+            api.alltoallv(comm, tok_in, counts.T, rdispls, tok_back,
+                          counts, sdispls)                      # combine
+            api.allreduce(comm, grads, dtype=np.float32, op="sum")
+            tok_back.data.block_until_ready()
+            grads.data.block_until_ready()
+
+        oneshot_step()  # compile/caches hot
+        r1 = benchmark(oneshot_step, **kw)
+        rows.append((pattern, "oneshot", "-", r1.trimean,
+                     int(counts.sum()), dropped))
+        best.setdefault(pattern, {})["oneshot"] = r1.trimean
+
+        for hmode in hier_modes:
+            envmod.env.coll_hier = hmode
+            pc_d = api.alltoallv_init(comm, tok_out, counts, sdispls,
+                                      tok_in, counts.T, rdispls)
+            pc_c = api.alltoallv_init(comm, tok_in, counts.T, rdispls,
+                                      tok_back, counts, sdispls)
+            pr_g = api.allreduce_init(comm, grads, dtype=np.float32,
+                                      op="sum")
+
+            def persistent_step():
+                pc_d.start(); pc_d.wait()
+                pc_c.start(); pc_c.wait()
+                pr_g.start(); pr_g.wait()
+                tok_back.data.block_until_ready()
+                grads.data.block_until_ready()
+
+            persistent_step()  # first start pays any lazy compile
+            r2 = benchmark(persistent_step, **kw)
+            rows.append((pattern, "persistent", hmode, r2.trimean,
+                         int(counts.sum()), dropped))
+            best[pattern][hmode] = r2.trimean
+            for h in (pc_d, pc_c, pr_g):
+                h.free()
+        envmod.env.coll_hier = "auto"
+
+    emit_csv(("pattern", "mode", "hier", "step_s", "dispatch_bytes",
+              "dropped_tokens"), rows)
+    # the per-pattern speedup report: persistent vs one-shot, hier vs flat
+    for pattern, arms in best.items():
+        one = arms.get("oneshot")
+        for hmode in hier_modes:
+            t = arms.get(hmode)
+            if one and t and t > 0:
+                print(f"moe speedup [{pattern}/{hmode}]: {one / t:.2f}x "
+                      f"persistent vs one-shot", file=sys.stderr)
+        if "flat" in arms and "hier" in arms and arms["hier"] > 0:
+            print(f"moe hier speedup [{pattern}]: "
+                  f"{arms['flat'] / arms['hier']:.2f}x "
+                  f"(flat {arms['flat']:.3e}s vs hier "
+                  f"{arms['hier']:.3e}s)", file=sys.stderr)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,8 +5,17 @@ Re-design of /root/reference/bin/bench_mpi_ireduce.cpp (a survey of the
 library's Ireduce on device buffers of doubles): times allreduce and
 root-reduce over the mesh for float32/int32 at 2^10..2^22 bytes (float64
 would need jax_enable_x64; the reduce layer refuses the silent downcast).
+
+`--persistent` grows the ISSUE 14 A/B columns: the same allreduce via
+`api.allreduce_init` handles, one row per algorithm family (ring vs
+halving, forced) — the per-algorithm µs columns
+bench_persistent_alltoallv prints for the alltoallv family. `--hier`
+additionally A/Bs the two-level reduction plan (needs a multi-node
+topology; pass `--ranks-per-node` on a CPU mesh). Per-algorithm speedup
+lines print to stderr; counters via _common.report_counters.
 """
 
+import os
 import sys
 
 from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
@@ -17,18 +26,39 @@ def main() -> int:
     p = base_parser("reduce survey", multirank=True)
     p.add_argument("--sizes", type=int, nargs="*",
                    default=[1 << k for k in range(10, 23, 4)])
+    p.add_argument("--persistent", action="store_true",
+                   help="add persistent-handle rows per algorithm "
+                        "(ring vs halving) next to the one-shot survey")
+    p.add_argument("--hier", action="store_true",
+                   help="add the two-level (reduce-to-leader / leader "
+                        "exchange / broadcast) plan rows; needs a "
+                        "multi-node topology (--ranks-per-node)")
+    p.add_argument("--ranks-per-node", type=int, default=0,
+                   help="synthetic TEMPI_RANKS_PER_NODE topology for the "
+                        "--hier A/B on a CPU mesh")
     args = p.parse_args()
+    if args.ranks_per_node:
+        # before api.init(): topology discovery reads the knob there
+        os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
     setup_platform(args)
 
     import numpy as np
 
     from tempi_tpu import api
+    from tempi_tpu.coll import reduce as redsched
     from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import env as envmod
 
     devices_or_die(2)
     comm = api.init()
     kw = bench_kwargs(args.quick)
+    if args.hier and comm.num_nodes < 2:
+        print("--hier needs a multi-node topology; pass --ranks-per-node",
+              file=sys.stderr)
+        return 2
+    algs = ["ring"] + (["halving"] if redsched.is_pow2(comm.size) else [])
     rows = []
+    speed = {}  # (kind, dtype, nbytes) -> {label: trimean}
     # float64 needs jax x64; the canonical on-TPU element types are surveyed
     for nbytes in args.sizes:
         for dtype in (np.float32, np.int32):
@@ -44,9 +74,45 @@ def main() -> int:
 
                 run()  # compile
                 r = benchmark(run, **kw)
-                rows.append((kind, np.dtype(dtype).name, nbytes, r.trimean,
-                             nbytes / r.trimean))
-    emit_csv(("op", "dtype", "bytes", "time_s", "Bps"), rows)
+                rows.append((kind, np.dtype(dtype).name, nbytes, "oneshot",
+                             r.trimean, nbytes / r.trimean))
+                key = (kind, np.dtype(dtype).name, nbytes)
+                speed.setdefault(key, {})["oneshot"] = r.trimean
+
+            if not args.persistent:
+                continue
+            # persistent A/B rows: one per forced algorithm family (the
+            # one-shot row above is the fused library baseline), plus the
+            # two-level plan under --hier
+            arms = [(a, "flat") for a in algs] \
+                + ([(a, "hier") for a in algs] if args.hier else [])
+            for alg, plan in arms:
+                envmod.env.redcoll = alg
+                envmod.env.coll_hier = "hier" if plan == "hier" else "flat"
+                pr = api.allreduce_init(comm, buf, dtype=dtype, op="sum")
+
+                def prun():
+                    pr.start()
+                    pr.wait()
+                    buf.data.block_until_ready()
+
+                prun()  # first start pays any lazy compile
+                r = benchmark(prun, **kw)
+                rows.append(("allreduce", np.dtype(dtype).name, nbytes,
+                             pr.method, r.trimean, nbytes / r.trimean))
+                key = ("allreduce", np.dtype(dtype).name, nbytes)
+                speed.setdefault(key, {})[pr.method] = r.trimean
+                pr.free()
+            envmod.env.redcoll = "auto"
+            envmod.env.coll_hier = "auto"
+    emit_csv(("op", "dtype", "bytes", "method", "time_s", "Bps"), rows)
+    for (kind, dt, nbytes), arms in speed.items():
+        one = arms.get("oneshot")
+        for label, t in sorted(arms.items()):
+            if label != "oneshot" and one and t > 0:
+                print(f"persistent speedup [{kind}/{dt}/{nbytes}B "
+                      f"{label}]: {one / t:.2f}x vs one-shot",
+                      file=sys.stderr)
     api.finalize()
     return 0
 

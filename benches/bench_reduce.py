@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""One-shot vs persistent reduction collectives, ring vs halving, and the
+flat-vs-hierarchical plan A/B (ISSUE 14).
+
+The persistent API (`api.allreduce_init` -> start/wait) pays algorithm
+choice, round-plan compilation, and lowering once; this bench measures
+that amortization against the one-shot `api.allreduce` dispatcher, per
+algorithm family, across buffer sizes — and with `--ranks-per-node` it
+grows the two-level A/B: the same allreduce compiled flat (ring/halving
+over the whole world) vs hierarchical (reduce-to-leader over ICI, leader
+exchange over DCN, broadcast back). cpu-mesh-32 with `--ranks-per-node 4`
+is the judged shape:
+
+    python bench_reduce.py --cpu --cpu-devices 32 --ranks-per-node 4 --quick
+
+CSV columns: kind, alg (fused|ring|halving|hier_*), mode
+(oneshot|persistent), bytes, setup_s, time_s. Per-algorithm and
+hier-vs-flat speedup lines print to stderr; nonzero counters — including
+the coll.reduce_* evidence that the round plans actually ran — print via
+benches/_common.report_counters.
+"""
+
+import os
+import sys
+import time
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+
+def main() -> int:
+    p = base_parser("one-shot vs persistent reduction collectives")
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[1 << 12, 1 << 16, 1 << 20])
+    p.add_argument("--algs", default="ring,halving",
+                   help="comma list over ring|halving to A/B as forced "
+                        "persistent algorithms (plus the fused library "
+                        "arm, always measured)")
+    p.add_argument("--ranks-per-node", type=int, default=0,
+                   help="synthetic TEMPI_RANKS_PER_NODE topology so a CPU "
+                        "mesh exercises the two-level reduction (0 = "
+                        "discover from the platform; also enables the "
+                        "hier-vs-flat A/B)")
+    args = p.parse_args()
+    if args.ranks_per_node:
+        # before api.init(): topology discovery reads the knob there
+        os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
+    setup_platform(args)
+
+    import numpy as np
+
+    from tempi_tpu import api
+    from tempi_tpu.coll import reduce as redsched
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import env as envmod
+
+    algs = [a.strip() for a in args.algs.split(",") if a.strip()]
+    for a in algs:
+        if a not in ("ring", "halving"):
+            print(f"bad --algs entry {a!r}: want ring|halving",
+                  file=sys.stderr)
+            return 2
+
+    devices_or_die(2)
+    comm = api.init()
+    size = comm.size
+    kw = bench_kwargs(args.quick)
+    if "halving" in algs and not redsched.is_pow2(size):
+        print(f"note: world size {size} is not a power of two — the "
+              "halving rows below measure the ring degradation",
+              file=sys.stderr)
+
+    rows = []
+    best = {}  # nbytes -> {label: trimean} for the speedup footer
+    for nbytes in args.sizes:
+        buf = comm.alloc(nbytes)
+
+        def oneshot():
+            api.allreduce(comm, buf, dtype=np.float32, op="sum")
+            buf.data.block_until_ready()
+
+        oneshot()  # compile/caches hot
+        r1 = benchmark(oneshot, **kw)
+        rows.append(("allreduce", "fused", "oneshot", nbytes, 0.0,
+                     r1.trimean))
+        best.setdefault(nbytes, {})["oneshot"] = r1.trimean
+
+        arms = [("fused", "flat")] \
+            + [(a, "flat") for a in algs] \
+            + ([(a, "hier") for a in algs] if comm.num_nodes > 1 else [])
+        for alg, plan in arms:
+            envmod.env.redcoll = "auto" if alg == "fused" else alg
+            envmod.env.coll_hier = "hier" if plan == "hier" else "flat"
+            t0 = time.perf_counter()
+            pr = api.allreduce_init(comm, buf, dtype=np.float32, op="sum")
+
+            def persistent():
+                pr.start()
+                pr.wait()
+                buf.data.block_until_ready()
+
+            persistent()  # first start pays any lazy compile
+            setup = time.perf_counter() - t0
+            r2 = benchmark(persistent, **kw)
+            rows.append(("allreduce", pr.method, "persistent", nbytes,
+                         setup, r2.trimean))
+            best[nbytes][f"{plan}:{pr.method}"] = r2.trimean
+            pr.free()
+        envmod.env.redcoll = "auto"
+        envmod.env.coll_hier = "auto"
+
+    emit_csv(("kind", "alg", "mode", "bytes", "setup_s", "time_s"), rows)
+    # the acceptance ratios: per-algorithm persistent vs one-shot, and
+    # hierarchical vs the best flat round plan — >1 means faster
+    for nbytes, arms in best.items():
+        one = arms.get("oneshot")
+        for label, t in sorted(arms.items()):
+            if label != "oneshot" and one and t > 0:
+                print(f"persistent speedup [{nbytes}B {label}]: "
+                      f"{one / t:.2f}x vs one-shot", file=sys.stderr)
+        flat = [t for lbl, t in arms.items()
+                if lbl.startswith("flat:") and not lbl.endswith("fused")]
+        hier = [t for lbl, t in arms.items() if lbl.startswith("hier:")]
+        if flat and hier and min(hier) > 0:
+            print(f"hier speedup [{nbytes}B]: "
+                  f"{min(flat) / min(hier):.2f}x "
+                  f"(flat {min(flat):.3e}s vs hier {min(hier):.3e}s)",
+                  file=sys.stderr)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,6 +195,9 @@ def finalize() -> None:
         from .tune import online as tune_online
         tune_online.finalize()
         type_cache.clear()
+        from .parallel import reduce as reduce_mod
+        reduce_mod.clear_programs()  # a later session's backend may
+        # reuse device ids; stale programs must not be read back
         from .runtime import health, qos
         health.reset()  # breaker history is per-session, like counters
         qos.configure()  # api-armed QoS and the verdict ledger are
@@ -567,6 +570,33 @@ def neighbor_alltoallv_init(*args, **kwargs):
     """MPI_Neighbor_alltoallv_init analog over a dist-graph communicator's
     adjacency (matrix-expressible graphs only)."""
     from .coll.persistent import neighbor_alltoallv_init as _init
+    return _init(*args, **kwargs)
+
+
+def allreduce_init(*args, **kwargs):
+    """MPI 4.0 ``MPI_Allreduce_init`` direction (ISSUE 14): compile the
+    reduction once — ring/recursive-halving round plan (or the fused
+    library lowering, or the two-level hierarchy), AUTO-costed from the
+    measured sheet — and replay it with ``start()``/``wait()`` on the
+    returned ``PersistentReduce``. See coll/reduce.py and the README
+    "Reduction collectives" section."""
+    from .coll.persistent import allreduce_init as _init
+    return _init(*args, **kwargs)
+
+
+def reduce_scatter_init(*args, **kwargs):
+    """``MPI_Reduce_scatter_init`` direction (ISSUE 14): rank ``r`` ends
+    owning the reduced block ``r`` (ragged counts allowed); same
+    persistent start/wait/test/free surface and invalidation contract as
+    the other init APIs."""
+    from .coll.persistent import reduce_scatter_init as _init
+    return _init(*args, **kwargs)
+
+
+def allgather_init(*args, **kwargs):
+    """``MPI_Allgather_init`` direction (ISSUE 14; ragged = allgatherv):
+    every rank ends with the concatenation of every rank's block."""
+    from .coll.persistent import allgather_init as _init
     return _init(*args, **kwargs)
 
 

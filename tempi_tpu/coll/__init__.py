@@ -8,6 +8,10 @@ first, oversized messages chunk-split); :mod:`persistent` lowers the
 schedule onto the existing exchange machinery and replays it.
 """
 
-from .persistent import (PersistentColl, alltoallv_init,  # noqa: F401
-                         neighbor_alltoallv_init)
+from .persistent import (PersistentColl, PersistentReduce,  # noqa: F401
+                         allgather_init, allreduce_init, alltoallv_init,
+                         neighbor_alltoallv_init, reduce_scatter_init)
+from .reduce import (HierReduceSchedule, ReduceSchedule,  # noqa: F401
+                     compile_allgather, compile_allreduce,
+                     compile_hier_reduce, compile_reduce_scatter)
 from .schedule import Schedule, SMsg, compile_schedule  # noqa: F401

@@ -69,7 +69,9 @@ from ..utils import logging as log
 from ..utils.env import AlltoallvMethod
 from ..parallel import p2p, tags
 from ..parallel import plan as planmod
+from ..parallel import reduce as reduce_mod
 from ..parallel.communicator import Communicator, DistBuffer
+from . import reduce as redsched
 from .schedule import HierSchedule, Schedule, compile_hier_schedule, \
     compile_schedule
 
@@ -174,34 +176,40 @@ def _hier_estimate(hs: HierSchedule, sc: np.ndarray) -> float:
     return t
 
 
+def _tune_scale(est: Dict[str, float], underlying: Dict[str, str], lk,
+                colocated: bool, nbytes_rep: int) -> List[str]:
+    """The shared drift-proven blend loop of every collective tune
+    overlay: scale each method's swept estimate by its underlying
+    transport's learned evidence on the representative link. Only bins
+    the tuner has judged stale participate (the same evidence-scoping as
+    ``tune_model.adapt_choice``); the correction is a ratio, so a
+    transport observed 3x slower than its swept prediction prices its
+    methods 3x up. Returns the adjusted methods."""
+    stats = tune_online.bin_stats(lk, tune_online.size_bin(nbytes_rep),
+                                  tuple({underlying[m] for m in est}))
+    adjusted = []
+    for m in list(est):
+        st = stats.get(underlying[m])
+        if st is None or not st[2] or st[0] <= 0 or st[1] <= 0:
+            continue  # never observed / not drift-proven
+        pred = tune_model.predicted_seconds(underlying[m], nbytes_rep,
+                                            nbytes_rep, True, colocated)
+        if 0.0 < pred < math.inf and est[m] < math.inf:
+            est[m] = est[m] * tune_model.blend(pred, st[1], st[0]) / pred
+            adjusted.append(m)
+    return adjusted
+
+
 def _tune_overlay(comm: Communicator, sc: np.ndarray, remote: np.ndarray,
                   est: Dict[str, float]) -> List[str]:
-    """Scale the swept estimates by the drift-proven learned evidence of
-    each method's underlying transport on the REPRESENTATIVE link (the
-    largest pair — the message the batch-level p2p chooser keys on too).
-    Only bins the tuner has judged stale participate (the same
-    evidence-scoping as ``tune_model.adapt_choice``); the correction is a
-    ratio, so a transport observed 3x slower than its swept prediction
-    prices its collective methods 3x up. Returns the adjusted methods."""
+    """Alltoallv tune overlay: the representative link is the largest
+    pair — the message the batch-level p2p chooser keys on too."""
     s, d = np.unravel_index(int(np.argmax(sc)), sc.shape)
     nb = int(sc[s, d])
     if nb <= 0:
         return []
     lk = health.link(comm.library_rank(int(s)), comm.library_rank(int(d)))
-    colocated = not bool(remote[s, d])
-    stats = tune_online.bin_stats(lk, tune_online.size_bin(nb),
-                                  tuple({_UNDERLYING[m] for m in est}))
-    adjusted = []
-    for m in list(est):
-        st = stats.get(_UNDERLYING[m])
-        if st is None or not st[2] or st[0] <= 0 or st[1] <= 0:
-            continue  # never observed / not drift-proven
-        pred = tune_model.predicted_seconds(_UNDERLYING[m], nb, nb, True,
-                                            colocated)
-        if 0.0 < pred < math.inf and est[m] < math.inf:
-            est[m] = est[m] * tune_model.blend(pred, st[1], st[0]) / pred
-            adjusted.append(m)
-    return adjusted
+    return _tune_scale(est, _UNDERLYING, lk, not bool(remote[s, d]), nb)
 
 
 def _choose_method(comm: Communicator, sched: Schedule, sc: np.ndarray,
@@ -1019,3 +1027,689 @@ def neighbor_alltoallv_init(comm: Communicator, sendbuf: DistBuffer,
             "match the send counts (asymmetric graph edge sizes)")
     return PersistentColl(comm, sendbuf, recvbuf, sc * es, sd * es, rd * es,
                           method=method)
+
+
+# -- reduction collectives (ISSUE 14) -----------------------------------------
+
+#: Transport strategy each reduction method rides (the breaker/tune key
+#: space, like ``_UNDERLYING`` above): the fused lowering is the device
+#: collective; the round plans execute through host staging on a
+#: single-controller world, so their health evidence is the staged
+#: transport's; the two-level plan's DCN leg rides the device transport
+#: like the alltoallv hierarchy.
+_UNDERLYING_RED = {
+    "fused": "device",
+    "ring": "staged",
+    "halving": "staged",
+    "hier_ring": "device",
+    "hier_halving": "device",
+}
+
+
+class _FusedReduceLowering:
+    """``fused``: the library's device lowering (one XLA psum/pmax/pmin
+    program over the mesh axis), compiled once through the module-level
+    program cache of ``parallel/reduce.py`` — every ``start()`` after
+    the first is a cache hit + dispatch. Allreduce only (the one-shot
+    layer has no fused reduce_scatter/allgather lowering to ride)."""
+
+    num_rounds = 1
+
+    def __init__(self, comm, buf, dtype, op):
+        self.comm, self.buf = comm, buf
+        self._fn = reduce_mod.get_program(comm, buf.nbytes, dtype, op, None)
+        self._stats = (comm.size, buf.nbytes * comm.size)
+
+    def run_round(self, ri: int) -> None:
+        with self.comm._progress_lock:
+            self.buf.data = self._fn(self.buf.data)
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._stats
+
+    def poll(self) -> bool:
+        return p2p._buf_ready(self.buf)
+
+    def finish(self) -> None:
+        p2p._sync_bufs([self.buf], deadline=p2p._deadline())
+
+    def abort(self) -> None:
+        pass  # dispatch is synchronous; nothing stays in flight
+
+
+class _RoundsReduceLowering:
+    """ring / halving / hier: the compiled round plan executed through
+    host staging (the reference's "host staging where it pays", and the
+    same single-controller rationale as ``_StagedLowering``):
+
+      round 0        — ONE bulk stage-in pass: every rank's element view
+                       lands in a per-rank host work buffer;
+      rounds 1..N    — the compiled rounds applied over the host work
+                       buffers via the shared ``coll.reduce.apply_round``
+                       (the exact code ``simulate`` proves delivery
+                       with) under the shared elementwise op seam
+                       (``parallel.reduce.host_op``); transactional —
+                       every result computes before any write commits,
+                       so a failed round leaves the buffers untouched
+                       and the per-round retry loop re-dispatches
+                       safely;
+      round N+1      — ONE bulk stage-out pass of the delivered region
+                       into the output buffer.
+
+    Rounds are safe to re-dispatch after a pre-dispatch fault (the
+    ``redcoll.round`` site fires BEFORE ``run_round``), and a restart
+    after any failure rebuilds the host staging from the still-unmodified
+    device input, so the handle is always restartable."""
+
+    def __init__(self, comm, inbuf, outbuf, sched, dtype, op, kind):
+        from ..parallel.alltoallv import _lib_perm
+        self.comm = comm
+        self.inbuf, self.outbuf = inbuf, outbuf
+        self.sched, self.kind = sched, kind
+        self._dt = np.dtype(dtype)
+        self._np_op = reduce_mod.host_op(op) if op else None
+        self._lib = _lib_perm(comm)
+        self._work: Optional[List[np.ndarray]] = None
+        self._hier = isinstance(sched, redsched.HierReduceSchedule)
+        if self._hier:
+            self._rounds = sched.all_rounds()
+            self.total_elems = sched.total_elems
+            self._counts = redsched.partition_elems(sched.total_elems,
+                                                    comm.size)
+        else:
+            self._rounds = [(None, rnd) for rnd in sched.rounds]
+            self.total_elems = sched.total_elems
+            self._counts = list(sched.counts)
+        self._offs = np.concatenate(([0], np.cumsum(self._counts))) \
+            .astype(np.int64)
+        self.num_rounds = len(self._rounds) + 2
+        self._round_stats = [(comm.size, self.total_elems * self._dt.itemsize)]
+        for _tier, rnd in self._rounds:
+            self._round_stats.append(
+                (len(rnd), sum(m.nelems for m in rnd) * self._dt.itemsize))
+        self._round_stats.append(
+            (comm.size, self.total_elems * self._dt.itemsize))
+
+    def run_round(self, ri: int) -> None:
+        if ri == 0:
+            self._stage_in()
+        elif ri <= len(self._rounds):
+            self._apply(self._rounds[ri - 1][1])
+        else:
+            self._stage_out()
+
+    def round_tier(self, ri: int) -> Optional[str]:
+        if not self._hier or not 0 < ri <= len(self._rounds):
+            return None
+        return self._rounds[ri - 1][0]
+
+    def _stage_in(self) -> None:
+        comm = self.comm
+        it = self._dt.itemsize
+        with comm._progress_lock:
+            host = np.ascontiguousarray(np.asarray(self.inbuf.data))
+        work = []
+        for r in range(comm.size):
+            row = host[int(self._lib[r])]
+            if self.kind == "allgather":
+                # rank r contributes counts[r] elements from its row's
+                # head, placed at its block offset; other ranges start
+                # zero and are filled by the plan's copies
+                w = np.zeros(self.total_elems, self._dt)
+                n = int(self._counts[r])
+                w[self._offs[r]: self._offs[r] + n] = \
+                    row[: n * it].view(self._dt)
+            else:
+                w = row[: self.total_elems * it].view(self._dt).copy()
+            work.append(w)
+        self._work = work
+
+    def _apply(self, rnd) -> None:
+        redsched.apply_round(self._work, rnd, self._np_op)
+
+    def _stage_out(self) -> None:
+        import jax
+        comm = self.comm
+        it = self._dt.itemsize
+        with comm._progress_lock:
+            host_r = np.array(self.outbuf.data, copy=True, order="C")
+            for r in range(comm.size):
+                lr = int(self._lib[r])
+                if self.kind == "reduce_scatter":
+                    sl = self.sched.owned_slice(r)
+                    seg = self._work[r][sl]
+                else:  # allreduce (in place) / allgather: the full vector
+                    seg = self._work[r][: self.total_elems]
+                raw = np.ascontiguousarray(seg).view(np.uint8)
+                host_r[lr, : raw.size] = raw
+            self.outbuf.data = jax.device_put(host_r, comm.sharding())
+        self._work = None  # staged state never outlives the instance
+
+    def round_stats(self, ri: int) -> Tuple[int, int]:
+        return self._round_stats[ri]
+
+    def poll(self) -> bool:
+        return p2p._buf_ready(self.outbuf)
+
+    def finish(self) -> None:
+        p2p._sync_bufs([self.outbuf], deadline=p2p._deadline())
+
+    def abort(self) -> None:
+        # host passes are synchronous and the device input is only read:
+        # dropping the scratch restores the restartable state
+        self._work = None
+
+
+def _reduce_estimates(comm: Communicator, candidates,
+                      schedules, nbytes_total: int) -> Dict[str, float]:
+    """Swept-sheet cost of each eligible reduction method, in seconds.
+    The fused arm prices one fused collective of the full buffer at the
+    worst link tier; a round plan prices its stage-in/out passes plus
+    its rounds back to back — host moves for flat/ICI rounds, the
+    inter-node curve for DCN rounds (the per-(algorithm, link tier,
+    nbytes) costing the AUTO precedence ranks). Unmeasured curves price
+    at +inf; an all-inf result means "unmeasured system" and the caller
+    keeps the TPU-first default."""
+    sp = msys.get()
+    multi = comm.num_nodes > 1
+    est: Dict[str, float] = {}
+    for m in candidates:
+        if m == "fused":
+            curve = sp.inter_node_pingpong if (
+                multi and sp.inter_node_pingpong) else sp.intra_node_pingpong
+            est[m] = msys.interp_time(curve, max(1, nbytes_total))
+            continue
+        sched = schedules[m]
+        t = msys.interp_time(sp.d2h, max(1, nbytes_total)) \
+            + msys.interp_time(sp.h2d, max(1, nbytes_total))
+        if isinstance(sched, redsched.HierReduceSchedule):
+            esize = max(1, nbytes_total // max(1, sched.total_elems))
+            for tier, rnd in sched.all_rounds():
+                maxb = max(mm.nelems for mm in rnd) * esize
+                if tier == "dcn":
+                    t += msys.model_direct_1d(maxb, False)
+                else:
+                    t += msys.interp_time(sp.host_pingpong, maxb)
+        else:
+            esize = max(1, nbytes_total // max(1, sched.total_elems or 1))
+            for maxe in sched.round_max_elems():
+                t += msys.interp_time(sp.host_pingpong, max(1, maxe * esize))
+        est[m] = t
+    return est
+
+
+def _reduce_tune_overlay(comm: Communicator, est: Dict[str, float],
+                         nbytes_rep: int) -> List[str]:
+    """Reduction tune overlay: the representative link is the 0-1 ring
+    edge — every round plan crosses it (the shared ``_tune_scale`` blend
+    under the reduction methods' transport map)."""
+    if nbytes_rep <= 0 or comm.size < 2:
+        return []
+    l0, l1 = comm.library_rank(0), comm.library_rank(1)
+    return _tune_scale(est, _UNDERLYING_RED, health.link(l0, l1),
+                       comm.is_colocated(l0, l1), nbytes_rep)
+
+
+class PersistentReduce:
+    """A compiled, replayable reduction collective (MPI 4.0
+    ``MPI_Allreduce_init`` / ``MPI_Reduce_scatter_init`` /
+    ``MPI_Allgather_init`` direction): ``start()`` dispatches the
+    compiled round plan, ``wait()``/``test()`` complete it, ``free()``
+    releases it — the same persistent-request surface and the same
+    shared plan-invalidation contract (breaker open, tune drift, mapping
+    epoch, FT verdict, grow) as :class:`PersistentColl`.
+
+    Method precedence (the established order): env-forced
+    (``TEMPI_REDCOLL=ring|halving``, and ``TEMPI_COLL_HIER=hier`` for
+    the plan family) > open breaker > tune > swept model. A forced
+    ``halving`` on a non-power-of-two world degrades to ``ring``
+    identically (no halving plan exists there — the
+    forced-hier-on-one-node precedent). The two-level plan competes (or
+    is forced) for ALLREDUCE on multi-node topologies only: intra-node
+    reduce to the elected leader over ICI, leader ring/halving over DCN,
+    broadcast back (``coll/reduce.compile_hier_reduce``)."""
+
+    def __init__(self, comm: Communicator, kind: str, inbuf: DistBuffer,
+                 outbuf: DistBuffer, counts: Sequence[int], dtype, op: str):
+        if envmod.env.redcoll == "off":
+            raise RuntimeError(
+                "the reduction-collective engine is disarmed "
+                "(TEMPI_REDCOLL=off); one-shot api.allreduce/api.reduce "
+                "remain available")
+        self.comm = comm
+        self.kind = kind
+        self.inbuf, self.outbuf = inbuf, outbuf
+        self.counts = [int(c) for c in counts]
+        self.total_elems = int(sum(self.counts))
+        self.dtype = np.dtype(reduce_mod.elem_dtype(
+            self.total_elems * np.dtype(dtype).itemsize, dtype))
+        if op is not None:
+            reduce_mod.host_op(op)  # loud: an unknown op fails the init
+        self.op = op
+        self._forced_alg: Optional[str] = envmod.env.redcoll \
+            if envmod.env.redcoll in ("ring", "halving") else None
+        chunk_b = envmod.env.redcoll_chunk_bytes
+        self._chunk_elems = (max(1, chunk_b // self.dtype.itemsize)
+                             if chunk_b > 0 else 0)
+        self._hier_mode = envmod.env.coll_hier
+        self._derive_topology()
+        self.method: str = ""
+        self._lowering = None
+        self._active = False
+        self._started = False
+        self._freed = False
+        self._mapping_epoch = comm.mapping_epoch
+        # shared invalidation stamp BEFORE the compile reads any trigger
+        # state; the FT check AFTER it (same ordering rationale as
+        # PersistentColl.__init__)
+        self._inval_token = invalidation.current()
+        self._check_alive()
+        self._compile()
+
+    # -- compile / recompile --------------------------------------------------
+
+    def _derive_topology(self) -> None:
+        """Mapping-derived state: the app-rank node map and elected
+        leaders (for the two-level plan), and the breaker-key link set —
+        the ring edges every round plan crosses, plus the leader pairs
+        of an eligible hierarchy."""
+        comm = self.comm
+        lib = [comm.library_rank(a) for a in range(comm.size)]
+        topo = comm.topology
+        self._node_of = [topo.node_of_rank[lib[a]]
+                         for a in range(comm.size)]
+        self._leaders = [comm.application_rank(r) for r in topo.leaders()]
+        links = {health.link(lib[a], lib[(a + 1) % comm.size])
+                 for a in range(comm.size) if comm.size > 1}
+        for i, la in enumerate(self._leaders):
+            for lb in self._leaders[i + 1:]:
+                links.add(health.link(lib[la], lib[lb]))
+        self.links = links
+
+    def _hier_eligible(self) -> bool:
+        """The two-level reduction exists only where it can pay: an
+        allreduce over a multi-node topology (reduce_scatter/allgather
+        have no broadcast-back shape), with the plan family not pinned
+        flat. Single-node topologies keep the flat plans identically."""
+        return (self.kind == "allreduce" and self._hier_mode != "flat"
+                and len(set(self._node_of)) > 1)
+
+    def _candidates(self) -> List[str]:
+        cands = ["ring"]
+        if redsched.is_pow2(self.comm.size):
+            cands.append("halving")
+        if self.kind == "allreduce":
+            cands.append("fused")
+        if self._hier_eligible():
+            cands.append("hier_ring")
+            if redsched.is_pow2(len(self._leaders)):
+                cands.append("hier_halving")
+        return cands
+
+    def _schedule_for(self, method: str):
+        """Compile (or cache-hit) the round plan of one method — pure
+        (kind, counts, algorithm, chunk, node map) artifacts, cached per
+        communicator like the alltoallv schedules so sibling handles
+        compile each once."""
+        if method == "fused":
+            return None
+        comm = self.comm
+        if method.startswith("hier_"):
+            alg = method[len("hier_"):]
+            key = ("redcoll", "hier", alg, self.total_elems,
+                   self._chunk_elems, tuple(self._node_of),
+                   tuple(self._leaders))
+        else:
+            alg = method
+            key = ("redcoll", self.kind, alg, tuple(self.counts),
+                   self._chunk_elems)
+        with comm._progress_lock:
+            sched = planmod.cache_get(comm, key)
+            if sched is None:
+                if method.startswith("hier_"):
+                    sched = redsched.compile_hier_reduce(
+                        self.total_elems, self._node_of, self._leaders,
+                        algorithm=alg, chunk_elems=self._chunk_elems)
+                else:
+                    compiler = {
+                        "allreduce": redsched.compile_allreduce,
+                        "reduce_scatter": redsched.compile_reduce_scatter,
+                        "allgather": redsched.compile_allgather,
+                    }[self.kind]
+                    sched = compiler(comm.size, self.counts, algorithm=alg,
+                                     chunk_elems=self._chunk_elems)
+                planmod.cache_put(comm, key, sched)
+        return sched
+
+    def _choose(self) -> str:
+        """One method with the established precedence. Env-forced arms:
+        ``TEMPI_REDCOLL=ring|halving`` pins the algorithm family and
+        ``TEMPI_COLL_HIER=hier`` pins the two-level plan wherever one is
+        eligible; both compose (forced hier rides the forced algorithm
+        on its DCN leg). Otherwise every eligible candidate competes in
+        the model-driven AUTO choice."""
+        forced_alg = self._forced_alg
+        if forced_alg == "halving" and not redsched.is_pow2(self.comm.size):
+            log.debug("forced halving on a non-power-of-two world: "
+                      "degrading to the ring plan (no halving plan "
+                      "exists at this size)")
+            forced_alg = "ring"
+        if self._hier_mode == "hier" and self._hier_eligible():
+            alg = forced_alg
+            if alg is None:
+                alg = "halving" if redsched.is_pow2(len(self._leaders)) \
+                    else "ring"
+            elif alg == "halving" \
+                    and not redsched.is_pow2(len(self._leaders)):
+                alg = "ring"
+            method = f"hier_{alg}"
+            if obstrace.ENABLED:
+                obstrace.emit("redcoll.choice", kind=self.kind,
+                              method=method, forced=True)
+            return method
+        if forced_alg is not None:
+            if obstrace.ENABLED:
+                obstrace.emit("redcoll.choice", kind=self.kind,
+                              method=forced_alg, forced=True)
+            return forced_alg
+        cands = self._candidates()
+        schedules = {m: self._schedule_for(m) for m in cands
+                     if m != "fused"}
+        nb_total = self.total_elems * self.dtype.itemsize
+        est = _reduce_estimates(self.comm, cands, schedules, nb_total)
+        tuned = _reduce_tune_overlay(self.comm, est, nb_total) \
+            if tune_online.ADAPTING else []
+        quarantined = []
+        if health.TRIPPED:
+            for m in list(est):
+                us = _UNDERLYING_RED[m]
+                if any(health.state(lk, us) == health.OPEN
+                       for lk in self.links):
+                    quarantined.append(m)
+        eligible = {m: t for m, t in est.items() if m not in quarantined}
+        finite = {m: t for m, t in eligible.items() if t < math.inf}
+        if finite:
+            choice = min(finite, key=finite.get)
+        elif self.kind == "allreduce" and "fused" in eligible:
+            # unmeasured system: the TPU-first default, like one-shot AUTO
+            choice = "fused"
+        elif "ring" in eligible:
+            choice = "ring"
+        else:
+            # every transport quarantined: the ring plan is the
+            # conservative host path whose next runs feed the probes
+            choice = "ring"
+        if obstrace.ENABLED:
+            obstrace.emit("redcoll.choice", kind=self.kind, method=choice,
+                          forced=False,
+                          estimates={m: (t if t < math.inf else None)
+                                     for m, t in est.items()},
+                          tuned=tuned, quarantined=quarantined)
+        return choice
+
+    def _compile(self, recompile: bool = False) -> None:
+        method = self._choose()
+        if recompile and method == self.method:
+            return  # no healthier alternative: keep the compiled plan
+        self.method = method
+        self._lowering = self._build_lowering(method)
+        ctr.counters.coll.reduce_compiles += 1
+        if recompile:
+            ctr.counters.coll.reduce_recompiles += 1
+            log.info(f"persistent reduction recompiled onto "
+                     f"{self.method!r} (plan invalidated)")
+
+    def _build_lowering(self, method: str):
+        addressable = all(
+            getattr(b.data, "is_fully_addressable", True)
+            for b in (self.inbuf, self.outbuf))
+        if method == "fused":
+            return _FusedReduceLowering(self.comm, self.outbuf, self.dtype,
+                                        self.op)
+        if not addressable:
+            # the staged host passes need every local shard; a
+            # multi-controller allreduce takes the fused device path
+            # (same rationale as _StagedLowering's degrade); the other
+            # kinds have no device lowering to degrade to — refuse
+            if self.kind == "allreduce":
+                log.debug("reduction round plan on a partially-"
+                          "addressable buffer: lowering to fused")
+                return _FusedReduceLowering(self.comm, self.outbuf,
+                                            self.dtype, self.op)
+            raise RuntimeError(
+                f"persistent {self.kind} needs fully-addressable buffers "
+                "(multi-controller worlds are unsupported here)")
+        sched = self._schedule_for(method)
+        if isinstance(sched, redsched.HierReduceSchedule):
+            ctr.counters.coll.reduce_hier_compiles += 1
+        return _RoundsReduceLowering(self.comm, self.inbuf, self.outbuf,
+                                     sched, self.dtype, self.op, self.kind)
+
+    def _refresh_mapping(self) -> None:
+        """An applied rank re-placement changed the app->library
+        permutation: node map, leaders, the link set, and the lowering's
+        rank translation are stale — rebuild them all (the plan cache
+        was dropped by the apply step, so schedules recompile fresh)."""
+        self._derive_topology()
+        self.method = self._choose()
+        self._lowering = self._build_lowering(self.method)
+        self._mapping_epoch = self.comm.mapping_epoch
+        ctr.counters.coll.reduce_compiles += 1
+        ctr.counters.coll.reduce_recompiles += 1
+        log.info(f"persistent reduction recompiled onto {self.method!r} "
+                 f"(rank re-placement epoch {self.comm.mapping_epoch})")
+
+    def _check_alive(self) -> None:
+        if liveness.ENABLED and self.comm.dead_ranks:
+            raise liveness.RankFailure(
+                self.comm.dead_ranks,
+                detail="persistent reduction on a communicator with "
+                       "failed ranks; api.shrink(comm) and rebuild the "
+                       "handle on the survivor communicator")
+
+    def _revalidate(self, token: int) -> None:
+        self._check_alive()
+        if self._mapping_epoch != self.comm.mapping_epoch:
+            self._refresh_mapping()
+        if self._needs_recompile() or self._tune_may_rerank():
+            self._compile(recompile=True)
+        self._inval_token = token
+
+    def _tune_may_rerank(self) -> bool:
+        """Forced methods — a TEMPI_REDCOLL algorithm or a forced hier
+        plan — are never overridden, mirroring PersistentColl."""
+        if not tune_online.ADAPTING or self._forced_alg is not None:
+            return False
+        return not (self.method.startswith("hier_")
+                    and self._hier_mode == "hier")
+
+    def _needs_recompile(self) -> bool:
+        if self._forced_alg is not None or not health.TRIPPED:
+            return False
+        if self.method.startswith("hier_") and self._hier_mode == "hier":
+            return False  # explicitly forced plan: never overridden
+        us = _UNDERLYING_RED[self.method]
+        return any(health.state(lk, us) == health.OPEN for lk in self.links)
+
+    # -- MPI persistent-request surface ---------------------------------------
+
+    def start(self) -> None:
+        """Dispatch the compiled plan (MPI_Start analog). Each round is a
+        ``redcoll.round`` fault site and obs span; a faulted round
+        retries under TEMPI_RETRY_ATTEMPTS (the site fires before the
+        round dispatches and the staged state rebuilds from the device
+        input, so re-dispatch is safe)."""
+        rec = self.comm._step_recorder
+        if rec is not None and rec.recording:
+            with rec.suspended():
+                self._start_impl()
+            rec.note_coll(self)
+            return
+        self._start_impl()
+
+    def _start_impl(self) -> None:
+        if self._freed:
+            raise RuntimeError("start() on a freed persistent reduction")
+        if self._active:
+            raise RuntimeError("start() on an already-active persistent "
+                               "reduction (MPI: operation error)")
+        tok = invalidation.current()
+        if tok != self._inval_token:
+            self._revalidate(tok)
+        if self._started:
+            ctr.counters.coll.reduce_replays += 1
+        retries = envmod.env.retry_attempts
+        low = self._lowering
+        hier = isinstance(low, _RoundsReduceLowering) and low._hier
+        try:
+            for ri in range(low.num_rounds):
+                t0 = time.monotonic() if obstrace.ENABLED else 0.0
+                tier = low.round_tier(ri) if hier else None
+                attempt = 0
+                while True:
+                    try:
+                        if faults.ENABLED:
+                            # BEFORE the round dispatches: a raise never
+                            # leaves a round half-applied
+                            faults.check("redcoll.round")
+                        low.run_round(ri)
+                        break
+                    except Exception:
+                        if attempt >= retries:
+                            raise
+                        attempt += 1
+                        delay = envmod.env.retry_backoff_s \
+                            * (2 ** (attempt - 1))
+                        if delay > 0:
+                            time.sleep(delay)
+                msgs, nbytes = low.round_stats(ri)
+                ctr.counters.coll.reduce_rounds += 1
+                ctr.counters.coll.reduce_wire_bytes += nbytes
+                if tier == "ici":
+                    ctr.counters.coll.reduce_hier_rounds_ici += 1
+                elif tier == "dcn":
+                    ctr.counters.coll.reduce_hier_rounds_dcn += 1
+                if obstrace.ENABLED:
+                    extra = {"tier": tier} if tier else {}
+                    obstrace.emit_span("redcoll.round", t0, round=ri,
+                                       msgs=msgs, nbytes=nbytes,
+                                       method=self.method, kind=self.kind,
+                                       retries=attempt, **extra)
+        except BaseException:
+            low.abort()
+            raise
+        self._started = True
+        self._active = True
+
+    def wait(self) -> None:
+        """Complete the active instance (MPI_Wait analog)."""
+        rec = self.comm._step_recorder
+        if rec is not None and rec.recording:
+            with rec.suspended():
+                self._wait_impl()
+            rec.note_barrier()
+            return
+        self._wait_impl()
+
+    def _wait_impl(self) -> None:
+        if self._freed:
+            raise RuntimeError("wait() on a freed persistent reduction")
+        if not self._active:
+            raise RuntimeError("wait() on an inactive persistent reduction")
+        try:
+            self._lowering.finish()
+        finally:
+            self._active = False
+
+    def test(self) -> bool:
+        """Nonblocking completion query (MPI_Test analog)."""
+        if self._freed:
+            raise RuntimeError("test() on a freed persistent reduction")
+        if not self._active:
+            raise RuntimeError("test() on an inactive persistent reduction")
+        if not self._lowering.poll():
+            return False
+        self.wait()
+        return True
+
+    def free(self) -> None:
+        """Release the compiled state (MPI_Request_free analog)."""
+        if self._active:
+            raise RuntimeError("free() on an active persistent reduction "
+                               "(wait() it first)")
+        self._lowering = None
+        self._freed = True
+
+
+def allreduce_init(comm: Communicator, buf: DistBuffer, dtype=None,
+                   op: str = "sum") -> PersistentReduce:
+    """MPI 4.0 ``MPI_Allreduce_init`` direction: compile the reduction
+    once — algorithm choice, round plan, lowering — and replay it with
+    ``start()``/``wait()`` on the returned handle. In place over every
+    rank's row of ``buf`` (the :func:`parallel.reduce.allreduce`
+    semantics), elements viewed as ``dtype`` (default float32)."""
+    import jax.numpy as jnp
+    dtype = dtype if dtype is not None else jnp.float32
+    edt = reduce_mod.elem_dtype(buf.nbytes, dtype)
+    total = buf.nbytes // edt.itemsize
+    counts = redsched.partition_elems(total, comm.size)
+    return PersistentReduce(comm, "allreduce", buf, buf, counts, dtype, op)
+
+
+def reduce_scatter_init(comm: Communicator, sendbuf: DistBuffer,
+                        recvcounts, recvbuf: DistBuffer, dtype=None,
+                        op: str = "sum") -> PersistentReduce:
+    """``MPI_Reduce_scatter_init`` direction: every rank contributes
+    ``sum(recvcounts)`` elements from its ``sendbuf`` row; after
+    completion rank ``r``'s ``recvbuf`` row holds the reduced block
+    ``r`` (``recvcounts[r]`` elements) at offset 0. Ragged counts
+    allowed."""
+    import jax.numpy as jnp
+    dtype = dtype if dtype is not None else jnp.float32
+    counts = [int(c) for c in recvcounts]
+    if len(counts) != comm.size:
+        raise ValueError(f"recvcounts must have one entry per rank "
+                         f"({comm.size}), got {len(counts)}")
+    if any(c < 0 for c in counts):
+        raise ValueError("negative recvcounts entry")
+    edt = np.dtype(reduce_mod.elem_dtype(0, dtype))
+    total = sum(counts)
+    if sendbuf.nbytes < total * edt.itemsize:
+        raise ValueError(
+            f"sendbuf rows of {sendbuf.nbytes} B cannot hold "
+            f"{total} {edt.name} elements")
+    if counts and recvbuf.nbytes < max(counts) * edt.itemsize:
+        raise ValueError(
+            f"recvbuf rows of {recvbuf.nbytes} B cannot hold the widest "
+            f"block ({max(counts)} {edt.name} elements)")
+    return PersistentReduce(comm, "reduce_scatter", sendbuf, recvbuf,
+                            counts, dtype, op)
+
+
+def allgather_init(comm: Communicator, sendbuf: DistBuffer, sendcounts,
+                   recvbuf: DistBuffer, dtype=None) -> PersistentReduce:
+    """``MPI_Allgather_init`` direction (ragged = allgatherv): rank ``r``
+    contributes ``sendcounts[r]`` elements from the head of its
+    ``sendbuf`` row; after completion every rank's ``recvbuf`` row holds
+    the concatenation (block ``b`` at element offset
+    ``sum(sendcounts[:b])``)."""
+    import jax.numpy as jnp
+    dtype = dtype if dtype is not None else jnp.float32
+    counts = [int(c) for c in sendcounts]
+    if len(counts) != comm.size:
+        raise ValueError(f"sendcounts must have one entry per rank "
+                         f"({comm.size}), got {len(counts)}")
+    if any(c < 0 for c in counts):
+        raise ValueError("negative sendcounts entry")
+    edt = np.dtype(reduce_mod.elem_dtype(0, dtype))
+    total = sum(counts)
+    if counts and sendbuf.nbytes < max(counts) * edt.itemsize:
+        raise ValueError(
+            f"sendbuf rows of {sendbuf.nbytes} B cannot hold the widest "
+            f"contribution ({max(counts)} {edt.name} elements)")
+    if recvbuf.nbytes < total * edt.itemsize:
+        raise ValueError(
+            f"recvbuf rows of {recvbuf.nbytes} B cannot hold "
+            f"{total} {edt.name} elements")
+    return PersistentReduce(comm, "allgather", sendbuf, recvbuf, counts,
+                            dtype, op=None)

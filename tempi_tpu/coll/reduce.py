@@ -1,0 +1,546 @@
+"""Reduction-collective schedule compiler: ring / recursive-halving round
+plans for reduce_scatter, allgather, and allreduce (ISSUE 14).
+
+The alltoallv engine proved the pattern — compile a collective ONCE into a
+deterministic round structure, prove exact delivery with a pure numpy
+``simulate()``, then replay the compiled plan behind a persistent handle —
+but allreduce / reduce_scatter / allgather dominate real training traffic
+and still ride the library's fused lowering alone.  This module is the
+compile step for the reduction family, mirroring ``coll/schedule.py``'s
+role for alltoallv:
+
+  * **block model** — the logical element space of the collective is
+    ``total = sum(counts)`` elements partitioned into ``size`` blocks
+    (block ``b`` owned by application rank ``b``, ``counts[b]`` elements,
+    ragged counts allowed).  Every rank works over a ``total``-element
+    buffer; messages name absolute element ranges into it.
+  * **ring** — the classic ``size - 1``-round ring: in round ``k`` rank
+    ``j`` forwards one block to ``(j + 1) % size``.  reduce_scatter
+    accumulates along the ring so rank ``r`` ends owning the fully
+    reduced block ``r``; allgather copies along the ring so every rank
+    ends with every block.  Works at ANY world size, ragged included.
+  * **halving** — recursive vector halving for reduce_scatter plus
+    recursive doubling for allgather: ``log2(size)`` rounds of paired
+    half-window exchanges.  Power-of-two worlds only; the persistent
+    layer degrades a forced ``halving`` to ``ring`` identically on other
+    sizes (the forced-``hier``-on-one-node precedent).
+  * **allreduce** — the reduce_scatter + allgather composition, the
+    bandwidth-optimal shape both algorithm families share.
+  * **chunk segmentation** — ``chunk_elems`` bounds the elements any
+    single round moves per rank: each block's element range splits into
+    consecutive sub-segments and the plan compiles as per-segment
+    sub-plans run back to back (the round-level analog of
+    TEMPI_COLL_CHUNK_BYTES, knob TEMPI_REDCOLL_CHUNK_BYTES).
+  * **two-level reduction** (:func:`compile_hier_reduce`) — the
+    reduction shape of ``coll/schedule.compile_hier_schedule``'s three
+    phases: every non-leader reduces into its node's elected leader over
+    ICI, the leaders run a flat ring/halving allreduce over DCN, and the
+    leaders broadcast the result back over ICI.  Same
+    plan/invariant/simulate structure, phase-tested like ``test_hier.py``
+    does for alltoallv.
+
+Invariants the runtime (and the property tests) rely on:
+
+  * **pairing** — within a round each rank sends to at most ONE peer and
+    receives from at most ONE peer (several messages may ride one pair —
+    chunk segments of one transfer), so a round is a set of disjoint
+    point-to-point transfers with no self-contention.
+  * **read-before-write** — a round's payloads are all read before any
+    write commits (``simulate`` and the runtime lowering both honor it),
+    so in-round source and destination ranges may alias freely.
+  * **exact delivery** — ``simulate()`` replays the rounds over plain
+    numpy buffers and the tests compare against the dense reference
+    (``np_op.reduce`` over every rank's contribution).
+
+Pure Python/numpy: no jax, no communicator, no I/O — deterministic for a
+given (counts, algorithm, chunk) input, hence cacheable under
+``plan.cache_get/cache_put`` exactly like the alltoallv schedules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Round-plan algorithm families. ``ring`` works at any world size;
+#: ``halving`` (recursive halving + recursive doubling) needs a
+#: power-of-two world — `algorithms_for` is the eligibility oracle the
+#: persistent layer's AUTO chooser consults.
+ALGORITHMS = ("ring", "halving")
+
+#: Reduction-collective kinds this compiler lowers.
+KINDS = ("reduce_scatter", "allgather", "allreduce")
+
+
+def is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def apply_round(bufs: Sequence[np.ndarray], rnd, np_op) -> None:
+    """Apply one round's messages over per-rank element buffers — THE
+    executable definition of a round, shared by both ``simulate``
+    flavors and the runtime lowering so the spec and the executor cannot
+    drift.  Transactional: every payload is read AND every result
+    computed before any write commits, so in-round source/destination
+    ranges may alias freely (read-before-write) and a failure while
+    computing leaves the buffers untouched — the per-round retry loop
+    may re-dispatch safely (the remaining writes are precomputed-array
+    slice assignments, which cannot raise after the shape-matched
+    compute)."""
+    commits = []
+    for m in rnd:
+        payload = bufs[m.src][m.offset: m.offset + m.nelems]
+        seg = bufs[m.dst][m.offset: m.offset + m.nelems]
+        commits.append((seg, np_op(seg, payload) if m.action == "reduce"
+                        else payload.copy()))
+    for seg, value in commits:
+        seg[:] = value
+
+
+def _pairing_violation(rnd) -> "str | None":
+    """One round's pairing check (see ``ReduceSchedule.check_pairing``):
+    each rank sends to at most one peer and receives from at most one —
+    several messages on ONE pair are fine (chunk segments ride
+    together). Returns the violation description, or None."""
+    out: Dict[int, int] = {}
+    inc: Dict[int, int] = {}
+    for m in rnd:
+        if out.setdefault(m.src, m.dst) != m.dst:
+            return f"rank {m.src} sends to two peers"
+        if inc.setdefault(m.dst, m.src) != m.src:
+            return f"rank {m.dst} receives from two peers"
+        if m.src == m.dst:
+            return f"self-message {m}"
+    return None
+
+
+def algorithms_for(size: int) -> Tuple[str, ...]:
+    """The algorithm families that have a plan at this world size."""
+    return ALGORITHMS if is_pow2(size) else ("ring",)
+
+
+@dataclass(frozen=True)
+class RMsg:
+    """One scheduled reduction message (or chunk segment of one):
+    application-rank endpoints, an absolute element range into the
+    logical buffer, and what the receiver does with the payload —
+    ``reduce`` (accumulate under the handle's elementwise op) or
+    ``copy`` (store)."""
+
+    src: int
+    dst: int
+    offset: int   # element offset into the logical buffer
+    nelems: int
+    action: str   # "reduce" | "copy"
+
+
+@dataclass
+class ReduceSchedule:
+    """A compiled reduction round plan over one (counts, algorithm,
+    chunk) input.  ``counts`` is per-block ELEMENT counts; byte sizing is
+    the persistent layer's concern (elements x itemsize)."""
+
+    size: int
+    kind: str                    # reduce_scatter | allgather | allreduce
+    algorithm: str               # ring | halving
+    counts: Tuple[int, ...]
+    rounds: List[List[RMsg]] = field(default_factory=list)
+    chunk_elems: int = 0
+
+    @property
+    def total_elems(self) -> int:
+        return int(sum(self.counts))
+
+    def block_offsets(self) -> np.ndarray:
+        return np.concatenate(([0], np.cumsum(self.counts))).astype(np.int64)
+
+    def owned_slice(self, rank: int) -> slice:
+        """The element range rank ``rank`` owns after a reduce_scatter
+        (and contributes to an allgather)."""
+        offs = self.block_offsets()
+        return slice(int(offs[rank]), int(offs[rank + 1]))
+
+    # -- property-check helpers (used by tests and the runtime) ---------------
+
+    def check_pairing(self) -> None:
+        """Raise if any round has a rank talking to two peers in one
+        direction (multiple messages on ONE pair are fine — chunk
+        segments of one transfer ride together)."""
+        for ri, rnd in enumerate(self.rounds):
+            bad = _pairing_violation(rnd)
+            if bad:
+                raise AssertionError(f"round {ri}: {bad}")
+
+    def round_max_elems(self) -> List[int]:
+        """Widest per-rank element volume of each round — what the chunk
+        segmentation bounds and the AUTO cost model prices."""
+        out = []
+        for rnd in self.rounds:
+            per_src: Dict[int, int] = {}
+            for m in rnd:
+                per_src[m.src] = per_src.get(m.src, 0) + m.nelems
+            out.append(max(per_src.values(), default=0))
+        return out
+
+    def total_wire_elems(self) -> int:
+        return sum(m.nelems for rnd in self.rounds for m in rnd)
+
+    def simulate(self, rows: Sequence[np.ndarray], np_op) -> List[np.ndarray]:
+        """Replay the rounds over plain numpy buffers — the executable
+        definition of exact delivery the property tests compare against
+        the dense reference.  ``rows[r]`` is rank ``r``'s initial
+        ``total_elems`` buffer; ``np_op`` the elementwise ufunc (e.g.
+        ``np.add``) applied by ``reduce`` actions.  Rounds apply through
+        the shared :func:`apply_round` — the same code the runtime
+        lowering executes, so the spec and the executor cannot drift."""
+        bufs = [np.array(r, copy=True) for r in rows]
+        for rnd in self.rounds:
+            apply_round(bufs, rnd, np_op)
+        return bufs
+
+
+def _segments(counts: Sequence[int], chunk_elems: int
+              ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Split each block's element range into consecutive sub-segments of
+    at most ``chunk_elems`` elements.  Returns per-segment
+    ``(seg_counts, seg_base)`` arrays — segment ``s`` of block ``b``
+    covers absolute elements ``[seg_base[b], seg_base[b] + seg_counts[b])``.
+    ``chunk_elems <= 0`` disables splitting (one segment, the raw
+    blocks)."""
+    counts = np.asarray(counts, np.int64)
+    offs = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+    if chunk_elems <= 0:
+        return [(counts.copy(), offs[:-1].copy())]
+    nseg = max(1, int(np.max(np.ceil(counts / chunk_elems))) if counts.size
+               else 1)
+    segs = []
+    for s in range(nseg):
+        lo = np.minimum(counts, s * chunk_elems)
+        hi = np.minimum(counts, (s + 1) * chunk_elems)
+        segs.append(((hi - lo).astype(np.int64),
+                     (offs[:-1] + lo).astype(np.int64)))
+    return segs
+
+
+def _ring_rounds(size: int, seg_counts: np.ndarray, seg_base: np.ndarray,
+                 action: str) -> List[List[RMsg]]:
+    """The ``size - 1`` ring rounds over one segment's blocks.  For
+    ``reduce`` (reduce_scatter): round ``k`` has rank ``j`` forwarding
+    the partial of block ``(j - k - 1) % size`` to ``(j + 1) % size``,
+    which accumulates — after all rounds rank ``r`` owns the full
+    reduction of block ``r``.  For ``copy`` (allgather): rank ``j``
+    forwards block ``(j - k) % size``; after all rounds every rank holds
+    every block."""
+    shift = 1 if action == "reduce" else 0
+    rounds = []
+    for k in range(size - 1):
+        rnd = []
+        for j in range(size):
+            b = (j - k - shift) % size
+            if seg_counts[b]:
+                rnd.append(RMsg(src=j, dst=(j + 1) % size,
+                                offset=int(seg_base[b]),
+                                nelems=int(seg_counts[b]), action=action))
+        rounds.append(rnd)
+    return rounds
+
+
+def _halving_rs_rounds(size: int, seg_counts: np.ndarray,
+                       seg_base: np.ndarray) -> List[List[RMsg]]:
+    """Recursive vector halving reduce_scatter: ``log2(size)`` rounds of
+    paired half-window exchanges.  Rank ``j``'s block window starts at
+    ``[0, size)`` and halves every round following ``j``'s bits top-down,
+    so after the last round rank ``r`` owns exactly block ``r``."""
+    assert is_pow2(size), "halving plans need a power-of-two world"
+    lo = [0] * size
+    hi = [size] * size
+    rounds = []
+    d = size >> 1
+    while d:
+        rnd = []
+        for j in range(size):
+            partner = j ^ d
+            mid = (lo[j] + hi[j]) // 2
+            blocks = range(mid, hi[j]) if not j & d else range(lo[j], mid)
+            for b in blocks:
+                if seg_counts[b]:
+                    rnd.append(RMsg(src=j, dst=partner,
+                                    offset=int(seg_base[b]),
+                                    nelems=int(seg_counts[b]),
+                                    action="reduce"))
+        for j in range(size):
+            mid = (lo[j] + hi[j]) // 2
+            if not j & d:
+                hi[j] = mid
+            else:
+                lo[j] = mid
+        rounds.append(rnd)
+        d >>= 1
+    return rounds
+
+
+def _doubling_ag_rounds(size: int, seg_counts: np.ndarray,
+                        seg_base: np.ndarray) -> List[List[RMsg]]:
+    """Recursive doubling allgather (the inverse of halving, the other
+    half of the ``halving`` family): rank ``j``'s valid window starts at
+    its own block and doubles every round via an aligned-partner copy
+    exchange."""
+    assert is_pow2(size), "doubling plans need a power-of-two world"
+    rounds = []
+    d = 1
+    while d < size:
+        rnd = []
+        for j in range(size):
+            partner = j ^ d
+            wlo = (j // d) * d  # aligned valid window of width d
+            for b in range(wlo, wlo + d):
+                if seg_counts[b]:
+                    rnd.append(RMsg(src=j, dst=partner,
+                                    offset=int(seg_base[b]),
+                                    nelems=int(seg_counts[b]),
+                                    action="copy"))
+        rounds.append(rnd)
+        d <<= 1
+    return rounds
+
+
+def _compile(kind: str, size: int, counts: Sequence[int], algorithm: str,
+             chunk_elems: int) -> ReduceSchedule:
+    counts = [int(c) for c in counts]
+    assert len(counts) == size, "one block count per rank"
+    assert all(c >= 0 for c in counts), "negative block count"
+    assert kind in KINDS and algorithm in ALGORITHMS
+    if algorithm == "halving" and not is_pow2(size):
+        raise ValueError(
+            f"halving plans need a power-of-two world, got size={size} "
+            "(the persistent layer degrades forced halving to ring)")
+    sched = ReduceSchedule(size=size, kind=kind, algorithm=algorithm,
+                           counts=tuple(counts), chunk_elems=int(chunk_elems))
+    if size == 1 or sched.total_elems == 0:
+        return sched  # nothing moves: an empty plan delivers trivially
+    for seg_counts, seg_base in _segments(counts, chunk_elems):
+        if not int(seg_counts.sum()):
+            continue
+        if kind in ("reduce_scatter", "allreduce"):
+            sched.rounds += (
+                _ring_rounds(size, seg_counts, seg_base, "reduce")
+                if algorithm == "ring"
+                else _halving_rs_rounds(size, seg_counts, seg_base))
+        if kind in ("allgather", "allreduce"):
+            sched.rounds += (
+                _ring_rounds(size, seg_counts, seg_base, "copy")
+                if algorithm == "ring"
+                else _doubling_ag_rounds(size, seg_counts, seg_base))
+    sched.rounds = [rnd for rnd in sched.rounds if rnd]
+    return sched
+
+
+def compile_reduce_scatter(size: int, counts: Sequence[int],
+                           algorithm: str = "ring",
+                           chunk_elems: int = 0) -> ReduceSchedule:
+    """Compile a reduce_scatter round plan: every rank contributes a full
+    ``sum(counts)``-element buffer; after the plan rank ``r``'s block
+    ``r`` range holds the full reduction (other ranges hold partials —
+    undefined output, like MPI)."""
+    return _compile("reduce_scatter", size, counts, algorithm, chunk_elems)
+
+
+def compile_allgather(size: int, counts: Sequence[int],
+                      algorithm: str = "ring",
+                      chunk_elems: int = 0) -> ReduceSchedule:
+    """Compile an allgather round plan: rank ``r`` starts with valid data
+    in its block ``r`` range; after the plan every rank holds every
+    block."""
+    return _compile("allgather", size, counts, algorithm, chunk_elems)
+
+
+def compile_allreduce(size: int, counts: Sequence[int],
+                      algorithm: str = "ring",
+                      chunk_elems: int = 0) -> ReduceSchedule:
+    """Compile an allreduce as the reduce_scatter + allgather composition
+    (the bandwidth-optimal shape of both algorithm families): after the
+    plan every rank's full buffer holds the reduction of every rank's
+    contribution."""
+    return _compile("allreduce", size, counts, algorithm, chunk_elems)
+
+
+def partition_elems(total: int, parts: int) -> List[int]:
+    """Deterministic near-equal element partition (the block structure a
+    caller without natural per-rank counts uses — allreduce over one flat
+    buffer, the leader exchange of the two-level plan)."""
+    base, rem = divmod(int(total), int(parts))
+    return [base + (1 if i < rem else 0) for i in range(parts)]
+
+
+# -- two-level (ICI x DCN) reduction plans ------------------------------------
+
+
+@dataclass(frozen=True)
+class HRMsg:
+    """One scheduled hierarchical reduction message: endpoints are
+    application ranks, the element range is absolute into the logical
+    buffer, ``action`` as :class:`RMsg`, ``tier`` names the link tier the
+    message rides (``ici`` intra-node, ``dcn`` leader-to-leader)."""
+
+    src: int
+    dst: int
+    offset: int
+    nelems: int
+    action: str
+    tier: str
+
+
+@dataclass
+class HierReduceSchedule:
+    """A compiled three-phase two-level allreduce:
+
+      * **phase A (reduce to leader, ICI)** — every non-leader rank sends
+        its full vector to its node's elected leader, which accumulates;
+        one member per node per round, so each leader receives from at
+        most one peer per round (the pairing invariant).
+      * **phase B (leader exchange, DCN)** — the leaders run a flat
+        ring/halving allreduce among themselves over a near-equal element
+        partition (:func:`partition_elems` over ``len(leaders)`` blocks).
+      * **phase C (broadcast, ICI)** — each leader copies the reduced
+        vector back to its local members, one per round.
+
+    The invariants mirror ``coll/schedule.HierSchedule``: per-round
+    pairing, tier separation (A/C never cross a node, B runs only
+    leader-to-leader across nodes), and exact delivery via the
+    three-phase ``simulate``."""
+
+    size: int
+    node_of: List[int]
+    leaders: List[int]
+    total_elems: int
+    algorithm: str                                  # the phase-B family
+    phase_a: List[List[HRMsg]] = field(default_factory=list)
+    phase_b: List[List[HRMsg]] = field(default_factory=list)
+    phase_c: List[List[HRMsg]] = field(default_factory=list)
+    chunk_elems: int = 0
+    dcn_rounds: int = 0
+    dcn_elems: int = 0     # total elements crossing DCN
+
+    def phases(self) -> List[Tuple[str, List[List[HRMsg]]]]:
+        return [("ici", self.phase_a), ("dcn", self.phase_b),
+                ("ici", self.phase_c)]
+
+    def all_rounds(self) -> List[Tuple[str, List[HRMsg]]]:
+        return [(tier, rnd) for tier, rounds in self.phases()
+                for rnd in rounds]
+
+    def check_pairing(self) -> None:
+        for pname, rounds in (("A", self.phase_a), ("B", self.phase_b),
+                              ("C", self.phase_c)):
+            for ri, rnd in enumerate(rounds):
+                bad = _pairing_violation(rnd)
+                if bad:
+                    raise AssertionError(
+                        f"phase {pname} round {ri}: {bad}")
+
+    def check_tier_separation(self) -> None:
+        """Phase A/C messages never cross a node; every phase-B message
+        runs leader-to-leader across nodes — no DCN traffic between
+        non-leader ranks, ever."""
+        leaders = set(self.leaders)
+        for rnd in self.phase_a:
+            for m in rnd:
+                assert m.tier == "ici" and m.action == "reduce"
+                assert self.node_of[m.src] == self.node_of[m.dst], \
+                    f"phase A message {m} crosses nodes"
+                assert m.dst in leaders, f"phase A target {m.dst} not a leader"
+        for rnd in self.phase_b:
+            for m in rnd:
+                assert m.tier == "dcn"
+                assert m.src in leaders and m.dst in leaders, \
+                    f"DCN message {m} between non-leader ranks"
+                assert self.node_of[m.src] != self.node_of[m.dst], \
+                    f"phase B message {m} stays on one node"
+        for rnd in self.phase_c:
+            for m in rnd:
+                assert m.tier == "ici" and m.action == "copy"
+                assert self.node_of[m.src] == self.node_of[m.dst], \
+                    f"phase C message {m} crosses nodes"
+                assert m.src in leaders, f"phase C source {m.src} not a leader"
+
+    def simulate(self, rows: Sequence[np.ndarray], np_op) -> List[np.ndarray]:
+        """Replay the three phases over plain numpy buffers through the
+        shared :func:`apply_round` (same contract as
+        :meth:`ReduceSchedule.simulate`)."""
+        bufs = [np.array(r, copy=True) for r in rows]
+        for _tier, rnd in self.all_rounds():
+            apply_round(bufs, rnd, np_op)
+        return bufs
+
+
+def compile_hier_reduce(total_elems: int, node_of: Sequence[int],
+                        leaders: Sequence[int], algorithm: str = "ring",
+                        chunk_elems: int = 0) -> HierReduceSchedule:
+    """Compile the two-level allreduce plan (the reduction shape of
+    ``coll/schedule.compile_hier_schedule``'s three phases).
+
+    ``node_of`` maps each application rank to its node id and ``leaders``
+    names the leader application rank of each node (``parallel.topology``
+    elects them; the compiler stays comm-free).  ``algorithm`` picks the
+    phase-B family over the leader set — ``halving`` requires a
+    power-of-two LEADER count (node count), not world size.  Ragged node
+    sizes are fine: phase A/C rounds are as deep as the largest node."""
+    size = len(node_of)
+    node_of = [int(n) for n in node_of]
+    leaders = [int(a) for a in leaders]
+    for n, lead in enumerate(leaders):
+        assert node_of[lead] == n, \
+            f"leader {lead} of node {n} lives on node {node_of[lead]}"
+    sched = HierReduceSchedule(size=size, node_of=node_of, leaders=leaders,
+                               total_elems=int(total_elems),
+                               algorithm=algorithm,
+                               chunk_elems=int(chunk_elems))
+    if size == 1 or total_elems == 0:
+        return sched
+    members = {n: [r for r in range(size)
+                   if node_of[r] == n and r != leaders[n]]
+               for n in range(len(leaders))}
+    depth = max((len(ms) for ms in members.values()), default=0)
+
+    # phase A: one member per node per round reduces into its leader
+    # (full vector — the leader accumulates the node's contribution)
+    for j in range(depth):
+        rnd = []
+        for n, lead in enumerate(leaders):
+            if j < len(members[n]):
+                rnd.append(HRMsg(src=members[n][j], dst=lead, offset=0,
+                                 nelems=int(total_elems), action="reduce",
+                                 tier="ici"))
+        if rnd:
+            sched.phase_a.append(rnd)
+
+    # phase B: flat allreduce over the leader set, blocks a near-equal
+    # element partition; plan ranks remap onto leader app ranks
+    if len(leaders) > 1:
+        flat = compile_allreduce(len(leaders),
+                                 partition_elems(total_elems, len(leaders)),
+                                 algorithm=algorithm,
+                                 chunk_elems=chunk_elems)
+        for rnd in flat.rounds:
+            sched.phase_b.append([
+                HRMsg(src=leaders[m.src], dst=leaders[m.dst],
+                      offset=m.offset, nelems=m.nelems, action=m.action,
+                      tier="dcn")
+                for m in rnd])
+        sched.dcn_rounds = len(sched.phase_b)
+        sched.dcn_elems = sum(m.nelems for rnd in sched.phase_b for m in rnd)
+
+    # phase C: each leader copies the reduced vector back, one member
+    # per round (mirror of phase A)
+    for j in range(depth):
+        rnd = []
+        for n, lead in enumerate(leaders):
+            if j < len(members[n]):
+                rnd.append(HRMsg(src=lead, dst=members[n][j], offset=0,
+                                 nelems=int(total_elems), action="copy",
+                                 tier="ici"))
+        if rnd:
+            sched.phase_c.append(rnd)
+    return sched

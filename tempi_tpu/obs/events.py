@@ -35,6 +35,10 @@ EVENTS = (
     # coll/persistent.py — persistent-collective schedules
     "coll.choice",       # plan choice (flat vs hier; forced or modeled)
     "coll.round",        # one schedule round dispatched (span)
+    # coll/reduce.py + coll/persistent.py — reduction round plans (ISSUE 14)
+    "redcoll.choice",    # reduction method choice (fused/ring/halving/
+                         # hier; forced or modeled, with estimates)
+    "redcoll.round",     # one reduction round dispatched (span; tier)
     # tune/online.py — online performance-model adaptation
     "tune.drift",        # a bin's swept prediction declared stale
     "tune.adopt",        # adapt mode re-ranked a decision

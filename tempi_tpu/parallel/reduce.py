@@ -10,10 +10,27 @@ the reference's "library path".
 
 Buffers are DistBuffer byte rows; ``dtype`` gives the element view
 (MPI_DOUBLE ≙ float64 etc.). Ops: sum, max, min.
+
+The elementwise op seams live here and are shared with the reduction
+round-plan engine (ISSUE 14, ``coll/reduce.py``): ``_OPS`` maps op names
+onto the device collectives, :data:`HOST_OPS` maps the same names onto
+the numpy ufuncs the compiled round plans accumulate with, and
+:func:`elem_dtype` is the one loud dtype gate both paths validate
+through.
+
+Compiled programs ride a MODULE-LEVEL cache (ISSUE 14 satellite — the
+ISSUE 12 ``p2p._strategy_cache`` fix applied to programs): the jitted
+step is a pure function of (mesh devices, nbytes, dtype, op, root), not
+of communicator identity, yet the old per-communicator plan-cache entry
+made every derived dist-graph communicator (each shrink/grow/replace
+rebuild, every bench phase) recompile identical reductions from cold.
+Hits/misses land in the ``modeling`` counter group, the same evidence
+surface the strategy decision cache reports on.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional
 
 import jax
@@ -30,12 +47,34 @@ _OPS = {
     "min": jax.lax.pmin,
 }
 
+#: The host-side elementwise seam of the same op vocabulary: what the
+#: compiled reduction round plans (coll/reduce.py) accumulate with on
+#: their staged host passes. One table, two executors — an op added here
+#: without a ufunc (or vice versa) is a registry drift the tests pin.
+HOST_OPS = {
+    "sum": "add",
+    "max": "maximum",
+    "min": "minimum",
+}
 
-def _build(comm: Communicator, nbytes: int, dtype, op: str,
-           root: Optional[int]):
-    # with x64 disabled jax would silently compute a float64 view in
-    # float32, reinterpreting each double as two unrelated singles — refuse
-    # rather than reduce garbage
+
+def host_op(op: str):
+    """The numpy ufunc of a registered op name (loud on typos — a wrong
+    op must fail the compile, never quietly sum a max)."""
+    import numpy as np
+
+    if op not in HOST_OPS:
+        raise ValueError(f"unknown reduction op {op!r}; known: "
+                         f"{tuple(HOST_OPS)}")
+    return getattr(np, HOST_OPS[op])
+
+
+def elem_dtype(nbytes: int, dtype):
+    """The one loud dtype gate of every reduction path: refuse dtypes
+    that canonicalize away (float64 under disabled x64 would silently
+    reinterpret each double as two unrelated singles) and buffers that
+    are not a whole number of elements. Returns the numpy dtype of the
+    element view."""
     import numpy as np
 
     jdt = jnp.dtype(jax.dtypes.canonicalize_dtype(dtype))
@@ -46,6 +85,12 @@ def _build(comm: Communicator, nbytes: int, dtype, op: str,
     if nbytes % jdt.itemsize:
         raise ValueError(f"buffer of {nbytes} B is not a whole number of "
                          f"{jdt.name} elements")
+    return np.dtype(jdt)
+
+
+def _build(comm: Communicator, nbytes: int, dtype, op: str,
+           root: Optional[int]):
+    jdt = jnp.dtype(elem_dtype(nbytes, dtype))
     collective = _OPS[op]
 
     def step(x):
@@ -65,36 +110,73 @@ def _build(comm: Communicator, nbytes: int, dtype, op: str,
     return jax.jit(sm)
 
 
-def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
-         root: Optional[int]) -> None:
+#: Module-level compiled-program cache (see the module docstring): the
+#: key carries everything the program closes over — the mesh's device
+#: ids (derived communicators over the same devices share programs; a
+#: different mesh can never collide), buffer width, element view, op,
+#: and the root LIBRARY rank (mapping-independent for allreduce's
+#: ``root=None``). LRU-bounded like the per-comm plan cache; mutated
+#: without a lock like ``p2p._strategy_cache`` — a concurrent duplicate
+#: compile or lost insert is benign (the program is a pure function),
+#: never a wrong answer.
+_PROGRAM_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_PROGRAM_CACHE_MAX = 64
+
+
+def _program_key(comm: Communicator, nbytes: int, dtype, op: str,
+                 root: Optional[int]) -> tuple:
     import numpy as np
 
-    # the LRU cache access (structural OrderedDict mutation, possible
-    # eviction releasing a staging slab) and the device collective run
-    # under the progress lock like barrier() below and every collective
-    # dispatcher — but the jit BUILD happens OUTSIDE it (the fused-halo
-    # discipline: a first-use compile must not freeze a background pump
-    # mid-exchange for the whole compile)
-    from .plan import cache_get, cache_put
-    key = ("reduce", buf.nbytes, np.dtype(dtype).name, op, root)
+    return (tuple(d.id for d in comm.mesh.devices.flat), nbytes,
+            np.dtype(dtype).name, op, root)
+
+
+def get_program(comm: Communicator, nbytes: int, dtype, op: str,
+                root: Optional[int]):
+    """The compiled reduction step for this (mesh, shape, op) — a cache
+    hit for every communicator sharing the mesh, counted in the
+    ``modeling`` group (the decision-cache evidence surface). The jit
+    BUILD happens outside any lock AND is lowered+compiled eagerly here
+    (jax.jit is lazy; merely building it would push the multi-second
+    trace+compile into the caller's locked dispatch — the fused-halo
+    discipline)."""
+    key = _program_key(comm, nbytes, dtype, op, root)
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is not None:
+        _PROGRAM_CACHE.move_to_end(key)
+        ctr.counters.modeling.cache_hit += 1
+        return fn
+    ctr.counters.modeling.cache_miss += 1
+    with ctr.timed(ctr.counters.modeling, "wall_time"):
+        built = _build(comm, nbytes, dtype, op, root)
+        import numpy as np
+        shape = jax.ShapeDtypeStruct((comm.size, nbytes), np.uint8,
+                                     sharding=comm.sharding())
+        built = built.lower(shape).compile()
+    fn = _PROGRAM_CACHE.setdefault(key, built)  # a racer's insert wins
+    _PROGRAM_CACHE.move_to_end(key)
+    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.popitem(last=False)
+    return fn
+
+
+def clear_programs() -> None:
+    """Drop every cached program (api.finalize, test isolation): a later
+    session may bring up a different backend whose device ids collide
+    with this one's — a stale program bound to torn-down devices must
+    never be read back."""
+    _PROGRAM_CACHE.clear()
+
+
+def _run(comm: Communicator, buf: DistBuffer, dtype, op: str,
+         root: Optional[int]) -> None:
+    # validate + compile (or cache-hit) OUTSIDE the lock, then dispatch
+    # the device collective under it like barrier() below and every
+    # collective dispatcher
     with comm._progress_lock:
         if comm.freed:
             raise RuntimeError("communicator has been freed")
-        fn = cache_get(comm, key)
-    if fn is None:
-        # AOT: jax.jit is lazy, so the un-traced wrapper must be lowered
-        # and compiled HERE — merely building it outside the lock would
-        # push the multi-second trace+compile into the locked dispatch
-        # below (the fused-halo _build_fused discipline)
-        built = _build(comm, buf.nbytes, dtype, op, root)
-        built = built.lower(buf.data).compile()
-        with comm._progress_lock:
-            if comm.freed:
-                raise RuntimeError("communicator has been freed")
-            fn = cache_get(comm, key)  # another thread may have won
-            if fn is None:
-                fn = built
-                cache_put(comm, key, fn)
+    fn = get_program(comm, buf.nbytes, dtype, op, root)
     with comm._progress_lock:
         if comm.freed:
             raise RuntimeError("communicator has been freed")

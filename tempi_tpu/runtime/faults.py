@@ -108,6 +108,15 @@ SITES = (
                           # per-round retry loop re-dispatches safely;
                           # wedge refused for the same progress-lock
                           # reason as coll.round)
+    "redcoll.round",      # each round of a persistent REDUCTION plan
+                          # (coll/persistent.py, ISSUE 14 — fires BEFORE
+                          # the round dispatches, so a raise never
+                          # leaves a round half-applied; a restart
+                          # rebuilds the host staging from the (still
+                          # unmodified) device buffers, so re-dispatch
+                          # after the pre-dispatch raise is safe; wedge
+                          # refused — rounds run under the progress
+                          # lock, same rationale as coll.round)
     "replace.apply",      # each rank re-placement apply step
                           # (parallel/replacement.py — fires BEFORE the
                           # new permutation is installed, so a raise

@@ -91,6 +91,18 @@ class CollCounters:
     hier_rounds_dcn: int = 0  # leader-exchange rounds run
     hier_dcn_msgs: int = 0   # aggregated node-pair messages compiled
     hier_dcn_bytes: int = 0  # bytes the compiled plans move over DCN
+    # reduction collectives (ISSUE 14; coll/reduce.py + the persistent
+    # handles): pinned at zero whenever the init APIs are unused — the
+    # counter-based byte-for-byte guard that one-shot allreduce/reduce
+    # never touch the round-plan engine
+    reduce_compiles: int = 0    # reduction plans compiled (incl. recompiles)
+    reduce_recompiles: int = 0  # invalidation-driven reduction recompiles
+    reduce_replays: int = 0     # start() calls replaying a compiled plan
+    reduce_rounds: int = 0      # reduction rounds dispatched
+    reduce_hier_compiles: int = 0   # two-level reduction plans built
+    reduce_hier_rounds_ici: int = 0  # intra-node (reduce/broadcast) rounds
+    reduce_hier_rounds_dcn: int = 0  # leader-exchange rounds run
+    reduce_wire_bytes: int = 0  # bytes the dispatched rounds moved
 
 
 @dataclass

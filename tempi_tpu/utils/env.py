@@ -138,6 +138,32 @@ coll/schedule.compile_hier_schedule, coll/persistent.py and the README
                          knob. Unset = inherit TEMPI_COLL_CHUNK_BYTES;
                          negative rejected loudly; 0 disables splitting.
 
+Reduction-collective knobs (ISSUE 14; see coll/reduce.py,
+coll/persistent.py and the README "Reduction collectives" section):
+  TEMPI_REDCOLL        = off | auto | ring | halving — the round-plan
+                         engine behind api.allreduce_init /
+                         reduce_scatter_init / allgather_init (default
+                         auto: ring and recursive-halving plans compete
+                         with the fused library lowering in the
+                         model-driven AUTO choice, costed per
+                         (algorithm, link tier, nbytes) from the
+                         measured sheet). ``ring``/``halving`` force
+                         that algorithm family (env-forced: never
+                         overridden by breakers or tune; a forced
+                         ``halving`` on a non-power-of-two world
+                         degrades to ring identically — no halving plan
+                         exists there). ``off`` disarms the engine: the
+                         init APIs refuse with a pointer at this knob
+                         and one-shot allreduce/reduce stay the only
+                         reduction surface (byte-for-byte the
+                         pre-ISSUE-14 behavior).
+  TEMPI_REDCOLL_CHUNK_BYTES  chunk threshold of the reduction round
+                         plans: bounds the bytes any single round moves
+                         per rank — larger reductions compile as
+                         consecutive per-segment sub-plans (default
+                         4 MiB; 0 disables splitting; negative rejected
+                         loudly).
+
 Multi-tenant QoS knobs (ISSUE 7; see runtime/qos.py, runtime/progress.py
 and the README "Multi-tenant QoS" section):
   TEMPI_QOS_DEFAULT    = latency | bulk — the QoS class of communicators
@@ -370,6 +396,9 @@ KNOWN_KNOBS = (
     "TEMPI_COLL_HIER",
     "TEMPI_COLL_CHUNK_BYTES_ICI",
     "TEMPI_COLL_CHUNK_BYTES_DCN",
+    # reduction collectives (ISSUE 14)
+    "TEMPI_REDCOLL",
+    "TEMPI_REDCOLL_CHUNK_BYTES",
     # multi-tenant QoS (ISSUE 7)
     "TEMPI_QOS_DEFAULT",
     "TEMPI_QOS_QUEUE_DEPTH",
@@ -527,6 +556,11 @@ class Environment:
     coll_hier: str = "auto"        # flat | hier | auto
     coll_chunk_bytes_ici: int = -1  # -1 = inherit coll_chunk_bytes
     coll_chunk_bytes_dcn: int = -1  # -1 = inherit coll_chunk_bytes
+    # reduction collectives (ISSUE 14) — see coll/reduce.py and the
+    # persistent handle layer in coll/persistent.py
+    redcoll: str = "auto"          # off | auto | ring | halving
+    redcoll_chunk_bytes: int = 1 << 22  # per-round per-rank byte bound
+    #                                     (0 = no splitting)
     # multi-tenant QoS (no reference analog; ISSUE 7) — see runtime/qos.py
     # (class scheduler) and runtime/progress.py (pump integration)
     qos_default: str = ""          # "" = QoS off | latency | bulk
@@ -775,6 +809,20 @@ class Environment:
         e.coll_chunk_bytes_ici = _tier_chunk("TEMPI_COLL_CHUNK_BYTES_ICI")
         e.coll_chunk_bytes_dcn = _tier_chunk("TEMPI_COLL_CHUNK_BYTES_DCN")
 
+        # reduction-collective knobs parse loudly too: a typo'd
+        # TEMPI_REDCOLL silently falling back to auto would quietly
+        # change which ALGORITHM a production allreduce compiled — the
+        # exact class of surprise the loud-parse constraint exists to
+        # prevent
+        rc = (getenv("TEMPI_REDCOLL") or "auto").lower()
+        if rc not in ("off", "auto", "ring", "halving"):
+            raise ValueError(
+                f"bad TEMPI_REDCOLL={rc!r}: want off | auto | ring | "
+                "halving")
+        e.redcoll = rc
+        e.redcoll_chunk_bytes = _pos_int_env("TEMPI_REDCOLL_CHUNK_BYTES",
+                                             1 << 22)
+
         # QoS knobs parse loudly too: a typo'd class name silently leaving
         # QoS off would hand the one multi-tenant deployment that asked
         # for isolation the exact head-of-line blocking it configured
@@ -944,6 +992,9 @@ class Environment:
             # strategy modeling" means the flat schedule, never a
             # leader-staged hierarchy
             e.coll_hier = "flat"
+            # ...and the reduction round-plan engine: the bail-out's
+            # reductions are the library's fused lowering only
+            e.redcoll = "off"
             # ...and re-placement: "no placement remap" is the bail-out's
             # explicit contract, one-shot AND online
             e.replace_mode = "off"

@@ -41,6 +41,11 @@ def pytest_configure(config):
         "<30s smoke is `pytest -m hier`)")
     config.addinivalue_line(
         "markers",
+        "redcoll: reduction-collective round-plan tests — ring/halving "
+        "schedules, persistent handles, the two-level reduction (the "
+        "<30s smoke is `pytest -m redcoll`)")
+    config.addinivalue_line(
+        "markers",
         "qos: multi-tenant QoS scheduler tests (the <30s smoke is "
         "`pytest -m qos`)")
     config.addinivalue_line(

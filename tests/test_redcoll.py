@@ -1,0 +1,721 @@
+"""Reduction collectives (ISSUE 14): the ring/halving round-plan compiler
+(coll/reduce.py), the persistent handles (coll/persistent.PersistentReduce),
+the two-level reduction plan, and the satellites.
+
+Marker ``redcoll`` is the tier-1-compatible <30s smoke (`pytest -m
+redcoll`), like the coll/hier markers; the chaos variants are dual-marked
+``faults`` so the chaos smoke exercises the ``redcoll.round`` site.
+"""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.coll import reduce as redsched
+from tempi_tpu.runtime import faults, health
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.redcoll
+
+
+def _bf16():
+    import jax.numpy as jnp
+    return np.dtype(jnp.bfloat16)
+
+
+#: The property-sweep dtype/op grid: integer-valued payloads keep float
+#: accumulation EXACT in any association order (bf16's 8-bit mantissa
+#: holds integers up to 256 exactly; sums here stay well below), so
+#: byte-exactness against the dense reference is well-defined for sum
+#: too, not just max/min.
+def _dtype_grid():
+    return [(np.float32, "f32"), (_bf16(), "bf16"), (np.int32, "i32")]
+
+
+def _np_op(op):
+    from tempi_tpu.parallel.reduce import host_op
+    return host_op(op)
+
+
+def _rand_counts(size, seed, hi=9):
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, hi, size)
+    if counts.sum() == 0:
+        counts[0] = 3
+    return counts.tolist()
+
+
+def _rand_rows(size, total, dtype, seed, hi=4):
+    rng = np.random.default_rng(seed + 1)
+    return [rng.integers(0, hi, total).astype(dtype) for _ in range(size)]
+
+
+# -- pure compiler properties (no mesh) ---------------------------------------
+
+
+@pytest.mark.parametrize("size", [2, 3, 5, 7, 8, 16])  # non-pow2 included
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+@pytest.mark.parametrize("dtype,_label", _dtype_grid())
+def test_allreduce_byte_exact_vs_dense_reference(size, op, dtype, _label):
+    """The acceptance property: allreduce byte-exactness vs the dense
+    numpy reference across dtypes, ops, and non-power-of-two worlds with
+    ragged counts — for every algorithm that exists at the size."""
+    counts = _rand_counts(size, seed=size)
+    rows = _rand_rows(size, sum(counts), dtype, seed=size)
+    # np.add.reduce promotes sub-platform ints; the reference must stay
+    # in the collective's dtype (values are tiny, so the cast is exact)
+    dense = _np_op(op).reduce(rows, axis=0).astype(dtype)
+    for alg in redsched.algorithms_for(size):
+        s = redsched.compile_allreduce(size, counts, alg)
+        s.check_pairing()
+        got = s.simulate(rows, _np_op(op))
+        for r in range(size):
+            np.testing.assert_array_equal(
+                np.asarray(got[r]).view(np.uint8),
+                np.asarray(dense).view(np.uint8))
+
+
+@pytest.mark.parametrize("size", [3, 5, 8])
+@pytest.mark.parametrize("op", ["sum", "max"])
+def test_reduce_scatter_and_allgather_byte_exact(size, op):
+    """reduce_scatter delivers the reduced block r to rank r exactly;
+    allgather delivers every block everywhere — both algorithm families,
+    ragged counts with zero blocks."""
+    counts = _rand_counts(size, seed=size + 40)
+    total = sum(counts)
+    rows = _rand_rows(size, total, np.int32, seed=size + 40, hi=50)
+    dense = _np_op(op).reduce(rows, axis=0)
+    for alg in redsched.algorithms_for(size):
+        rs = redsched.compile_reduce_scatter(size, counts, alg)
+        rs.check_pairing()
+        got = rs.simulate(rows, _np_op(op))
+        for r in range(size):
+            sl = rs.owned_slice(r)
+            np.testing.assert_array_equal(got[r][sl], dense[sl])
+        # allgather: rank r starts valid only in its own block
+        ag_rows = []
+        want = np.zeros(total, np.int32)
+        for r in range(size):
+            sl = rs.owned_slice(r)
+            buf = np.zeros(total, np.int32)
+            buf[sl] = rows[r][sl]
+            want[sl] = rows[r][sl]
+            ag_rows.append(buf)
+        ag = redsched.compile_allgather(size, counts, alg)
+        ag.check_pairing()
+        got_ag = ag.simulate(ag_rows, np.add)
+        for r in range(size):
+            np.testing.assert_array_equal(got_ag[r], want)
+
+
+def test_chunk_segmentation_bounds_round_volume():
+    """TEMPI_REDCOLL_CHUNK_BYTES' compiler-level contract: no round moves
+    more than chunk_elems per rank, segments ride consecutive sub-plans,
+    and delivery stays exact."""
+    size = 8
+    counts = [13, 0, 7, 22, 3, 9, 1, 5]
+    rows = _rand_rows(size, sum(counts), np.int64, seed=2, hi=100)
+    dense = np.add.reduce(rows, axis=0)
+    for alg in ("ring", "halving"):
+        s = redsched.compile_allreduce(size, counts, alg, chunk_elems=4)
+        s.check_pairing()
+        got = s.simulate(rows, np.add)
+        for r in range(size):
+            np.testing.assert_array_equal(got[r], dense)
+        unchunked = redsched.compile_allreduce(size, counts, alg)
+        assert len(s.rounds) > len(unchunked.rounds)
+        if alg == "ring":
+            # one block per pair per round: the per-rank bound is exact
+            assert max(s.round_max_elems()) <= 4
+    # chunk larger than every block: plan identical to unchunked
+    a = redsched.compile_allreduce(size, counts, "ring", chunk_elems=64)
+    b = redsched.compile_allreduce(size, counts, "ring")
+    assert a.rounds == b.rounds
+
+
+def test_halving_refused_at_non_pow2_and_deterministic():
+    with pytest.raises(ValueError, match="power-of-two"):
+        redsched.compile_allreduce(6, [1] * 6, "halving")
+    a = redsched.compile_allreduce(8, [3] * 8, "halving", chunk_elems=2)
+    b = redsched.compile_allreduce(8, [3] * 8, "halving", chunk_elems=2)
+    assert a.rounds == b.rounds
+    assert redsched.algorithms_for(8) == ("ring", "halving")
+    assert redsched.algorithms_for(6) == ("ring",)
+
+
+def test_halving_round_count_is_logarithmic():
+    """The point of the halving family: log2(size) rounds per phase vs
+    the ring's size-1 — the structure the AUTO cost model prices."""
+    size = 16
+    counts = [4] * size
+    rs_ring = redsched.compile_reduce_scatter(size, counts, "ring")
+    rs_half = redsched.compile_reduce_scatter(size, counts, "halving")
+    assert len(rs_ring.rounds) == size - 1
+    assert len(rs_half.rounds) == 4  # log2(16)
+    ar = redsched.compile_allreduce(size, counts, "halving")
+    assert len(ar.rounds) == 8  # halving RS + doubling AG
+
+
+def test_partition_elems_near_equal():
+    assert redsched.partition_elems(10, 4) == [3, 3, 2, 2]
+    assert redsched.partition_elems(3, 8) == [1, 1, 1, 0, 0, 0, 0, 0]
+    assert sum(redsched.partition_elems(1 << 20, 7)) == 1 << 20
+
+
+@pytest.mark.parametrize("rpn", [2, 3, 4])  # 3 leaves 8 ranks RAGGED
+def test_hier_reduce_invariants_and_exact_delivery(rpn):
+    """The two-level reduction plan, phase-tested like test_hier.py does
+    for alltoallv: per-round pairing, tier separation (phase A/C never
+    cross a node, phase B leader-to-leader only), and exact delivery via
+    the three-phase simulation over even AND ragged node maps."""
+    size = 8
+    node_of = [i // rpn for i in range(size)]
+    nn = max(node_of) + 1
+    leaders = [min(r for r in range(size) if node_of[r] == n)
+               for n in range(nn)]
+    for alg in redsched.algorithms_for(nn):
+        hs = redsched.compile_hier_reduce(23, node_of, leaders, alg,
+                                          chunk_elems=5)
+        hs.check_pairing()
+        hs.check_tier_separation()
+        assert hs.dcn_rounds == len(hs.phase_b) > 0
+        rows = _rand_rows(size, 23, np.int64, seed=rpn, hi=100)
+        dense = np.add.reduce(rows, axis=0)
+        got = hs.simulate(rows, np.add)
+        for r in range(size):
+            np.testing.assert_array_equal(got[r], dense)
+
+
+def test_hier_reduce_leader_on_wrong_node_refused():
+    with pytest.raises(AssertionError, match="leader"):
+        redsched.compile_hier_reduce(8, [0, 0, 1, 1], [0, 1], "ring")
+
+
+def test_host_ops_cover_the_device_op_table():
+    """The elementwise op seam: every device collective op has a host
+    ufunc and vice versa — the registry-drift guard of the shared
+    vocabulary."""
+    from tempi_tpu.parallel.reduce import HOST_OPS, _OPS, host_op
+    assert set(HOST_OPS) == set(_OPS)
+    assert host_op("sum") is np.add
+    with pytest.raises(ValueError, match="unknown reduction op"):
+        host_op("product")
+
+
+# -- runtime on the 8-device CPU mesh -----------------------------------------
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+@pytest.fixture()
+def make_world():
+    """Deferred init (the test_hier pattern): topology discovery reads
+    TEMPI_RANKS_PER_NODE at api.init(), so tests arming a synthetic node
+    map must init AFTER the env is set."""
+    inited = []
+
+    def f():
+        comm = api.init()
+        inited.append(comm)
+        return comm
+
+    yield f
+    if inited:
+        api.finalize()
+
+
+def _fill(comm, vals):
+    return comm.buffer_from_host(
+        [np.ascontiguousarray(v).view(np.uint8).copy() for v in vals])
+
+
+def _elems(buf, rank, dtype, n):
+    return buf.get_rank(rank)[: n * np.dtype(dtype).itemsize].view(dtype)
+
+
+@pytest.mark.parametrize("alg", ["ring", "halving"])
+def test_allreduce_runtime_byte_identical_and_replays(world, alg):
+    """Forced round plans deliver byte-identically to the dense
+    reference on the mesh, and a second start() is a counted replay that
+    reduces the (already reduced) buffer again — the in-place one-shot
+    semantics, counter-pinned compile-once."""
+    envmod.env.redcoll = alg
+    n = 24
+    vals = [np.arange(n, dtype=np.float32) + r for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method == alg
+    assert ctr.counters.coll.reduce_compiles == 1
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n), want)
+    pr.start()
+    pr.wait()
+    assert ctr.counters.coll.reduce_compiles == 1
+    assert ctr.counters.coll.reduce_replays == 1
+    assert ctr.counters.coll.reduce_rounds > 0
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n),
+                                      want * world.size)
+    pr.free()
+    with pytest.raises(RuntimeError, match="freed"):
+        pr.start()
+
+
+def test_reduce_scatter_runtime_ragged(world):
+    envmod.env.redcoll = "halving"
+    counts = [3, 5, 0, 2, 7, 1, 4, 2][: world.size]
+    total = sum(counts)
+    vals = [np.random.default_rng(r).integers(0, 99, total, np.int64)
+            .astype(np.int32) for r in range(world.size)]
+    sb = _fill(world, vals)
+    rb = world.alloc(max(counts) * 4)
+    pr = api.reduce_scatter_init(world, sb, counts, rb, dtype=np.int32,
+                                 op="max")
+    assert pr.method == "halving"
+    pr.start()
+    pr.wait()
+    dense = np.maximum.reduce(vals, axis=0)
+    offs = np.concatenate(([0], np.cumsum(counts)))
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(rb, r, np.int32, counts[r]),
+            dense[offs[r]: offs[r + 1]])
+    pr.free()
+
+
+def test_allgather_runtime_ragged(world):
+    counts = [2, 4, 1, 3, 0, 5, 1, 2][: world.size]
+    total = sum(counts)
+    rng = np.random.default_rng(3)
+    contrib = [rng.integers(0, 99, counts[r]).astype(np.int32)
+               for r in range(world.size)]
+    width = max(counts) * 4
+    sb = _fill(world, [np.concatenate([
+        c.view(np.uint8), np.zeros(width - c.nbytes, np.uint8)])
+        for c in contrib])
+    rb = world.alloc(total * 4)
+    envmod.env.redcoll = "ring"
+    pr = api.allgather_init(world, sb, counts, rb, dtype=np.int32)
+    pr.start()
+    pr.wait()
+    want = np.concatenate(contrib)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(rb, r, np.int32, total), want)
+    pr.free()
+
+
+def test_bf16_runtime_byte_exact(world):
+    """bf16 rides the same round plans byte-exactly (integer-valued
+    payloads keep the accumulation order-independent)."""
+    envmod.env.redcoll = "ring"
+    dt = _bf16()
+    n = 16
+    vals = [(np.arange(n) % 5 + r % 3).astype(dt)
+            for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=dt, op="sum")
+    pr.start()
+    pr.wait()
+    want = np.add.reduce([v.astype(np.float64) for v in vals],
+                         axis=0).astype(dt)
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, dt, n).view(np.uint8),
+            want.view(np.uint8))
+    pr.free()
+
+
+def test_auto_unmeasured_defaults_fused_and_matches_oneshot(world):
+    """On an unmeasured sheet AUTO keeps the TPU-first fused default for
+    allreduce (round plans are costed in, never guessed into) and the
+    result is byte-identical to the one-shot api.allreduce."""
+    from tempi_tpu.measure import system as msys
+    prior = msys.get()
+    try:
+        msys.set_system(msys.SystemPerformance())
+        n = 16
+        vals = [np.full(n, r + 1, np.float32) for r in range(world.size)]
+        buf = _fill(world, vals)
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        assert pr.method == "fused"
+        pr.start()
+        pr.wait()
+        buf2 = _fill(world, vals)
+        api.allreduce(world, buf2, dtype=np.float32, op="sum")
+        for r in range(world.size):
+            np.testing.assert_array_equal(buf.get_rank(r), buf2.get_rank(r))
+        pr.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_auto_is_costed_from_the_sheet(world):
+    """A measured sheet whose host moves are cheap and whose fused
+    collective is expensive steers AUTO onto a round plan — the
+    per-(algorithm, tier, nbytes) model-driven choice."""
+    from tempi_tpu.measure import system as msys
+    prior = msys.get()
+    try:
+        sp = msys.SystemPerformance()
+        cheap = [(1, 1e-9), (1 << 22, 1e-7)]
+        sp.d2h = list(cheap)
+        sp.h2d = list(cheap)
+        sp.host_pingpong = list(cheap)
+        sp.intra_node_pingpong = [(1, 1.0), (1 << 22, 2.0)]
+        sp.inter_node_pingpong = [(1, 1.0), (1 << 22, 2.0)]
+        msys.set_system(sp)
+        buf = world.alloc(1 << 12)
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        assert pr.method in ("ring", "halving")
+        pr.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_oneshot_counters_pinned_when_init_apis_unused(world):
+    """The acceptance pin: one-shot allreduce/reduce never touch the
+    round-plan engine — every coll.reduce_* counter stays zero."""
+    buf = world.alloc(64)
+    api.allreduce(world, buf, dtype=np.float32, op="sum")
+    api.reduce(world, buf, root=0, dtype=np.float32, op="max")
+    snap = api.counters_snapshot()["coll"]
+    assert all(v == 0 for k, v in snap.items() if k.startswith("reduce_"))
+
+
+def test_program_cache_hits_across_derived_communicators(world):
+    """The ISSUE 12-style fix: the jitted reduction step is keyed on
+    (mesh devices, shape, op), not communicator identity — a derived
+    dist-graph communicator reuses the compiled program (previously a
+    guaranteed cold recompile per derived comm)."""
+    buf = world.alloc(128)
+    api.allreduce(world, buf, dtype=np.float32, op="sum")
+    misses = ctr.counters.modeling.cache_miss
+    hits = ctr.counters.modeling.cache_hit
+    api.allreduce(world, buf, dtype=np.float32, op="sum")
+    assert ctr.counters.modeling.cache_hit == hits + 1
+    peers = [[(r + 1) % world.size] for r in range(world.size)]
+    derived = api.dist_graph_create_adjacent(world, peers, peers)
+    buf2 = derived.alloc(128)
+    api.allreduce(derived, buf2, dtype=np.float32, op="sum")
+    assert ctr.counters.modeling.cache_miss == misses  # no cold recompile
+    assert ctr.counters.modeling.cache_hit == hits + 2
+
+
+def test_redcoll_off_refuses_and_disable_forces_off(world, monkeypatch):
+    envmod.env.redcoll = "off"
+    buf = world.alloc(64)
+    with pytest.raises(RuntimeError, match="TEMPI_REDCOLL"):
+        api.allreduce_init(world, buf, dtype=np.float32)
+    # one-shot stays available under off
+    api.allreduce(world, buf, dtype=np.float32, op="sum")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    monkeypatch.setenv("TEMPI_REDCOLL", "ring")
+    envmod.read_environment()
+    assert envmod.env.redcoll == "off"
+
+
+def test_redcoll_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_REDCOLL", "sideways")
+    with pytest.raises(ValueError, match="TEMPI_REDCOLL"):
+        envmod.read_environment()
+    monkeypatch.delenv("TEMPI_REDCOLL")
+    for bad in ("-1", "lots"):
+        monkeypatch.setenv("TEMPI_REDCOLL_CHUNK_BYTES", bad)
+        with pytest.raises(ValueError, match="TEMPI_REDCOLL_CHUNK_BYTES"):
+            envmod.read_environment()
+        monkeypatch.delenv("TEMPI_REDCOLL_CHUNK_BYTES")
+    envmod.read_environment()
+    assert envmod.env.redcoll == "auto"
+    assert envmod.env.redcoll_chunk_bytes == 1 << 22
+
+
+def test_init_validation_errors(world):
+    sb = world.alloc(16)
+    rb = world.alloc(16)
+    with pytest.raises(ValueError, match="one entry per rank"):
+        api.reduce_scatter_init(world, sb, [1, 2], rb, dtype=np.int32)
+    with pytest.raises(ValueError, match="cannot hold"):
+        api.reduce_scatter_init(world, sb, [8] * world.size, rb,
+                                dtype=np.int32)
+    with pytest.raises(ValueError, match="cannot hold"):
+        api.allgather_init(world, sb, [8] * world.size, rb, dtype=np.int32)
+    with pytest.raises(ValueError, match="unknown reduction op"):
+        api.allreduce_init(world, sb, dtype=np.int32, op="product")
+    with pytest.raises(ValueError, match="whole number"):
+        api.allreduce_init(world, world.alloc(7), dtype=np.float32)
+
+
+def _force_hier(monkeypatch, rpn="2"):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", rpn)
+    monkeypatch.setenv("TEMPI_COLL_HIER", "hier")
+    envmod.read_environment()
+
+
+@pytest.mark.parametrize("rpn", ["2", "3", "4"])  # 3 = ragged last node
+def test_hier_runtime_byte_identical(make_world, monkeypatch, rpn):
+    """Forced two-level reduction: byte-identical to the dense reference
+    on even and ragged node maps, with ICI and DCN round evidence."""
+    _force_hier(monkeypatch, rpn)
+    world = make_world()
+    n = 20
+    vals = [np.arange(n, dtype=np.float32) * (r + 1)
+            for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method.startswith("hier_")
+    assert ctr.counters.coll.reduce_hier_compiles == 1
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n), want)
+    assert ctr.counters.coll.reduce_hier_rounds_ici > 0
+    assert ctr.counters.coll.reduce_hier_rounds_dcn > 0
+    pr.free()
+
+
+def test_hier_forced_halving_degrades_to_ring_on_non_pow2_leaders(
+        make_world, monkeypatch):
+    """Forced halving with a non-power-of-two LEADER count (3 nodes):
+    the DCN leg degrades to the ring family identically — the
+    forced-hier-on-one-node precedent applied to the algorithm."""
+    _force_hier(monkeypatch, "3")  # 8 ranks -> 3 nodes -> 3 leaders
+    monkeypatch.setenv("TEMPI_REDCOLL", "halving")
+    envmod.read_environment()
+    world = make_world()
+    buf = world.alloc(64)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method == "hier_ring"
+    pr.free()
+
+
+def test_hier_never_chosen_on_single_node(world):
+    """No DCN tier to aggregate for: AUTO never picks hier on one node
+    and forcing it falls back to the flat plans identically — hier
+    counters pinned."""
+    envmod.env.coll_hier = "hier"
+    envmod.env.redcoll = "ring"
+    buf = world.alloc(64)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method == "ring"
+    pr.start()
+    pr.wait()
+    pr.free()
+    assert ctr.counters.coll.reduce_hier_compiles == 0
+    assert ctr.counters.coll.reduce_hier_rounds_dcn == 0
+
+
+def test_breaker_recompiles_auto_choice_not_forced(world):
+    """The precedence contract at the reduction layer: an open breaker
+    on the chosen method's transport recompiles an AUTO choice onto a
+    healthy method before the next start; an env-forced algorithm is
+    never overridden."""
+    from tempi_tpu.coll.persistent import _UNDERLYING_RED
+    from tempi_tpu.measure import system as msys
+    prior = msys.get()
+    try:
+        sp = msys.SystemPerformance()
+        cheap = [(1, 1e-9), (1 << 22, 1e-7)]
+        dear = [(1, 1e-3), (1 << 22, 2e-3)]
+        sp.d2h = list(cheap)
+        sp.h2d = list(cheap)
+        sp.host_pingpong = list(cheap)
+        sp.intra_node_pingpong = list(dear)
+        sp.inter_node_pingpong = list(dear)
+        msys.set_system(sp)
+        buf = world.alloc(1 << 12)
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        assert pr.method in ("ring", "halving")  # AUTO-chosen host plan
+        pr.start()
+        pr.wait()
+        for lk in pr.links:
+            for _ in range(envmod.env.breaker_threshold):
+                health.record_failure(lk, _UNDERLYING_RED[pr.method],
+                                      error="synthetic")
+        assert health.TRIPPED
+        recompiles = ctr.counters.coll.reduce_recompiles
+        pr.start()
+        pr.wait()
+        assert ctr.counters.coll.reduce_recompiles == recompiles + 1
+        assert pr.method == "fused"  # the healthy device path
+        pr.free()
+        # forced algorithm: breakers never override explicit config
+        health.reset()
+        envmod.env.redcoll = "ring"
+        pr2 = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        pr2.start()
+        pr2.wait()
+        for lk in pr2.links:
+            for _ in range(envmod.env.breaker_threshold):
+                health.record_failure(lk, "staged", error="synthetic")
+        recompiles = ctr.counters.coll.reduce_recompiles
+        pr2.start()
+        pr2.wait()
+        assert ctr.counters.coll.reduce_recompiles == recompiles
+        assert pr2.method == "ring"
+        pr2.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_mapping_epoch_recompiles(world):
+    """An applied rank re-placement bumps the epoch; the next start()
+    rebuilds the mapping-derived state before replaying (the
+    recompile-on-epoch contract at the reduction layer)."""
+    from tempi_tpu.runtime import invalidation
+    envmod.env.redcoll = "ring"
+    n = 8
+    vals = [np.full(n, r + 1, np.float32) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    world.mapping_epoch += 1
+    world.invalidate_plans()
+    invalidation.bump("mapping", f"test epoch {world.mapping_epoch}")
+    compiles = ctr.counters.coll.reduce_compiles
+    pr.start()
+    pr.wait()
+    assert ctr.counters.coll.reduce_compiles == compiles + 1
+    assert pr._mapping_epoch == world.mapping_epoch
+    # second application reduces the already-reduced rows: S * size
+    want = np.add.reduce(vals, axis=0) * world.size
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n), want)
+    pr.free()
+
+
+def test_ft_verdict_refuses_start(world, monkeypatch):
+    """ULFM semantics at the reduction layer: a death verdict on the
+    communicator refuses every later start with RankFailure."""
+    from tempi_tpu.runtime import invalidation, liveness
+    envmod.env.redcoll = "ring"
+    buf = world.alloc(64)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    monkeypatch.setattr(liveness, "ENABLED", True)
+    world.dead_ranks = {2}
+    invalidation.bump("ft", "test verdict")
+    with pytest.raises(liveness.RankFailure):
+        pr.start()
+    with pytest.raises(liveness.RankFailure):
+        pr.start()  # refuses EVERY start, not once
+    world.dead_ranks = set()
+
+
+def test_redcoll_choice_and_round_events(world):
+    """Every choice emits redcoll.choice with estimates; every round a
+    redcoll.round span carrying method and kind."""
+    from tempi_tpu.obs import trace as obstrace
+    obstrace.configure("flight")
+    envmod.env.redcoll = "ring"
+    buf = world.alloc(64)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    events = obstrace.snapshot()
+    choices = [e for e in events if e["name"] == "redcoll.choice"]
+    assert choices and choices[0]["method"] == "ring"
+    assert choices[0]["forced"] is True
+    spans = [e for e in events if e["name"] == "redcoll.round"]
+    assert len(spans) == pr._lowering.num_rounds
+    assert all(s["kind"] == "allreduce" for s in spans)
+    pr.free()
+    obstrace.configure("off")
+
+
+def test_hier_round_spans_carry_tier(make_world, monkeypatch):
+    from tempi_tpu.obs import trace as obstrace
+    _force_hier(monkeypatch, "4")
+    world = make_world()
+    obstrace.configure("flight")  # after init: init re-arms from the env
+    buf = world.alloc(64)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    spans = [e for e in obstrace.snapshot()
+             if e["name"] == "redcoll.round"]
+    tiers = {s.get("tier") for s in spans}
+    assert {"ici", "dcn"} <= tiers
+    pr.free()
+    obstrace.configure("off")
+
+
+@pytest.mark.faults
+def test_round_fault_with_retries_delivers(world, monkeypatch):
+    """redcoll.round chaos with retries armed: the site fires before the
+    round dispatches, so the per-round retry loop re-dispatches safely
+    and the reduction still delivers byte-exactly."""
+    monkeypatch.setenv("TEMPI_FAULTS", "redcoll.round:raise:0.4:7")
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "8")
+    envmod.read_environment()
+    faults.configure()
+    envmod.env.redcoll = "ring"
+    n = 12
+    vals = [np.full(n, r + 1, np.int32) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.int32, op="sum")
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.int32, n), want)
+    pr.free()
+
+
+@pytest.mark.faults
+def test_round_fault_exhaustion_is_restartable(world, monkeypatch):
+    """With retries unarmed a redcoll.round raise surfaces immediately;
+    the handle returns to the startable state and a later healthy start
+    delivers the full reduction (the staging rebuilds from the untouched
+    device input)."""
+    monkeypatch.setenv("TEMPI_FAULTS", "redcoll.round:raise:1:3")
+    envmod.read_environment()
+    faults.configure()
+    envmod.env.redcoll = "ring"
+    n = 12
+    vals = [np.full(n, r + 1, np.int32) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.int32, op="sum")
+    with pytest.raises(faults.InjectedFault):
+        pr.start()
+    faults.reset()
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.int32, n), want)
+    pr.free()
+
+
+@pytest.mark.faults
+def test_round_wedge_refused(monkeypatch):
+    """wedge is refused at redcoll.round like every non-engine site —
+    rounds run under the progress lock where a blocked thread deadlocks
+    every bounded waiter."""
+    with pytest.raises(faults.FaultSpecError, match="wedge"):
+        faults.configure("redcoll.round:wedge:1:1")
+
+
+def test_plan_cache_shares_schedules_between_handles(world):
+    """Sibling handles over the same (kind, counts, algorithm, chunk)
+    compile the schedule once — the plan cache's hit counters are the
+    evidence, like the alltoallv schedules."""
+    envmod.env.redcoll = "ring"
+    buf = world.alloc(256)
+    pr1 = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    hits = ctr.counters.plan.cache_hit
+    pr2 = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert ctr.counters.plan.cache_hit > hits
+    pr1.free()
+    pr2.free()
