@@ -110,6 +110,14 @@ def report_counters(file=None, reset: bool = False) -> None:
           for k, v in vals.items() if v]
     if nz:
         print("counters: " + "  ".join(nz), file=out)
+    from tempi_tpu.obs import metrics as obsmetrics
+    if obsmetrics.ENABLED:
+        # a TEMPI_METRICS-armed bench run prints the Prometheus-style
+        # exposition too (ISSUE 15) — same stderr destination, so CSV
+        # stdout consumers are unaffected
+        rep = api.metrics_report()
+        if rep:
+            print(rep, file=out)
 
 
 def emit_csv(header, rows) -> None:

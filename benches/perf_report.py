@@ -10,11 +10,21 @@ why. Reference analog: the measured-curve dumps of bin/measure-system
 Usage: python benches/perf_report.py [path-to-sheet.json]
        (default: the active TEMPI_CACHE_DIR/perf.json)
 
-       python benches/perf_report.py --trace <dump.json>
+       python benches/perf_report.py --trace <dump.json> [--json]
        (ISSUE 3: summarize a flight-recorder dump — per-(span, strategy)
        latency stats from the Chrome trace JSON written by
        api.trace_dump() / TEMPI_TRACE=full at finalize / the automatic
-       WaitTimeout & breaker-open snapshots)
+       WaitTimeout & breaker-open snapshots. With TEMPI_METRICS=on the
+       dump carries metrics.round instants and the summary grows
+       skew/straggler columns; --json emits the machine-diffable form —
+       ISSUE 15)
+
+       python benches/perf_report.py --compare A.json B.json [--threshold PCT]
+       (ISSUE 15: per-key regression diff between two bench JSONs —
+       delta and % change per numeric key, loud DRIFT flags past the
+       threshold (default 10%), exit 1 when anything drifted — so the
+       BENCH_r*.json trajectory diffs mechanically in CI instead of by
+       eye)
 
        python benches/perf_report.py --tune [path-to-tune.json]
        (ISSUE 4: summarize the learned online-tuning state — per-(link,
@@ -44,8 +54,11 @@ def _fmt_t(t: float) -> str:
     return f"{t * 1e6:.1f}us"
 
 
-def trace_report(path: str) -> int:
-    """Per-(span, strategy) latency summary of a flight-recorder dump."""
+def trace_report(path: str, as_json: bool = False) -> int:
+    """Per-(span, strategy) latency summary of a flight-recorder dump.
+    ``as_json`` emits the machine-diffable form (ISSUE 15): the summary
+    rows — including the skew/straggler columns when metrics events are
+    present — plus the dump metadata, as one JSON document on stdout."""
     from tempi_tpu.obs import export
 
     with open(path) as f:
@@ -54,6 +67,11 @@ def trace_report(path: str) -> int:
     instants = sum(1 for ev in doc.get("traceEvents", [])
                    if ev.get("ph") == "i")
     meta = doc.get("otherData", {})
+    if as_json:
+        json.dump(dict(trace=path, rows=rows, instants=instants,
+                       metadata=meta), sys.stdout, indent=1, default=str)
+        print()
+        return 0 if rows else 1
     print(f"trace: {path}")
     if meta.get("reason"):
         print(f"captured: {meta['reason']}"
@@ -63,16 +81,30 @@ def trace_report(path: str) -> int:
         return 1
     # the tier column splits hierarchical coll.round spans into their
     # ici/dcn legs (ISSUE 10) — where a two-level exchange spends its
-    # time; flat spans print "-"
-    print(f"{'span':>18} {'strategy':>10} {'tier':>5} {'count':>7} "
-          f"{'mean':>10} {'p50':>10} {'max':>10} {'total':>10}")
+    # time; flat spans print "-". The skew/slow columns appear when the
+    # dump carries metrics.round instants (TEMPI_METRICS=on, ISSUE 15):
+    # worst max-minus-median arrival spread and the modal slowest rank
+    skewed = any("max_skew_us" in r for r in rows)
+    hdr = (f"{'span':>18} {'strategy':>10} {'tier':>5} {'count':>7} "
+           f"{'mean':>10} {'p50':>10} {'max':>10} {'total':>10}")
+    if skewed:
+        hdr += f" {'skew':>10} {'slow':>5}"
+    print(hdr)
     for r in rows:
-        print(f"{r['name']:>18} {r['strategy']:>10} "
-              f"{r.get('tier', '-'):>5} {r['count']:>7} "
-              f"{_fmt_t(r['mean_us'] / 1e6):>10} "
-              f"{_fmt_t(r['p50_us'] / 1e6):>10} "
-              f"{_fmt_t(r['max_us'] / 1e6):>10} "
-              f"{_fmt_t(r['total_us'] / 1e6):>10}")
+        line = (f"{r['name']:>18} {r['strategy']:>10} "
+                f"{r.get('tier', '-'):>5} {r['count']:>7} "
+                f"{_fmt_t(r['mean_us'] / 1e6):>10} "
+                f"{_fmt_t(r['p50_us'] / 1e6):>10} "
+                f"{_fmt_t(r['max_us'] / 1e6):>10} "
+                f"{_fmt_t(r['total_us'] / 1e6):>10}")
+        if skewed:
+            if "max_skew_us" in r:
+                slow = r.get("slow_rank")
+                line += (f" {_fmt_t(r['max_skew_us'] / 1e6):>10} "
+                         f"{('r' + str(slow)) if slow is not None else '-':>5}")
+            else:
+                line += f" {'-':>10} {'-':>5}"
+        print(line)
     # whole-step replay summary (ISSUE 12): the step.replay rows above
     # split fused replays from eager fallbacks via the strategy column;
     # this footer adds the ratio — a step mostly falling back to eager
@@ -124,13 +156,100 @@ def tune_report(path: str) -> int:
     return 0
 
 
+def _flatten_numeric(doc, prefix: str = "", out=None) -> dict:
+    """Dotted-key flat dict of every numeric leaf in a bench JSON.
+    Bench capture wrappers ({n, cmd, rc, tail, parsed}) unwrap to their
+    ``parsed`` payload; nested dicts (last_tpu, ...) flatten with dotted
+    keys; bools and non-numerics are skipped."""
+    if out is None:
+        out = {}
+    if not prefix and isinstance(doc, dict) \
+            and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        return out
+    for k, v in doc.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[prefix + str(k)] = float(v)
+        elif isinstance(v, dict):
+            _flatten_numeric(v, prefix + str(k) + ".", out)
+    return out
+
+
+def compare_report(a_path: str, b_path: str, threshold: float) -> int:
+    """Per-key regression diff of two bench JSONs (ISSUE 15): old, new,
+    delta, % change; keys whose |% change| crosses ``threshold`` get a
+    loud DRIFT flag and the exit code turns 1 — the mechanical form of
+    eyeballing two BENCH_r*.json files. Direction is deliberately not
+    judged (some keys are better-high, some better-low; a CI consumer
+    that wants direction reads the JSON keys it cares about) — the flag
+    says LOOK HERE, not pass/fail."""
+    with open(a_path) as f:
+        A = _flatten_numeric(json.load(f))
+    with open(b_path) as f:
+        B = _flatten_numeric(json.load(f))
+    common = sorted(set(A) & set(B))
+    drifted = 0
+    print(f"compare: {a_path} (old) vs {b_path} (new); "
+          f"threshold {threshold * 100:.3g}%")
+    print(f"{'key':>44} {'old':>12} {'new':>12} {'delta%':>8}")
+    for k in common:
+        a, b = A[k], B[k]
+        if a == b:
+            continue
+        pct = (b - a) / abs(a) if a else math.inf
+        flag = ""
+        if abs(pct) >= threshold:
+            drifted += 1
+            flag = "  <-- DRIFT"
+        print(f"{k:>44} {a:>12.6g} {b:>12.6g} "
+              f"{pct * 100:>7.1f}%{flag}")
+    for k in sorted(set(A) - set(B)):
+        print(f"{k:>44} {A[k]:>12.6g} {'GONE':>12}")
+    for k in sorted(set(B) - set(A)):
+        print(f"{k:>44} {'NEW':>12} {B[k]:>12.6g}")
+    same = sum(1 for k in common if A[k] == B[k])
+    print(f"{len(common)} shared key(s): {same} unchanged, "
+          f"{len(common) - same} changed, {drifted} past the "
+          f"{threshold * 100:.3g}% threshold")
+    return 1 if drifted else 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--trace":
-        if len(sys.argv) < 3:
-            print("usage: perf_report.py --trace <dump.json>",
+        args = [a for a in sys.argv[2:] if a != "--json"]
+        if len(args) != 1:
+            print("usage: perf_report.py --trace <dump.json> [--json]",
                   file=sys.stderr)
             return 2
-        return trace_report(sys.argv[2])
+        return trace_report(args[0], as_json="--json" in sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        rest = sys.argv[2:]
+        threshold = 0.1
+        if "--threshold" in rest:
+            i = rest.index("--threshold")
+            if i + 1 >= len(rest):
+                print("usage: perf_report.py --compare A.json B.json "
+                      "[--threshold PCT]", file=sys.stderr)
+                return 2
+            try:
+                threshold = float(rest[i + 1]) / 100.0
+            except ValueError:
+                print(f"bad --threshold {rest[i + 1]!r}: want a percent "
+                      "number (e.g. 10)", file=sys.stderr)
+                return 2
+            if threshold < 0:
+                print("bad --threshold: want a non-negative percent",
+                      file=sys.stderr)
+                return 2
+            del rest[i: i + 2]
+        if len(rest) != 2:
+            print("usage: perf_report.py --compare A.json B.json "
+                  "[--threshold PCT]", file=sys.stderr)
+            return 2
+        return compare_report(rest[0], rest[1], threshold)
     if len(sys.argv) > 1 and sys.argv[1] == "--tune":
         if len(sys.argv) > 2:
             tpath = sys.argv[2]

@@ -42,6 +42,13 @@ def init(devices=None) -> Communicator:
     from .obs import trace as obstrace
     obstrace.configure()  # arm TEMPI_TRACE the same way: a typo'd mode
     # must fail init, not silently record nothing
+    from .obs import metrics as obsmetrics
+    obsmetrics.configure()  # arm TEMPI_METRICS (AFTER the trace
+    # configure: the span-close hook it installs recomputes the shared
+    # site-arming flag); clears any prior session's histograms
+    from .obs import timeline as obstimeline
+    obstimeline.configure()  # clear the unified decision timeline —
+    # api.explain() history is per-session evidence, like counters
     from .tune import online as tune_online
     tune_online.configure()  # arm TEMPI_TUNE (knobs already loud-parsed
     # by read_environment; this clears any prior session's learned state)
@@ -67,8 +74,16 @@ def init(devices=None) -> Communicator:
         # failure is FATAL — continuing would run N independent single-host
         # worlds whose matched sends silently pair the wrong ranks.
         from .parallel import multihost
-        pidx, _ = multihost.init_distributed()
+        pidx, pcount = multihost.init_distributed()
         log.world_rank = pidx
+        if pcount > 1:
+            # fleet identity (ISSUE 15): stamp the process id into the
+            # flight recorder (rank-stamped dump names) and, with the
+            # recorder armed, estimate this process's clock offset
+            # against the coordinator over the KV seam — what
+            # api.trace_dump_fleet()/the merge CLI align timelines by
+            from .obs import fleet as obsfleet
+            obsfleet.init_process(pidx, pcount)
         devices = jax.devices()
     else:
         log.world_rank = 0  # single controller drives all ranks
@@ -189,6 +204,12 @@ def finalize() -> None:
         # counters
         from .obs import trace as obstrace
         obstrace.finalize()
+        from .obs import metrics as obsmetrics
+        obsmetrics.finalize()  # AFTER the trace finalize (full-mode
+        # dumps must not race the hook teardown); histograms and round
+        # windows are per-session, like counters
+        from .obs import timeline as obstimeline
+        obstimeline.reset()  # the decision timeline is per-session too
         # persist the learned tune state (observations are expensive
         # evidence) BEFORE the registries reset, then disarm — learned
         # history survives sessions via tune.json, not via module state
@@ -408,9 +429,66 @@ def trace_dump(path: Optional[str] = None) -> str:
     """Write the flight recorder as Chrome trace-event JSON (opens in
     https://ui.perfetto.dev or chrome://tracing) and return the path.
     ``path=None`` resolves ``TEMPI_TRACE_PATH``, falling back to
-    ``./tempi-trace.json``."""
+    ``./tempi-trace.json`` (rank-stamped ``tempi-trace-r<rank>.json``
+    in a multi-process world — the fleet-merge prerequisite)."""
     from .obs import trace as obstrace
     return obstrace.dump(path)
+
+
+def trace_dump_fleet(path: Optional[str] = None) -> str:
+    """Fleet-wide trace dump (ISSUE 15; obs/fleet.py): every process
+    writes its rank-stamped dump into the shared directory (``path`` or
+    ``TEMPI_TRACE_PATH``), a coordinator-KV barrier confirms every file
+    landed, and process 0 merges them — clock-aligned by the offsets
+    estimated at init — into ONE Perfetto document with a pid lane
+    block per rank (``tempi-trace-fleet.json``). SPMD: call on every
+    process; returns the merged path on the coordinator and this
+    process's own dump path elsewhere. The offline equivalent over
+    collected dumps is ``python -m tempi_tpu.obs.merge <dir>``."""
+    from .obs import fleet as obsfleet
+    return obsfleet.dump_fleet(path)
+
+
+def metrics_snapshot() -> dict:
+    """Diagnostic snapshot of the fixed-memory metrics layer (ISSUE 15;
+    ``TEMPI_METRICS=on``): per-(span, strategy, tier) log2-bucketed
+    latency histograms with their shared bucket edges, per-round
+    arrival-spread straggler attribution (skew = max−median arrival,
+    slowest-rank id and per-rank slowest counts), and persistent-step
+    critical paths (the longest chain of dependent spans per replay).
+    Pure data — safe to serialize. Callable before init and after
+    finalize (reads empty)."""
+    from .obs import metrics as obsmetrics
+    return obsmetrics.snapshot()
+
+
+def metrics_report() -> str:
+    """Prometheus-style text exposition of :func:`metrics_snapshot` —
+    cumulative ``tempi_span_seconds`` histograms, round-skew and
+    slowest-rank gauges, and step critical paths. The scrape surface a
+    monitoring endpoint (or a bench's stderr report;
+    benches/_common.report_counters) prints."""
+    from .obs import metrics as obsmetrics
+    return obsmetrics.report()
+
+
+def explain(limit: Optional[int] = None) -> dict:
+    """The unified runtime decision timeline (ISSUE 15;
+    obs/timeline.py): every subsystem's verdicts — breaker transitions
+    and demotions, tune drift/adoptions, re-placement decisions, FT
+    death verdicts and shrinks, QoS lane quarantines, elastic
+    join/admit records, plan-invalidation bumps, and the recompiles
+    they caused — as ONE causally-ordered, generation-stamped ledger.
+    "Why did my step recompile / why did p99 jump" is this one call
+    instead of seven snapshot diffs: follow a record's ``generation``
+    forward to the bump that moved it and the recompile that observed
+    it. ``limit`` keeps only the newest N records. Pure data — safe to
+    serialize. Callable before init and after finalize (reads empty)."""
+    from .obs import timeline as obstimeline
+    from .runtime import invalidation
+    return dict(generation=invalidation.current(),
+                events=obstimeline.snapshot(limit),
+                **obstimeline.stats())
 
 
 def initialized() -> bool:

@@ -57,6 +57,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..measure import system as msys
+from ..obs import metrics as obsmetrics
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..ops import dtypes
 from ..ops.dtypes import Datatype
@@ -719,6 +721,8 @@ class PersistentColl:
         ctr.counters.coll.num_compiles += 1
         if recompile:
             ctr.counters.coll.num_recompiles += 1
+            timeline.record("coll.recompile", comm=self.comm.uid,
+                            method=self.method)
             log.info(f"persistent collective recompiled onto "
                      f"{self.method!r} (plan invalidated: breaker/tune "
                      "state changed on a scheduled link)")
@@ -784,6 +788,9 @@ class PersistentColl:
         self._mapping_epoch = comm.mapping_epoch
         ctr.counters.coll.num_compiles += 1
         ctr.counters.coll.num_recompiles += 1
+        timeline.record("coll.recompile", comm=comm.uid,
+                        method=self.method, cause="mapping",
+                        epoch=comm.mapping_epoch)
         log.info(f"persistent collective recompiled onto {self.method!r} "
                  f"(rank re-placement epoch {comm.mapping_epoch})")
 
@@ -884,6 +891,13 @@ class PersistentColl:
             ctr.counters.coll.num_replays += 1
             if isinstance(self._lowering, _HierLowering):
                 ctr.counters.coll.hier_replays += 1
+        if obsmetrics.ENABLED:
+            # arrival window for straggler attribution (ISSUE 15): open
+            # across start()..wait(); the p2p engine stamps destination
+            # ranks as their pairs complete, and wait() closes it into
+            # the per-(span, method) skew/slowest-rank stats
+            obsmetrics.round_begin(self.comm.uid, "coll.round",
+                                   self.method)
         retries = envmod.env.retry_attempts
         low = self._lowering
         hier = isinstance(low, _HierLowering)
@@ -949,6 +963,8 @@ class PersistentColl:
             self._lowering.finish()
         finally:
             self._active = False
+            if obsmetrics.ENABLED:
+                obsmetrics.round_end(self.comm.uid, "coll.round")
 
     def test(self) -> bool:
         """Nonblocking completion query (MPI_Test analog): True completes
@@ -1456,6 +1472,8 @@ class PersistentReduce:
         ctr.counters.coll.reduce_compiles += 1
         if recompile:
             ctr.counters.coll.reduce_recompiles += 1
+            timeline.record("redcoll.recompile", comm=self.comm.uid,
+                            method=self.method, coll_kind=self.kind)
             log.info(f"persistent reduction recompiled onto "
                      f"{self.method!r} (plan invalidated)")
 
@@ -1496,6 +1514,9 @@ class PersistentReduce:
         self._mapping_epoch = self.comm.mapping_epoch
         ctr.counters.coll.reduce_compiles += 1
         ctr.counters.coll.reduce_recompiles += 1
+        timeline.record("redcoll.recompile", comm=self.comm.uid,
+                        method=self.method, cause="mapping",
+                        epoch=self.comm.mapping_epoch)
         log.info(f"persistent reduction recompiled onto {self.method!r} "
                  f"(rank re-placement epoch {self.comm.mapping_epoch})")
 
@@ -1558,6 +1579,9 @@ class PersistentReduce:
             self._revalidate(tok)
         if self._started:
             ctr.counters.coll.reduce_replays += 1
+        if obsmetrics.ENABLED:
+            obsmetrics.round_begin(self.comm.uid, "redcoll.round",
+                                   self.method)
         retries = envmod.env.retry_attempts
         low = self._lowering
         hier = isinstance(low, _RoundsReduceLowering) and low._hier
@@ -1620,6 +1644,8 @@ class PersistentReduce:
             self._lowering.finish()
         finally:
             self._active = False
+            if obsmetrics.ENABLED:
+                obsmetrics.round_end(self.comm.uid, "redcoll.round")
 
     def test(self) -> bool:
         """Nonblocking completion query (MPI_Test analog)."""

@@ -63,6 +63,8 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..obs import metrics as obsmetrics
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..runtime import faults, invalidation, liveness
 from ..utils import counters as ctr
@@ -418,6 +420,9 @@ class PersistentStep:
         self._check_alive()
         self._build()
         ctr.counters.step.num_recompiles += 1
+        timeline.record("step.rebuild", generation=token,
+                        comm=self.comm.uid,
+                        epoch=self.comm.mapping_epoch)
         log.info(f"persistent step rebuilt (plan invalidated: "
                  f"generation {token}; mapping epoch "
                  f"{self.comm.mapping_epoch})")
@@ -442,10 +447,18 @@ class PersistentStep:
             faults.check("step.replay")
         comm = self.comm
         t0 = time.monotonic() if obstrace.ENABLED else 0.0
+        men = obsmetrics.ENABLED
+        prof: List[tuple] = []
         with comm._progress_lock:
             if comm.freed:
                 raise RuntimeError("communicator has been freed")
             eager = self._eager_only or bool(comm._pending)
+            if men:
+                # arrival window (ISSUE 15): open across start()..wait();
+                # the p2p completions inside the replay stamp destination
+                # ranks for the straggler attribution
+                obsmetrics.round_begin(comm.uid, "step.replay",
+                                       "eager" if eager else "fused")
             if eager:
                 # pending eager traffic could FIFO-match into the step's
                 # exchanges: replaying the compiled pairing would overtake
@@ -458,15 +471,31 @@ class PersistentStep:
                 dispatched = 0
                 for item in self._program:
                     if item[0] == "plans":
+                        durs = []
                         for plan, strat, binding in item[1]:
+                            tp = time.monotonic() if men else 0.0
                             plan.bufs, plan.messages, plan.rounds = binding
                             plan.run(strat)
                             dispatched += 1
+                            if men:
+                                durs.append((strat,
+                                             time.monotonic() - tp))
+                        if men:
+                            prof.append(("plans", durs))
                     elif item[0] == "coll":
                         pcoll = item[1]
+                        tp = time.monotonic() if men else 0.0
                         pcoll.start()
                         pcoll.wait()
+                        if men:
+                            prof.append(("coll", time.monotonic() - tp))
                 ctr.counters.step.num_plan_dispatches += dispatched
+        if men and not eager:
+            # critical-path extraction (ISSUE 15): program items are
+            # sequentially dependent (they rebind the same buffers);
+            # plans inside one item are independent — the longest chain
+            # is each item's slowest member, summed
+            obsmetrics.note_step_replay(comm.uid, prof)
         if obstrace.ENABLED:
             # ``strategy`` carries the replay mode so the trace report's
             # generic (span, strategy) grouping splits fused replays from
@@ -521,6 +550,8 @@ class PersistentStep:
             p2p._sync_bufs(self._bufs, deadline=p2p._deadline())
         finally:
             self._active = False
+            if obsmetrics.ENABLED:
+                obsmetrics.round_end(self.comm.uid, "step.replay")
 
     def test(self) -> bool:
         """Nonblocking completion query: True completes the step (the

@@ -1,4 +1,5 @@
-"""Observability subsystem: request-lifecycle tracing and export (ISSUE 3).
+"""Observability subsystem: request-lifecycle tracing, export, fleet
+merging, metrics, and the unified decision timeline (ISSUES 3 + 15).
 
 The reference TEMPI stack's only runtime introspection is NVTX ranges and
 the per-rank counter dump at finalize (include/counters.hpp,
@@ -11,13 +12,27 @@ every serving stack has:
     and free (one module-flag truth test per site) when off;
   * :mod:`tempi_tpu.obs.export` — Chrome trace-event JSON export (opens
     directly in Perfetto / chrome://tracing) and the per-strategy span
-    summaries ``benches/perf_report.py --trace`` prints.
+    summaries ``benches/perf_report.py --trace`` prints;
+  * :mod:`tempi_tpu.obs.metrics` — fixed-memory log-bucketed span
+    histograms, per-round arrival-spread/straggler attribution, and
+    persistent-step critical paths, armed by ``TEMPI_METRICS``
+    (``api.metrics_snapshot()`` / ``api.metrics_report()``);
+  * :mod:`tempi_tpu.obs.timeline` — the merged, causally-ordered,
+    generation-stamped ledger of every runtime decision (breakers, tune,
+    re-placement, FT, QoS, elastic, invalidation) behind
+    ``api.explain()``;
+  * :mod:`tempi_tpu.obs.fleet` + the ``python -m tempi_tpu.obs.merge``
+    CLI — clock-offset estimation over the coordinator KV seam,
+    rank-stamped per-process dumps, and the merge into ONE Perfetto
+    timeline with a pid lane block per process
+    (``api.trace_dump_fleet()``).
 
 Instrumented layers: the p2p engine (post/match/dispatch/drain/complete/
 cancel/repost), the background progress pump and its supervisor verdicts,
-the circuit-breaker health registry, per-pair alltoallv lowering, and the
-measurement sweep's sections. Every ``WaitTimeout`` and breaker-open
-automatically snapshots the flight recorder next to its diagnostics.
+the circuit-breaker health registry, per-pair alltoallv lowering, the
+persistent collective/reduction/step replay rounds, and the measurement
+sweep's sections. Every ``WaitTimeout`` and breaker-open automatically
+snapshots the flight recorder next to its diagnostics.
 """
 
 from . import export, trace  # noqa: F401

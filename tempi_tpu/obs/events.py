@@ -77,4 +77,10 @@ EVENTS = (
     "step.replay",       # one PersistentStep start() (span; plans, msgs)
     # runtime/events.py — leak-site tracker
     "events.leak",       # an unfreed buffer's allocation site at finalize
+    # obs/metrics.py — round arrival spread (ISSUE 15): one closed round
+    # window's skew + slowest-rank attribution; the trace summary's
+    # skew/straggler columns key on these
+    "metrics.round",     # span, strategy, ranks, skew_us, slow_rank
+    # obs/fleet.py — fleet clock alignment (ISSUE 15)
+    "fleet.clock",       # this process's coordinator clock-offset estimate
 )

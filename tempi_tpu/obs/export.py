@@ -81,13 +81,32 @@ def summarize(doc: dict) -> List[dict]:
     hierarchical collective (ISSUE 10) into their ici/dcn legs, so a
     Perfetto dump shows WHERE a two-level exchange spends its time; spans
     without a tier attribute collapse into one "-" row, exactly as
-    before."""
+    before.
+
+    When the dump carries ``metrics.round`` instants (TEMPI_METRICS=on;
+    obs/metrics.py round windows), matching rows — keyed on the method
+    field round spans carry as their strategy — additionally grow
+    straggler columns: ``max_skew_us`` (worst max-minus-median arrival
+    spread seen) and ``slow_rank`` (the modal slowest rank's id)."""
     groups: Dict[tuple, List[float]] = {}
+    skews: Dict[tuple, dict] = {}
     for ev in doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        if ev.get("ph") == "i" and ev.get("name") == "metrics.round":
+            key = (args.get("span"), args.get("strategy", "-"))
+            agg = skews.setdefault(key, dict(max_skew_us=0.0, slow={}))
+            agg["max_skew_us"] = max(agg["max_skew_us"],
+                                     float(args.get("skew_us") or 0.0))
+            r = args.get("slow_rank")
+            if r is not None:
+                agg["slow"][r] = agg["slow"].get(r, 0) + 1
+            continue
         if ev.get("ph") != "X":
             continue
-        args = ev.get("args") or {}
-        strategy = args.get("strategy", "-")
+        # round spans stamp their collective method as ``method``; the
+        # summary's strategy column (and the metrics layer's key) treat
+        # the two interchangeably
+        strategy = args.get("strategy", args.get("method", "-"))
         tier = args.get("tier", "-")
         groups.setdefault((ev["name"], strategy, tier), []).append(
             float(ev.get("dur", 0.0)))
@@ -95,8 +114,14 @@ def summarize(doc: dict) -> List[dict]:
     for (name, strategy, tier), durs in groups.items():
         durs.sort()
         n = len(durs)
-        rows.append(dict(name=name, strategy=strategy, tier=tier, count=n,
-                         total_us=sum(durs), mean_us=sum(durs) / n,
-                         p50_us=durs[n // 2], max_us=durs[-1]))
+        row = dict(name=name, strategy=strategy, tier=tier, count=n,
+                   total_us=sum(durs), mean_us=sum(durs) / n,
+                   p50_us=durs[n // 2], max_us=durs[-1])
+        agg = skews.get((name, strategy))
+        if agg is not None:
+            row["max_skew_us"] = agg["max_skew_us"]
+            row["slow_rank"] = (max(agg["slow"], key=agg["slow"].get)
+                                if agg["slow"] else None)
+        rows.append(row)
     rows.sort(key=lambda r: -r["total_us"])
     return rows

@@ -1,0 +1,243 @@
+"""Fleet trace merging: clock-aligned, per-process Perfetto lanes
+(ISSUE 15).
+
+The flight recorder is per-process; the behaviors the runtime has grown
+— hierarchical collectives, persistent-step replay, FT shrink/grow,
+re-placement — are cross-rank, and their signature failure mode ("one
+straggler rank stalls the round") is invisible in any single process's
+timeline. This module makes N per-process dumps into ONE timeline:
+
+  * **Clock offsets** — at init (multi-process worlds, recorder armed)
+    every process estimates its monotonic-clock offset against the
+    coordinator (process 0) with a midpoint-of-RTT exchange over the
+    coordinator KV store — the same ``_allgather_kv_ints`` seam the FT
+    and elastic votes ride (parallel/multihost.py). The minimum-RTT
+    sample wins; half that RTT is the stored uncertainty. On one Linux
+    host CLOCK_MONOTONIC is machine-wide and the offset measures ~0 —
+    the estimate matters on real multi-host fleets, where monotonic
+    epochs are arbitrary per machine.
+  * **Rank-stamped dumps** — the recorder stamps its process id into
+    dump filenames (``tempi-trace-r<rank>.json``) and its clock estimate
+    into dump metadata (``otherData.process``), so a directory of fleet
+    dumps is self-describing.
+  * **Merge** — :func:`merge_docs` shifts every document's timestamps
+    into the coordinator's clock frame (``ts + t0 + offset``), rebases
+    the merged timeline at zero, and gives each process its own Perfetto
+    pid block (``r<rank>/...`` lanes). A wedge on rank 7 reads as the
+    gap every other rank's round span is waiting on.
+
+Entry points: ``api.trace_dump_fleet()`` (every process dumps, a KV
+barrier confirms, the coordinator merges) and the offline CLI
+``python -m tempi_tpu.obs.merge <dir>`` (obs/merge.py — a pure file
+reader, usable on a laptop over collected dumps).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import export
+from . import trace as obstrace
+from ..utils import env as envmod
+from ..utils import logging as log
+
+#: Perfetto pid block per process in a merged document: process ``r``'s
+#: original pid ``p`` becomes ``r * PID_STRIDE + p``. The recorder's own
+#: pids are small (0 = runtime, rank+1 lanes), so 1000 never collides.
+PID_STRIDE = 1000
+
+#: Default basename of a merged fleet document.
+FLEET_BASENAME = "tempi-trace-fleet.json"
+
+_fleet_rounds = itertools.count()  # SPMD-aligned dump-barrier ordinals
+
+
+# -- init-time wiring ----------------------------------------------------------
+
+
+def init_process(rank: int, count: int) -> Optional[dict]:
+    """Multi-process init hook (api.init, after the jax.distributed
+    join): stamp the process id into the recorder (rank-stamped dump
+    names are the fleet-merge prerequisite) and, when the recorder is
+    armed, estimate this process's clock offset against the coordinator.
+    Never fatal — a failed estimate degrades to offset-unknown dumps
+    that still merge (zero offset, flagged in metadata)."""
+    obstrace.set_process(rank)
+    if not obstrace.RECORDING:
+        # metrics-only arming (TEMPI_METRICS=on, rings off) must not pay
+        # the blocking KV exchange: the estimate only aligns dumps, and
+        # non-recording rings dump nothing
+        return None
+    from ..parallel import multihost
+    clk = multihost.clock_offset_exchange()
+    if clk is not None:
+        obstrace.set_process(rank, clock=clk)
+        if obstrace.ENABLED:
+            obstrace.emit("fleet.clock", rank=rank,
+                          offset_s=clk.get("offset_s"),
+                          uncertainty_s=clk.get("uncertainty_s"),
+                          method=clk.get("method"))
+        log.debug(f"fleet clock: process {rank}/{count} offset "
+                  f"{clk.get('offset_s', 0.0):+.6f}s "
+                  f"(±{clk.get('uncertainty_s', 0.0):.6f}s)")
+    return clk
+
+
+# -- merge (pure data; no jax) -------------------------------------------------
+
+
+def _doc_process(doc: dict, fallback_rank: int) -> Tuple[int, float, dict]:
+    """(rank, shift_seconds, clock-dict) of one dump document. Documents
+    without process metadata (a pre-fleet dump, a hand-built doc) get a
+    sequential rank, zero shift, and a loud ``unknown`` clock flag —
+    they still merge, on their own lane, unaligned."""
+    p = (doc.get("otherData") or {}).get("process") or {}
+    rank = int(p.get("rank", fallback_rank))
+    clock = dict(p.get("clock") or {})
+    offset = float(clock.get("offset_s", 0.0))
+    t0 = float(p.get("t0", 0.0))
+    if "t0" not in p or "offset_s" not in clock:
+        # no epoch OR no measured offset (a failed init-time exchange):
+        # the lane merges unaligned and must SAY so — a confident zero
+        # offset the merge never measured is worse than no claim
+        clock["unknown"] = True
+    return rank, t0 + offset, clock
+
+
+def merge_docs(docs: List[dict]) -> dict:
+    """N per-process Chrome trace documents -> one clock-aligned fleet
+    document. Every event keeps its fields; timestamps shift into the
+    coordinator's monotonic frame and rebase so the merged timeline
+    starts at ~0; each process's lanes land in their own pid block with
+    ``r<rank>/``-prefixed process names. Per-process event ORDER is
+    preserved exactly (a uniform shift per document cannot reorder);
+    cross-process order is as consistent as the clock estimates'
+    uncertainty, which rides along in ``otherData.processes``."""
+    if not docs:
+        raise ValueError("merge_docs: no documents to merge")
+    parsed = []
+    for i, doc in enumerate(docs):
+        rank, shift_s, clock = _doc_process(doc, i)
+        parsed.append((rank, shift_s, clock, doc))
+    parsed.sort(key=lambda t: t[0])
+    ranks = [r for r, _, _, _ in parsed]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(
+            f"merge_docs: duplicate process ranks {ranks} — each dump "
+            "must come from a distinct process (rank-stamped filenames)")
+    # rebase: the earliest shifted event timestamp across the fleet
+    base_us = None
+    for rank, shift_s, _clock, doc in parsed:
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M" or "ts" not in ev:
+                continue
+            t = float(ev["ts"]) + shift_s * 1e6
+            if base_us is None or t < base_us:
+                base_us = t
+    base_us = base_us or 0.0
+    out_events: List[dict] = []
+    procs_meta: List[dict] = []
+    for rank, shift_s, clock, doc in parsed:
+        procs_meta.append(dict(rank=rank, shift_s=shift_s, clock=clock))
+        for ev in doc.get("traceEvents", []):
+            ne = dict(ev)
+            if "pid" in ne:
+                ne["pid"] = rank * PID_STRIDE + int(ne["pid"])
+            if ne.get("ph") == "M":
+                if ne.get("name") == "process_name":
+                    args = dict(ne.get("args") or {})
+                    args["name"] = f"r{rank}/{args.get('name', '?')}"
+                    ne["args"] = args
+            elif "ts" in ne:
+                ne["ts"] = round(float(ne["ts"]) + shift_s * 1e6
+                                 - base_us, 3)
+            out_events.append(ne)
+    # metadata ("M") events first, then data events in global time order
+    # (stable sort: equal timestamps keep their per-process order)
+    meta = [e for e in out_events if e.get("ph") == "M"]
+    data = [e for e in out_events if e.get("ph") != "M"]
+    data.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return {"traceEvents": meta + data, "displayTimeUnit": "ms",
+            "otherData": dict(exporter="tempi_tpu.obs.merge",
+                              merged_from=len(parsed),
+                              processes=procs_meta)}
+
+
+def merge_paths(paths: List[str], out_path: str) -> str:
+    """Merge dump files into ``out_path`` (Chrome trace JSON; opens in
+    https://ui.perfetto.dev). Returns ``out_path``."""
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            docs.append(json.load(f))
+    merged = merge_docs(docs)
+    with open(out_path, "w") as f:
+        json.dump(merged, f, default=str)
+    return out_path
+
+
+def fleet_dump_paths(dirpath: str) -> List[str]:
+    """The rank-stamped dumps in a directory, rank order — what the
+    merge CLI and ``trace_dump_fleet`` collect. Matches the recorder's
+    ``tempi-trace-r<rank>.json`` stamp exactly; the merged fleet file
+    and failure snapshots never match."""
+    out = []
+    for fn in os.listdir(dirpath):
+        if not (fn.startswith("tempi-trace-r") and fn.endswith(".json")):
+            continue
+        stem = fn[len("tempi-trace-r"):-len(".json")]
+        if stem.isdigit():
+            out.append((int(stem), os.path.join(dirpath, fn)))
+    return [p for _, p in sorted(out)]
+
+
+def merge_dir(dirpath: str, out_path: Optional[str] = None) -> str:
+    """Merge every rank-stamped dump in ``dirpath`` into one fleet
+    document (default ``<dirpath>/tempi-trace-fleet.json``)."""
+    paths = fleet_dump_paths(dirpath)
+    if not paths:
+        raise FileNotFoundError(
+            f"no tempi-trace-r<rank>.json dumps in {dirpath!r} (write "
+            "them with api.trace_dump_fleet() or api.trace_dump() in a "
+            "multi-process world)")
+    return merge_paths(paths, out_path
+                       or os.path.join(dirpath, FLEET_BASENAME))
+
+
+# -- the collective dump entry point ------------------------------------------
+
+
+def dump_fleet(dirpath: Optional[str] = None, timeout_s: float = 30.0
+               ) -> str:
+    """Every process dumps its rank-stamped trace into ``dirpath``
+    (default: TEMPI_TRACE_PATH, falling back to the working directory),
+    a coordinator-KV barrier confirms every dump landed, and process 0
+    merges them into the fleet document. Returns the merged path on the
+    coordinator and this process's own dump path elsewhere (single-
+    process worlds merge their one dump trivially — the same artifact
+    shape either way). SPMD: call on every process."""
+    import jax
+
+    d = dirpath or envmod.env.trace_path or "."
+    if os.path.splitext(d)[1] == ".json":
+        # TEMPI_TRACE_PATH may name a file stem for single-process use;
+        # fleet dumps need a directory per the rank-stamp contract
+        d = os.path.dirname(d) or "."
+    os.makedirs(d, exist_ok=True)
+    own = obstrace.dump(os.path.join(d, obstrace.default_dump_name()))
+    n = jax.process_count()
+    if n <= 1:
+        return merge_paths([own], os.path.join(d, FLEET_BASENAME))
+    from ..parallel import multihost
+    ordinal = next(_fleet_rounds)
+    votes = multihost.allgather_fleet_dump(ordinal, timeout_s)
+    if jax.process_index() != 0:
+        return own
+    if not votes or len(votes) < n:
+        got = sorted(votes) if votes else []
+        log.warn(f"fleet dump barrier incomplete ({len(got)}/{n} "
+                 f"processes confirmed: {got}); merging what landed")
+    return merge_dir(d)

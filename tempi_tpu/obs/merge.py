@@ -1,0 +1,86 @@
+"""Fleet trace-merge CLI (ISSUE 15)::
+
+    python -m tempi_tpu.obs.merge <dir> [-o OUT]
+    python -m tempi_tpu.obs.merge <dump1.json> <dump2.json> ... [-o OUT]
+
+Merges rank-stamped flight-recorder dumps (``tempi-trace-r<rank>.json``,
+written by ``api.trace_dump_fleet()`` — or plain ``api.trace_dump()`` in
+a multi-process world) into ONE clock-aligned Chrome/Perfetto document
+with a pid lane block per process. Purely a FILE reader (the
+perf_report.py discipline): never imports jax, so it runs on a laptop
+over dumps scp'd from a fleet, and a wedged accelerator tunnel cannot
+hang it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+
+def main(argv: List[str]) -> int:
+    from . import fleet
+
+    out = None
+    inputs: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-o", "--out"):
+            if i + 1 >= len(argv):
+                print("merge: -o needs a path", file=sys.stderr)
+                return 2
+            out = argv[i + 1]
+            i += 2
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            inputs.append(a)
+            i += 1
+    if not inputs:
+        print("usage: python -m tempi_tpu.obs.merge <dir-or-dumps...> "
+              "[-o OUT]", file=sys.stderr)
+        return 2
+    try:
+        if len(inputs) == 1 and os.path.isdir(inputs[0]):
+            paths = fleet.fleet_dump_paths(inputs[0])
+            if not paths:
+                print(f"merge: no tempi-trace-r<rank>.json dumps in "
+                      f"{inputs[0]!r}", file=sys.stderr)
+                return 1
+            out = out or os.path.join(inputs[0], fleet.FLEET_BASENAME)
+        else:
+            paths = inputs
+            out = out or fleet.FLEET_BASENAME
+        merged_path = fleet.merge_paths(paths, out)
+    except (ValueError, FileNotFoundError, json.JSONDecodeError) as e:
+        print(f"merge: {e}", file=sys.stderr)
+        return 1
+    with open(merged_path) as f:
+        doc = json.load(f)
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") != "M"]
+    procs = (doc.get("otherData") or {}).get("processes", [])
+    print(f"merged {len(paths)} dump(s) -> {merged_path}")
+    for p in procs:
+        clk = p.get("clock") or {}
+        if clk.get("unknown"):
+            align = "clock UNKNOWN (unaligned lane)"
+        else:
+            align = (f"offset {clk.get('offset_s', 0.0):+.6f}s "
+                     f"±{clk.get('uncertainty_s', 0.0):.6f}s")
+        print(f"  r{p['rank']}: {align}")
+    if evs:
+        span_us = (max(float(e.get('ts', 0.0)) for e in evs)
+                   - min(float(e.get('ts', 0.0)) for e in evs))
+        spans = sum(1 for e in evs if e.get("ph") == "X")
+        print(f"  {len(evs)} events ({spans} spans) over "
+              f"{span_us / 1e3:.3f} ms")
+    print("open in https://ui.perfetto.dev — one pid block per rank")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

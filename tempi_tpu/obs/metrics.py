@@ -1,0 +1,442 @@
+"""Fixed-memory runtime metrics: span histograms, straggler attribution,
+and step critical paths (ISSUE 15).
+
+The flight recorder (obs/trace.py) answers "what happened around THIS
+failure"; this module answers the fleet-operations questions a bounded
+ring cannot — "what is p99 of a round over the last million replays",
+"which rank is the straggler stalling every collective", "where does a
+replayed step actually spend its time" — in memory that does NOT grow
+with traffic:
+
+  * **Span histograms** — every closed span (the recorder's
+    ``emit_span`` path) feeds a log2-bucketed latency histogram keyed on
+    (span name, strategy, tier). Buckets are fixed (1 us .. ~67 s, one
+    power of two each) and the key space is bounded (overflow keys
+    collapse into one ``(other)`` row, counted), so a month-long serving
+    run holds the same few KiB as a ten-second test.
+  * **Round arrival spread / straggler attribution** — persistent
+    collective, reduction, and step replays open a *round window* on
+    their communicator; the p2p engine stamps each completed pair's
+    DESTINATION rank as it lands, and closing the window computes
+    ``skew = max - median`` arrival plus the slowest rank's id. One
+    wedged rank stops hiding inside an aggregate round duration: its id
+    is in ``api.metrics_snapshot()`` and the per-rank slowest counts say
+    whether it is always the same rank (hardware) or rotating (load).
+  * **Step critical path** — a ``PersistentStep`` replay profiles each
+    program item; segments are sequentially dependent (they rebind the
+    same buffers) while plans inside a segment are independent, so the
+    critical path is the longest chain of dependent spans: the sum over
+    segments of each segment's slowest plan.
+
+Armed by ``TEMPI_METRICS=off|on`` (default off; loud-parsed in
+utils/env.py). Off is the established zero-cost contract: every
+instrumented site tests one module flag, no histogram state is
+allocated, and ``obs.trace`` keeps its byte-for-byte off behavior. On,
+the span feed rides the recorder's span-close hook
+(``trace.set_span_hook``) — metrics work with ``TEMPI_TRACE=off`` (the
+hook arms the emit sites without arming the rings) and add nothing to
+the rings' cost when tracing is also on.
+
+Surfaces: ``api.metrics_snapshot()`` (pure data) and
+``api.metrics_report()`` (Prometheus-style text exposition). With
+tracing armed, every closed round window also lands as a
+``metrics.round`` instant event, which is how the trace summary
+(``benches/perf_report.py --trace``) grows its skew/straggler columns.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import trace as obstrace
+from ..utils import env as envmod
+from ..utils import locks
+from ..utils import logging as log
+
+MODES = ("off", "on")
+
+#: Module-level fast-path flag (the ``runtime/faults.py`` pattern):
+#: instrumented sites test this before calling into the module.
+ENABLED = False
+MODE = "off"
+
+#: Histogram geometry: bucket ``i`` covers ``[2^i, 2^(i+1)) us``; the
+#: last bucket is the +Inf overflow. 27 power-of-two buckets span 1 us
+#: to ~67 s — wider than any span the runtime legitimately records.
+NUM_BUCKETS = 28
+
+#: Bound on distinct (span, strategy, tier) histogram keys AND distinct
+#: straggler keys: past it, new keys collapse into one ``(other)`` row
+#: (counted in ``dropped_keys``) — fixed memory is the contract, never
+#: an unbounded label-cardinality leak.
+MAX_KEYS = 256
+
+_lock = locks.named_lock("metrics")
+_hist: Dict[Tuple[str, str, str], "_Histogram"] = {}
+_stragglers: Dict[Tuple[str, str], "_Straggler"] = {}
+# per-communicator STACK of open windows: a PersistentColl replayed
+# inside a PersistentStep opens its own window above the step's, and an
+# arrival stamps every open window (it belongs to both replays)
+_windows: Dict[int, List["_Window"]] = {}
+_steps: Dict[int, dict] = {}
+_dropped_keys = 0
+
+_OTHER_KEY = ("(other)", "-", "-")
+
+
+class MetricsConfigError(ValueError):
+    """A malformed TEMPI_METRICS knob (fails loudly at configure time,
+    like every other observability knob)."""
+
+
+class _Histogram:
+    __slots__ = ("buckets", "count", "sum_s", "min_s", "max_s")
+
+    def __init__(self):
+        self.buckets = [0] * NUM_BUCKETS
+        self.count = 0
+        self.sum_s = 0.0
+        self.min_s = math.inf
+        self.max_s = 0.0
+
+    def observe(self, dur_s: float) -> None:
+        self.buckets[bucket_index(dur_s)] += 1
+        self.count += 1
+        self.sum_s += dur_s
+        if dur_s < self.min_s:
+            self.min_s = dur_s
+        if dur_s > self.max_s:
+            self.max_s = dur_s
+
+
+class _Straggler:
+    __slots__ = ("rounds", "last_skew_s", "max_skew_s", "last_slowest",
+                 "last_ranks", "slowest_counts")
+
+    def __init__(self):
+        self.rounds = 0
+        self.last_skew_s = 0.0
+        self.max_skew_s = 0.0
+        self.last_slowest: Optional[int] = None
+        self.last_ranks = 0
+        self.slowest_counts: Dict[int, int] = {}
+
+
+class _Window:
+    __slots__ = ("span", "strategy", "t_begin", "arrivals")
+
+    def __init__(self, span: str, strategy: str):
+        self.span = span
+        self.strategy = strategy
+        self.t_begin = time.monotonic()
+        self.arrivals: Dict[int, float] = {}
+
+
+def bucket_index(dur_s: float) -> int:
+    """Log2 bucket of a duration: ``[2^i, 2^(i+1)) us`` -> ``i``,
+    clamped into the fixed [0, NUM_BUCKETS) range (sub-microsecond lands
+    in bucket 0; anything past ~67 s in the +Inf bucket)."""
+    if dur_s <= 1e-6:
+        return 0
+    i = int(math.log2(dur_s / 1e-6))
+    return min(max(i, 0), NUM_BUCKETS - 1)
+
+
+def bucket_edges_us() -> List[float]:
+    """Upper edge of each bucket in microseconds (the Prometheus ``le``
+    labels); the last edge is +Inf."""
+    return [float(2 ** (i + 1)) for i in range(NUM_BUCKETS - 1)] \
+        + [math.inf]
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the metrics layer. ``None`` reads the parsed env's
+    ``metrics_mode`` (call after ``read_environment``); explicit values
+    override (test convenience). Clears all recorded state — metrics are
+    per-session, like counters — and (un)registers the span-close hook
+    on the flight recorder."""
+    global ENABLED, MODE
+    if mode is None:
+        mode = getattr(envmod.env, "metrics_mode", "off")
+    if mode not in MODES:
+        raise MetricsConfigError(
+            f"bad metrics mode {mode!r}: want one of {MODES}")
+    with _lock:
+        MODE = mode
+        ENABLED = mode == "on"
+        _hist.clear()
+        _stragglers.clear()
+        _windows.clear()
+        _steps.clear()
+        global _dropped_keys
+        _dropped_keys = 0
+    # outside the metrics lock: the recorder takes its own lock to swap
+    # the hook, and lock nesting here would put "metrics" above "trace"
+    # for no benefit
+    obstrace.set_span_hook(_observe_span if ENABLED else None)
+    if ENABLED:
+        log.debug("metrics armed: span histograms + straggler attribution "
+                  f"({NUM_BUCKETS} buckets, {MAX_KEYS} key bound)")
+
+
+def finalize() -> None:
+    """Session teardown (api.finalize): unhook from the recorder and drop
+    all recorded state — per-session, like counters."""
+    obstrace.set_span_hook(None)
+    with _lock:
+        global ENABLED, MODE, _dropped_keys
+        ENABLED = False
+        MODE = "off"
+        _hist.clear()
+        _stragglers.clear()
+        _windows.clear()
+        _steps.clear()
+        _dropped_keys = 0
+
+
+# -- span histogram feed (the recorder's span-close hook) ---------------------
+
+
+def _observe_span(name: str, dur_s: float, fields: Optional[dict]) -> None:
+    """One closed span (called from ``trace.emit_span`` / ``trace.span``
+    exit). Key cardinality is bounded: past MAX_KEYS new keys collapse
+    into the ``(other)`` row."""
+    global _dropped_keys
+    f = fields or {}
+    key = (name, str(f.get("strategy", f.get("method", "-"))),
+           str(f.get("tier", "-")))
+    with _lock:
+        h = _hist.get(key)
+        if h is None:
+            if len(_hist) >= MAX_KEYS - 1:
+                # the bound INCLUDES the overflow row: at most MAX_KEYS
+                # histograms ever exist, the last one being ``(other)``
+                _dropped_keys += 1
+                key = _OTHER_KEY
+                h = _hist.get(key)
+                if h is None:
+                    h = _hist[key] = _Histogram()
+            else:
+                h = _hist[key] = _Histogram()
+        h.observe(float(dur_s))
+
+
+# -- round windows / straggler attribution ------------------------------------
+
+
+def round_begin(comm_uid: int, span: str, strategy: str) -> None:
+    """Open the arrival window for one collective/step replay on
+    ``comm_uid``. Windows nest (a collective inside a step stacks its
+    window above the step's); a stale same-span window from a failed
+    earlier replay is replaced, never accumulated. Callers guard with
+    ``ENABLED``."""
+    with _lock:
+        stack = _windows.setdefault(comm_uid, [])
+        stack[:] = [w for w in stack if w.span != span]
+        stack.append(_Window(span, str(strategy or "-")))
+
+
+def note_arrivals(comm_uid: int, ranks: Sequence[int], t: float) -> None:
+    """Stamp destination ``ranks`` as arrived at monotonic ``t`` (the
+    p2p engine calls this as each strategy batch's pairs complete; the
+    LAST stamp per rank wins — a rank is as late as its latest
+    arrival). Stamps every open window on the communicator (a
+    completion inside a step's embedded collective belongs to both
+    replays). A no-op with no open window."""
+    with _lock:
+        stack = _windows.get(comm_uid)
+        if not stack:
+            return
+        for w in stack:
+            arr = w.arrivals
+            for r in ranks:
+                r = int(r)
+                if r >= 0 and t > arr.get(r, -math.inf):
+                    arr[r] = t
+
+
+def round_end(comm_uid: int, span: str) -> Optional[dict]:
+    """Close the newest ``span`` window on ``comm_uid``: compute the
+    arrival spread (``skew = max - median``; the slowest rank's id) and
+    fold it into the per-(span, strategy) straggler stats. Stale
+    windows stacked ABOVE it (an inner replay that failed before its
+    wait) are discarded. Returns the round record (None when no such
+    window was open). With tracing armed the record also lands as a
+    ``metrics.round`` instant event, which is what grows the trace
+    summary's skew/straggler columns."""
+    global _dropped_keys
+    with _lock:
+        stack = _windows.get(comm_uid)
+        w = None
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i].span == span:
+                    w = stack[i]
+                    del stack[i:]
+                    break
+            if not stack:
+                _windows.pop(comm_uid, None)
+        if w is None:
+            return None
+        key = (w.span, w.strategy)
+        st = _stragglers.get(key)
+        if st is None:
+            if len(_stragglers) >= MAX_KEYS - 1:
+                _dropped_keys += 1
+                key = (_OTHER_KEY[0], "-")
+                st = _stragglers.setdefault(key, _Straggler())
+            else:
+                st = _stragglers[key] = _Straggler()
+        skew = 0.0
+        slowest = None
+        n = len(w.arrivals)
+        if n:
+            stamps = sorted(w.arrivals.values())
+            skew = stamps[-1] - stamps[n // 2]
+            if skew > 0.0:
+                # zero spread (e.g. a replay fast path stamping every
+                # destination with one batch timestamp) has no straggler
+                # — naming the arbitrary dict-order winner would bias
+                # the modal slowest-rank stats toward an innocent rank
+                slowest = max(w.arrivals, key=w.arrivals.get)
+        st.rounds += 1
+        st.last_skew_s = skew
+        st.last_ranks = n
+        if skew > st.max_skew_s:
+            st.max_skew_s = skew
+        st.last_slowest = slowest
+        if slowest is not None:
+            st.slowest_counts[slowest] = st.slowest_counts.get(slowest,
+                                                               0) + 1
+        rec = dict(span=w.span, strategy=w.strategy, ranks=n,
+                   skew_us=skew * 1e6, slow_rank=slowest)
+    # outside the metrics lock: the emit path may create a ring under the
+    # trace lock, and nothing may nest under "metrics"
+    if obstrace.ENABLED:
+        obstrace.emit("metrics.round", **rec)
+    return rec
+
+
+# -- step critical path -------------------------------------------------------
+
+
+def note_step_replay(comm_uid: int, profile: List[tuple]) -> None:
+    """One fused ``PersistentStep`` replay's per-item profile:
+    ``("plans", [(strategy, dur_s), ...])`` for a fused exchange segment
+    (plans inside it are independent) or ``("coll", dur_s)`` for an
+    embedded persistent collective. The critical path — the longest
+    chain of DEPENDENT spans — is the sum over sequential items of each
+    item's slowest member; the chain records which strategy won each
+    link, so "where does my step spend its time" reads straight off the
+    snapshot."""
+    crit = 0.0
+    chain: List[dict] = []
+    for item in profile:
+        if item[0] == "plans":
+            if not item[1]:
+                continue
+            strat, dur = max(item[1], key=lambda sd: sd[1])
+            crit += dur
+            chain.append(dict(kind="plans", strategy=strat, dur_s=dur,
+                              parallel=len(item[1])))
+        else:
+            crit += item[1]
+            chain.append(dict(kind="coll", dur_s=item[1]))
+    with _lock:
+        st = _steps.get(comm_uid)
+        if st is None:
+            if len(_steps) >= MAX_KEYS:
+                return
+            st = _steps[comm_uid] = dict(replays=0, last_s=0.0, max_s=0.0,
+                                         chain=[])
+        st["replays"] += 1
+        st["last_s"] = crit
+        if crit > st["max_s"]:
+            st["max_s"] = crit
+        st["chain"] = chain
+
+
+# -- surfaces ------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Everything recorded this session as pure data — histograms (with
+    the shared bucket edges), straggler attribution, step critical
+    paths, and the key-bound bookkeeping. Safe to serialize; empty-ish
+    when TEMPI_METRICS=off."""
+    with _lock:
+        hists = [dict(span=k[0], strategy=k[1], tier=k[2],
+                      count=h.count, sum_s=h.sum_s,
+                      min_s=(h.min_s if h.count else 0.0), max_s=h.max_s,
+                      buckets=list(h.buckets))
+                 for k, h in _hist.items()]
+        strag = [dict(span=k[0], strategy=k[1], rounds=s.rounds,
+                      ranks=s.last_ranks, last_skew_s=s.last_skew_s,
+                      max_skew_s=s.max_skew_s, slowest_rank=s.last_slowest,
+                      slowest_counts=dict(s.slowest_counts))
+                 for k, s in _stragglers.items()]
+        steps = {uid: dict(replays=st["replays"],
+                           last_critical_path_s=st["last_s"],
+                           max_critical_path_s=st["max_s"],
+                           chain=[dict(c) for c in st["chain"]])
+                 for uid, st in _steps.items()}
+        return dict(mode=MODE, enabled=ENABLED,
+                    bucket_edges_us=bucket_edges_us(),
+                    histograms=sorted(hists,
+                                      key=lambda d: -d["count"]),
+                    stragglers=sorted(strag, key=lambda d: -d["rounds"]),
+                    steps=steps,
+                    open_windows=sum(len(s) for s in _windows.values()),
+                    dropped_keys=_dropped_keys)
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.9g}"
+
+
+def report() -> str:
+    """Prometheus-style text exposition of the snapshot — the scrape
+    surface. Cumulative histograms (``le`` upper edges in seconds, like
+    the convention), straggler gauges, and step critical paths."""
+    snap = snapshot()
+    lines: List[str] = []
+    edges = snap["bucket_edges_us"]
+    lines.append("# TYPE tempi_span_seconds histogram")
+    for h in snap["histograms"]:
+        lbl = (f'span="{h["span"]}",strategy="{h["strategy"]}",'
+               f'tier="{h["tier"]}"')
+        cum = 0
+        for i, c in enumerate(h["buckets"]):
+            cum += c
+            if not c and i < NUM_BUCKETS - 1:
+                continue  # keep the exposition small: skip empty buckets
+            le = "+Inf" if math.isinf(edges[i]) else _fmt(edges[i] / 1e6)
+            lines.append(
+                f'tempi_span_seconds_bucket{{{lbl},le="{le}"}} {cum}')
+        lines.append(f"tempi_span_seconds_count{{{lbl}}} {h['count']}")
+        lines.append(
+            f"tempi_span_seconds_sum{{{lbl}}} {_fmt(h['sum_s'])}")
+    lines.append("# TYPE tempi_round_skew_seconds gauge")
+    lines.append("# TYPE tempi_round_slowest_rank gauge")
+    for s in snap["stragglers"]:
+        lbl = f'span="{s["span"]}",strategy="{s["strategy"]}"'
+        lines.append(
+            f"tempi_round_skew_seconds{{{lbl}}} {_fmt(s['last_skew_s'])}")
+        lines.append(f"tempi_round_skew_seconds_max{{{lbl}}} "
+                     f"{_fmt(s['max_skew_s'])}")
+        lines.append(f"tempi_rounds_total{{{lbl}}} {s['rounds']}")
+        if s["slowest_rank"] is not None:
+            lines.append(
+                f"tempi_round_slowest_rank{{{lbl}}} {s['slowest_rank']}")
+    lines.append("# TYPE tempi_step_critical_path_seconds gauge")
+    for uid, st in sorted(snap["steps"].items()):
+        lbl = f'comm="{uid}"'
+        lines.append(f"tempi_step_critical_path_seconds{{{lbl}}} "
+                     f"{_fmt(st['last_critical_path_s'])}")
+        lines.append(f"tempi_step_replays_total{{{lbl}}} {st['replays']}")
+    if snap["dropped_keys"]:
+        lines.append(
+            f"tempi_metrics_dropped_keys_total {snap['dropped_keys']}")
+    return "\n".join(lines)

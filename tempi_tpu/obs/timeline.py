@@ -1,0 +1,111 @@
+"""Unified runtime decision timeline (ISSUE 15): one causally-ordered
+ledger for every subsystem's verdicts.
+
+The runtime grew seven separate decision ledgers — breaker transitions
+and demotions (runtime/health.py), tune drift/adoption (tune/online.py),
+re-placement decisions (parallel/replacement.py), FT death verdicts and
+shrinks (runtime/liveness.py), QoS lane quarantines (runtime/qos.py),
+elastic join/admit records (runtime/elastic.py), and plan-invalidation
+bumps (runtime/invalidation.py). Each is the right place to *keep* its
+subsystem's full evidence, but answering "why did my step recompile at
+12:04" or "what chain of verdicts preceded this p99 jump" meant diffing
+seven snapshots by hand and interleaving them by guesswork.
+
+This module is the merge point: every decision site appends ONE compact
+record here, stamped with a process-wide sequence number (causal order
+— two decisions on one process are ordered exactly as they happened,
+lock-free readers never see them swapped), the monotonic wall time, and
+the live plan-invalidation GENERATION at decision time. The generation
+stamp is what links cause to effect across subsystems: a breaker-open
+at generation 41, the ``invalidation.bump`` that moved it to 42, and
+the ``coll.recompile`` that observed 42 read as one story in
+``api.explain()``.
+
+Deliberately always-on and bounded: decisions are rare control-plane
+events (breaker transitions, verdicts, epoch bumps — never per-exchange
+traffic), the ledger keeps the newest ``KEEP`` records, and each record
+is a small plain dict. This mirrors the per-subsystem ledgers, which
+are also always-on; no knob, no hot-path cost.
+
+Lock discipline: ``record`` takes only its own leaf lock (it never
+calls out while holding it), so it is safe to call from any subsystem,
+under any of their locks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..utils import locks
+
+#: Bounded history: newest KEEP decisions (diagnostics, not logs).
+KEEP = 256
+
+_lock = locks.named_lock("timeline")
+_events: List[dict] = []
+_seq = 0
+_total = 0
+
+
+def record(kind: str, generation: Optional[int] = None, **fields) -> dict:
+    """Append one decision record: ``kind`` names the decision in the
+    subsystem's own vocabulary (``breaker.open``, ``tune.drift``,
+    ``ft.verdict``, ``invalidation.bump``, ...), ``fields`` carry its
+    compact payload (pure data — serializable). ``generation`` defaults
+    to the LIVE plan-invalidation generation at record time; the bump
+    site passes the generation it just created so the record never races
+    a concurrent trigger. Returns the record."""
+    global _seq, _total
+    if generation is None:
+        # lazy import: invalidation imports obs.trace; importing it at
+        # module scope here would make obs <-> runtime import order
+        # load-bearing for no benefit. A bare attribute read needs no
+        # lock (int reads are atomic under the GIL).
+        from ..runtime import invalidation
+        generation = invalidation.GENERATION
+    ev = dict(kind=str(kind), generation=int(generation),
+              at_monotonic=time.monotonic())
+    for k, v in fields.items():
+        if v is not None:
+            ev[k] = v
+    with _lock:
+        _seq += 1
+        _total += 1
+        ev["seq"] = _seq
+        _events.append(ev)
+        del _events[:-KEEP]
+    return ev
+
+
+def snapshot(limit: Optional[int] = None) -> List[dict]:
+    """The bounded timeline, oldest-first (causal order by ``seq``).
+    ``limit`` keeps only the newest N records. Pure data — safe to
+    serialize; empty before the first decision and after a reset."""
+    with _lock:
+        evs = [dict(e) for e in _events]
+    if limit is not None and limit >= 0:
+        evs = evs[-limit:]
+    return evs
+
+
+def stats() -> dict:
+    """Ledger bookkeeping: total decisions recorded this session and how
+    many the bounded history still holds."""
+    with _lock:
+        return dict(total=_total, kept=len(_events), keep=KEEP)
+
+
+def configure() -> None:
+    """Session arm point (api.init): clear the previous session's
+    decisions — the timeline is per-session evidence, like counters.
+    The sequence counter is NOT rewound (a monotonic stamp must never
+    collide across init/finalize cycles in one process)."""
+    reset()
+
+
+def reset() -> None:
+    with _lock:
+        global _total
+        _events.clear()
+        _total = 0

@@ -70,10 +70,23 @@ from ..utils import logging as log
 
 MODES = ("off", "flight", "full")
 
-#: Module-level fast-path flag: True iff mode != off. Instrumented sites
-#: test this before calling into the module (see module docstring).
+#: Module-level fast-path flag: True iff ANY consumer is armed — the
+#: rings (mode != off) or the metrics span-close hook (TEMPI_METRICS=on;
+#: obs/metrics.py). Instrumented sites test this before calling into the
+#: module (see module docstring). With only the hook armed, instant
+#: events are dropped cheaply inside :func:`emit` and spans feed the
+#: hook without touching (or allocating) any ring.
 ENABLED = False
 MODE = "off"
+
+#: True iff mode != off: the rings record. Split from ENABLED so the
+#: metrics layer can tap span closes without arming the rings.
+RECORDING = False
+
+#: Span-close hook (obs/metrics.py feed): called as
+#: ``hook(name, dur_s, fields_or_None)`` on every ``emit_span``/``span``
+#: exit while set. Installed via :func:`set_span_hook`.
+SPAN_HOOK = None
 
 _DEFAULT_CAPACITY = 4096
 _FAILURE_KEEP = 20  # bounded failure-snapshot history (diagnostics, not logs)
@@ -87,6 +100,11 @@ _path = ""
 _t0 = time.monotonic()   # session epoch; exported timestamps are relative
 _snap_seq = itertools.count(1)
 _failures: List[dict] = []
+# fleet identity (ISSUE 15; obs/fleet.py): the process id stamped into
+# dump filenames/metadata and the clock-offset estimate against the
+# coordinator that lets the merge CLI align N processes' timelines
+_process_rank: Optional[int] = None
+_clock: Optional[dict] = None
 
 
 class TraceConfigError(ValueError):
@@ -137,7 +155,7 @@ def configure(mode: Optional[str] = None, capacity: Optional[int] = None,
     ``read_environment``); explicit values override (test convenience).
     Clears all rings and the failure-snapshot history — the recorder is
     per-session state, like counters."""
-    global ENABLED, MODE, _capacity, _path, _gen, _t0
+    global ENABLED, MODE, RECORDING, _capacity, _path, _gen, _t0
     if mode is None:
         mode = getattr(envmod.env, "trace_mode", "off")
     if mode not in MODES:
@@ -150,30 +168,86 @@ def configure(mode: Optional[str] = None, capacity: Optional[int] = None,
             f"bad trace ring capacity {capacity!r}: want a positive integer")
     if path is None:
         path = getattr(envmod.env, "trace_path", "")
+    global _process_rank, _clock
     with _lock:
         MODE = mode
-        ENABLED = mode != "off"
+        RECORDING = mode != "off"
+        ENABLED = RECORDING or SPAN_HOOK is not None
         _capacity = int(capacity)
         _path = path or ""
         _gen += 1
         _rings.clear()
         _failures.clear()
         _t0 = time.monotonic()
-    if ENABLED:
+        # the fleet identity is per-session too: a re-init re-stamps it
+        # (obs/fleet.init_process) right after this configure
+        _process_rank = None
+        _clock = None
+    if RECORDING:
         log.debug(f"trace recorder armed: mode={mode} "
                   f"capacity={_capacity}/thread"
                   + (f" path={_path}" if _path else ""))
 
 
 def reset() -> None:
-    """Drop all recorded events and failure snapshots, keeping the
-    configured mode (session teardown / test isolation)."""
-    global _gen, _t0
+    """Drop all recorded events, failure snapshots, and the fleet
+    process identity, keeping the configured mode (session teardown /
+    test isolation)."""
+    global _gen, _t0, _process_rank, _clock
     with _lock:
         _gen += 1
         _rings.clear()
         _failures.clear()
         _t0 = time.monotonic()
+        _process_rank = None
+        _clock = None
+
+
+def set_span_hook(hook) -> None:
+    """Install (or with ``None`` remove) the span-close hook — the
+    metrics layer's feed (obs/metrics.py). Recomputes the combined
+    ``ENABLED`` flag so the instrumented sites fire for the hook even
+    with the rings off."""
+    global SPAN_HOOK, ENABLED
+    with _lock:
+        SPAN_HOOK = hook
+        ENABLED = RECORDING or hook is not None
+
+
+def set_process(rank: int, clock: Optional[dict] = None) -> None:
+    """Stamp this process's fleet identity (obs/fleet.py, at init):
+    ``rank`` is the jax process index (dump filenames gain the
+    ``-r<rank>`` stamp; merged lanes key on it), ``clock`` the
+    coordinator offset estimate (``offset_s``/``uncertainty_s``/...)
+    carried in dump metadata for the merge to apply."""
+    global _process_rank, _clock
+    with _lock:
+        _process_rank = int(rank)
+        if clock is not None:
+            _clock = dict(clock)
+
+
+def process_info() -> dict:
+    """This process's dump metadata: the session epoch (``t0`` on the
+    local monotonic clock — what the merge shifts by), plus rank and the
+    clock estimate when stamped."""
+    with _lock:
+        d: Dict[str, Any] = dict(t0=_t0)
+        if _process_rank is not None:
+            d["rank"] = _process_rank
+        if _clock:
+            d["clock"] = dict(_clock)
+    return d
+
+
+def default_dump_name() -> str:
+    """Basename a directory-resolved dump lands under:
+    ``tempi-trace-r<rank>.json`` once a process id is stamped (so N
+    processes sharing one TEMPI_TRACE_PATH directory never clobber each
+    other — the fleet-merge prerequisite), plain ``tempi-trace.json``
+    in a single-process world."""
+    return ("tempi-trace.json" if _process_rank is None
+            else f"tempi-trace-r{_process_rank}.json")
 
 
 def _ring() -> _Ring:
@@ -190,15 +264,25 @@ def _ring() -> _Ring:
 
 
 def emit(name: str, **fields: Any) -> None:
-    """Record one instant event. Callers guard with ``ENABLED``."""
-    _ring().append((time.monotonic(), None, name, fields or None))
+    """Record one instant event. Callers guard with ``ENABLED``; when
+    only the metrics span hook armed the sites (rings off), instants
+    drop here without allocating a ring."""
+    if RECORDING:
+        _ring().append((time.monotonic(), None, name, fields or None))
 
 
 def emit_span(name: str, t0: float, **fields: Any) -> None:
     """Record one duration event begun at ``t0`` (a ``time.monotonic()``
     stamp the caller took before the work). Callers guard with
-    ``ENABLED`` — on hot paths, around BOTH the stamp and this call."""
-    _ring().append((t0, time.monotonic() - t0, name, fields or None))
+    ``ENABLED`` — on hot paths, around BOTH the stamp and this call.
+    Every span close also feeds the metrics hook when one is installed
+    (obs/metrics.py histograms)."""
+    dur = time.monotonic() - t0
+    if RECORDING:
+        _ring().append((t0, dur, name, fields or None))
+    hook = SPAN_HOOK
+    if hook is not None:
+        hook(name, dur, fields or None)
 
 
 class span:
@@ -271,13 +355,17 @@ def failures() -> List[dict]:
 
 def _snapshot_file(reason: str, seq: int) -> str:
     """Where an auto-snapshot lands for the configured TEMPI_TRACE_PATH:
-    a directory gets ``tempi-trace-<reason>-<seq>.json`` inside it; a
-    file path gets the suffix spliced before its extension so repeated
-    failures never overwrite each other's evidence."""
+    a directory gets ``tempi-trace[-r<rank>]-<reason>-<seq>.json``
+    inside it; a file path gets the suffixes spliced before its
+    extension. The seq keeps repeated failures from overwriting each
+    other's evidence; the rank stamp (when a process id is known) keeps
+    N processes sharing one path from clobbering each other's."""
+    rs = "" if _process_rank is None else f"-r{_process_rank}"
     if os.path.isdir(_path):
-        return os.path.join(_path, f"tempi-trace-{reason}-{seq}.json")
+        return os.path.join(_path,
+                            f"tempi-trace{rs}-{reason}-{seq}.json")
     stem, ext = os.path.splitext(_path)
-    return f"{stem}-{reason}-{seq}{ext or '.json'}"
+    return f"{stem}{rs}-{reason}-{seq}{ext or '.json'}"
 
 
 def failure_snapshot(reason: str, detail: str = "") -> dict:
@@ -285,7 +373,13 @@ def failure_snapshot(reason: str, detail: str = "") -> dict:
     snapshot is appended to the bounded :func:`failures` history and,
     with ``TEMPI_TRACE_PATH`` set, written to disk as Chrome trace JSON
     (the file every ``WaitTimeout``/breaker-open names in its warning).
-    Never raises — evidence capture must not mask the failure itself."""
+    Never raises — evidence capture must not mask the failure itself.
+    A no-op when the rings are not recording (metrics-only arming makes
+    the callers' ``ENABLED`` guard pass, but an empty snapshot written
+    to disk is noise, not evidence)."""
+    if not RECORDING:
+        return dict(reason=reason, detail=str(detail)[:500], path="",
+                    events=[])
     snap = dict(reason=reason, detail=str(detail)[:500], path="",
                 events=snapshot())
     if _path:
@@ -296,7 +390,8 @@ def failure_snapshot(reason: str, detail: str = "") -> dict:
             out = _snapshot_file(reason, seq)
             export.write(out, snap["events"],
                          metadata=dict(reason=reason,
-                                       detail=snap["detail"]))
+                                       detail=snap["detail"],
+                                       process=process_info()))
             snap["path"] = out
             log.warn(f"flight recorder snapshot ({reason}) written to {out}")
         except Exception as e:  # noqa: BLE001 — diagnostics only
@@ -310,15 +405,26 @@ def failure_snapshot(reason: str, detail: str = "") -> dict:
 
 def dump(path: Optional[str] = None) -> str:
     """Write the current merged snapshot as Chrome trace-event JSON and
-    return the path. ``path=None`` resolves TEMPI_TRACE_PATH (a directory
-    gets ``tempi-trace.json`` inside it), falling back to
-    ``./tempi-trace.json``."""
+    return the path. ``path=None`` resolves TEMPI_TRACE_PATH (a
+    directory gets :func:`default_dump_name` inside it — rank-stamped
+    ``tempi-trace-r<rank>.json`` once a process id is known, so fleet
+    processes sharing one directory never clobber each other), falling
+    back to ``./<default_dump_name()>``. Dump metadata carries the
+    process identity + clock estimate the fleet merge aligns by."""
     from . import export
     if path is None:
-        path = _path or "tempi-trace.json"
+        path = _path or default_dump_name()
         if os.path.isdir(path):
-            path = os.path.join(path, "tempi-trace.json")
-    return export.write(path, snapshot(), metadata=dict(reason="dump"))
+            path = os.path.join(path, default_dump_name())
+        elif _process_rank is not None and path != default_dump_name():
+            # a FILE-path TEMPI_TRACE_PATH shared by N processes would
+            # clobber: splice the rank stamp before the extension, like
+            # the failure snapshots do
+            stem, ext = os.path.splitext(path)
+            path = f"{stem}-r{_process_rank}{ext or '.json'}"
+    return export.write(path, snapshot(),
+                        metadata=dict(reason="dump",
+                                      process=process_info()))
 
 
 def finalize() -> Optional[str]:
@@ -326,7 +432,7 @@ def finalize() -> Optional[str]:
     merged multi-rank dump, then reset — recorder history is per-session,
     like counters. Returns the dump path, if one was written."""
     out = None
-    if ENABLED and MODE == "full":
+    if RECORDING and MODE == "full":
         try:
             out = dump()
             log.info(f"trace dump written to {out}")

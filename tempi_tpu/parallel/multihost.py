@@ -27,6 +27,7 @@ boundary. A hardware multi-host launch only needs the coordinator address.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Optional, Tuple
@@ -36,6 +37,7 @@ from ..utils import env as envmod
 from ..utils import logging as log
 
 _initialized = False
+_clock_ordinal = itertools.count()  # SPMD-aligned clock-exchange rounds
 
 
 def _initialize_with_retry(do_init) -> None:
@@ -291,6 +293,82 @@ def read_join_commit(scope: str, budget_s: float) -> Optional[int]:
         return int(client.blocking_key_value_get(
             key, max(1, int(budget_s * 1000))))
     except Exception:
+        return None
+
+
+def allgather_fleet_dump(scope, timeout_s: float) -> Optional[dict]:
+    """DCN confirmation seam for the fleet trace dump (ISSUE 15;
+    obs/fleet.dump_fleet): publish "my rank-stamped dump landed on disk"
+    and collect every other process's confirmation, so the coordinator
+    merges only after the files it will read exist. Same transport and
+    abstention semantics as :func:`allgather_suspects`; ``scope`` is the
+    SPMD-aligned dump ordinal (KV entries outlive the barrier, so keys
+    must be unique per dump)."""
+    return _allgather_kv_ints(f"tempi/obs/fleetdump/{scope}", 1,
+                              timeout_s, what="fleet trace dump")
+
+
+def clock_offset_exchange(rounds: int = 5, budget_s: float = 5.0
+                          ) -> Optional[dict]:
+    """Midpoint-of-RTT clock-offset estimate against the coordinator
+    (process 0), over the same coordinator-KV channel the control votes
+    ride (ISSUE 15; obs/fleet.py). Each non-coordinator process runs
+    ``rounds`` ping/pong exchanges: it publishes a ping key, the
+    coordinator answers with its own ``time.monotonic_ns()`` stamp, and
+    the requester brackets the answer between its t0/t1 stamps —
+    ``offset = t_coord - (t0 + t1) / 2`` with uncertainty RTT/2. The
+    minimum-RTT sample wins (KV service jitter only ever WIDENS an RTT,
+    so the tightest bracket is the most truthful). The coordinator
+    serves every peer's pings sequentially and reports offset 0.
+
+    SPMD: call on every process of the world, the same number of times
+    (keys are scoped by a per-process ordinal that only stays aligned if
+    every process runs the same program — the ISSUE 9/13 key-scoping
+    discipline). Returns ``{rank, offset_s, uncertainty_s, rtt_s,
+    method}``, or None when no usable channel exists or the exchange
+    failed (the caller degrades to offset-unknown dumps; a broken clock
+    estimate must never fail init)."""
+    import jax
+
+    me, n = jax.process_index(), jax.process_count()
+    if n <= 1:
+        return dict(rank=int(me), offset_s=0.0, uncertainty_s=0.0,
+                    rtt_s=0.0, method="single-process")
+    client = _kv_client()
+    if client is None:
+        return None
+    base = f"tempi/obs/clock/{next(_clock_ordinal)}"
+    # the coordinator serves peers one after another, so a peer late in
+    # the order legitimately waits for every earlier peer's rounds
+    deadline = time.monotonic() + budget_s * max(1, n - 1)
+    try:
+        if me == 0:
+            for p in range(1, n):
+                for i in range(rounds):
+                    ms = max(1, int((deadline - time.monotonic()) * 1000))
+                    client.blocking_key_value_get(f"{base}/ping/{p}/{i}",
+                                                  ms)
+                    client.key_value_set(f"{base}/pong/{p}/{i}",
+                                         str(time.monotonic_ns()))
+            return dict(rank=0, offset_s=0.0, uncertainty_s=0.0,
+                        rtt_s=0.0, method="kv-midpoint", rounds=rounds)
+        best: Optional[Tuple[int, float]] = None  # (rtt_ns, offset_ns)
+        for i in range(rounds):
+            t0 = time.monotonic_ns()
+            client.key_value_set(f"{base}/ping/{me}/{i}", str(t0))
+            ms = max(1, int((deadline - time.monotonic()) * 1000))
+            tc = int(client.blocking_key_value_get(f"{base}/pong/{me}/{i}",
+                                                   ms))
+            t1 = time.monotonic_ns()
+            rtt = t1 - t0
+            if best is None or rtt < best[0]:
+                best = (rtt, tc - (t0 + t1) / 2.0)
+        return dict(rank=int(me), offset_s=best[1] / 1e9,
+                    uncertainty_s=best[0] / 2e9, rtt_s=best[0] / 1e9,
+                    method="kv-midpoint", rounds=rounds)
+    except Exception as e:
+        log.warn(f"fleet clock exchange failed: {e!r} (dumps will merge "
+                 "with an unknown offset)")
         return None
 
 

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..measure import system as msys
+from ..obs import metrics as obsmetrics
 from ..obs import trace as obstrace
 from ..runtime import faults, health, invalidation, liveness
 from ..tune import model as tune_model
@@ -720,6 +721,17 @@ def _execute_matched(comm: Communicator, messages, consumed,
                 obstrace.emit("p2p.complete", req=op.request.id,
                               kind=op.kind, rank=op.rank, peer=op.peer,
                               tag=op.tag, strategy=strat)
+        if obsmetrics.ENABLED:
+            # round-window arrival stamps (ISSUE 15): the DESTINATION
+            # rank of each completed pair just received its bytes — one
+            # stamp per strategy batch (its pairs complete together),
+            # so a batch that lags (a slow transport, a delayed link)
+            # marks exactly the ranks it kept waiting
+            obsmetrics.note_arrivals(
+                comm.uid,
+                [op.peer if op.kind == "send" else op.rank
+                 for op in ops],
+                time.monotonic())
         if liveness.ENABLED:
             # per-rank liveness heartbeats (ISSUE 9): a completed exchange
             # is proof of life for both endpoints — and the background
@@ -1377,6 +1389,17 @@ def _startall_impl(preqs: Sequence[PersistentRequest],
         done = Request(next(_req_ids), comm, buf=None, done=True)
         for p in preqs:
             p.active = done  # one shared completed handle for the replay
+        if obsmetrics.ENABLED:
+            # the replay fast path never re-enters the engine's matched
+            # completion loop, so it stamps its round-window arrivals
+            # here (ISSUE 15) — library-rank destinations, like the
+            # eager path's stamps
+            dests = []
+            for p in preqs:
+                d = p.peer if p.kind == "send" else p.app_rank
+                if d >= 0:
+                    dests.append(comm.library_rank(d))
+            obsmetrics.note_arrivals(comm.uid, dests, time.monotonic())
         return
     # first start (or subset/superset of a cached batch): drive the
     # one-time pipeline through the normal engine

@@ -58,6 +58,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..runtime import faults, health, liveness
 from ..tune import online as tune_online
@@ -355,6 +356,9 @@ def replace_ranks(comm: Communicator) -> dict:
         if dec["applied"]:
             _applied_total += 1
             _latest_epoch = max(_latest_epoch, dec["epoch"])
+    timeline.record("replace.decision", outcome=dec.get("outcome"),
+                    applied=bool(dec.get("applied")),
+                    epoch=dec.get("epoch"), gain=dec.get("gain"))
     return dec
 
 

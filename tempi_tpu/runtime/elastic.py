@@ -76,6 +76,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..utils import counters as ctr
 from ..utils import env as envmod
@@ -162,6 +163,12 @@ def _ledger_append(entry: dict) -> None:
         entry["at_monotonic"] = time.monotonic()
         _ledger.append(entry)
         del _ledger[:-_LEDGER_KEEP]
+    # every join/admit record also lands in the unified decision
+    # timeline (obs/timeline.py) — outside the lock, like the trace
+    # emits at the call sites
+    timeline.record(f"elastic.{entry.get('kind', '?')}",
+                    outcome=entry.get("outcome"),
+                    comm=entry.get("comm_uid"))
 
 
 # -- join ----------------------------------------------------------------------

@@ -48,6 +48,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..utils import env as envmod
 from ..utils import locks
@@ -152,6 +153,12 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
         _recompute_flags_locked()
         consecutive = b.consecutive
     if opened:
+        # the decision timeline record lands BEFORE its invalidation
+        # bump, mirroring causality (open -> bump -> recompile); both
+        # run outside the registry lock
+        timeline.record("breaker.open", link=list(peer),
+                        strategy=strategy, consecutive=consecutive,
+                        error=(error or "")[:200])
         # breaker-open trigger of the shared plan-invalidation contract
         # (runtime/invalidation.py): every compiled artifact riding this
         # strategy re-validates before its next replay
@@ -194,6 +201,9 @@ def force_open(peer: tuple, strategy: str, reason: str = "forced") -> None:
             b.last_transition_at = b.opened_at
         _recompute_flags_locked()
     if opened:
+        timeline.record("breaker.open", link=list(peer),
+                        strategy=strategy, forced=True,
+                        error=reason[:200])
         invalidation.bump("breaker", f"{peer} {strategy} pinned")
     if opened and obstrace.ENABLED:
         obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
@@ -254,8 +264,12 @@ def record_success(peer: tuple, strategy: str) -> None:
             closed = True
             b.last_transition_at = time.monotonic()
             _recompute_flags_locked()
-    if closed and obstrace.ENABLED:
-        obstrace.emit("breaker.close", link=list(peer), strategy=strategy)
+    if closed:
+        timeline.record("breaker.close", link=list(peer),
+                        strategy=strategy)
+        if obstrace.ENABLED:
+            obstrace.emit("breaker.close", link=list(peer),
+                          strategy=strategy)
 
 
 def allowed(peer: tuple, strategy: str) -> bool:
@@ -325,6 +339,8 @@ def note_demotion(peer: tuple, from_strategy: str, to_strategy: str) -> None:
         if len(_demotions) < 100:
             _demotions.append(dict(peer=list(peer), **{"from": from_strategy},
                                    to=to_strategy))
+    timeline.record("breaker.demotion", link=list(peer),
+                    **{"from": from_strategy}, to=to_strategy)
     if obstrace.ENABLED:
         obstrace.emit("breaker.demotion", link=list(peer),
                       **{"from": from_strategy}, to=to_strategy)

@@ -92,6 +92,14 @@ def bump(cause: str, detail: str = "") -> int:
         # not serialize trigger bookkeeping behind it
         obstrace.emit("invalidation.bump", generation=gen, cause=cause,
                       detail=str(detail)[:200])
+    # the unified decision timeline (obs/timeline.py, ISSUE 15): the
+    # bump is the causal hinge of every recompile story, so it records
+    # the generation it just CREATED — a concurrent trigger must not
+    # stamp this record with a newer one. Lazy import: timeline is a
+    # leaf, but obs <-> runtime import order must not become load-bearing
+    from ..obs import timeline
+    timeline.record("invalidation.bump", generation=gen, cause=cause,
+                    detail=str(detail)[:200])
     return gen
 
 

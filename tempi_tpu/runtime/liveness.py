@@ -76,6 +76,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..utils import counters as ctr
 from ..utils import env as envmod
@@ -526,6 +527,9 @@ def _declare_dead(comm, dead_set: Set[int], provenance: dict) -> Set[int]:
         _verdicts.append(entry)
         del _verdicts[:-_LEDGER_KEEP]
         _last_agreement = dict(provenance)
+    timeline.record("ft.verdict", dead=sorted(newly),
+                    revoked=len(doomed),
+                    method=provenance.get("method"))
     if obstrace.ENABLED:
         obstrace.emit("ft.verdict", dead=sorted(newly),
                       revoked=len(doomed),
@@ -636,6 +640,8 @@ def shrink(comm):
     with _lock:
         _verdicts.append(entry)
         del _verdicts[:-_LEDGER_KEEP]
+    timeline.record("ft.shrink", parent_size=comm.size, size=k,
+                    dead=sorted(dead))
     if obstrace.ENABLED:
         obstrace.emit("ft.shrink", parent_size=comm.size, size=k,
                       dead=sorted(dead))

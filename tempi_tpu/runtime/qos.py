@@ -47,6 +47,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from ..obs import timeline
 from ..utils import counters as ctr
 from ..utils import env as envmod
 from ..utils import locks
@@ -143,6 +144,7 @@ def note_lane_quarantine(cls: str) -> None:
     starvation-visibility ledger)."""
     with _verdict_lock:
         _quarantine_verdicts[cls] = _quarantine_verdicts.get(cls, 0) + 1
+    timeline.record("qos.quarantine", qos_class=cls)
 
 
 class ClassScheduler:

@@ -59,6 +59,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 from ..measure import system as msys
+from ..obs import timeline
 from ..obs import trace as obstrace
 from ..runtime import faults, health
 from ..utils import env as envmod
@@ -280,6 +281,7 @@ def record(link: tuple, strategy: str, nbytes: int, block: int,
             from ..runtime import invalidation
             invalidation.bump("tune", f"{phase} link {link} {strategy} "
                                       f"2^{event['bin']}B")
+        timeline.record("tune.drift", **event)
         if obstrace.ENABLED:
             obstrace.emit("tune.drift", **event)
         lvl = log.info if phase == "drifted" else log.debug
@@ -420,6 +422,9 @@ def note_adoption(entry: dict) -> None:
         _adopt_total += 1
         _adopt_audit.append(dict(entry))
         del _adopt_audit[:-_AUDIT_KEEP]
+    timeline.record("tune.adopt", link=entry.get("link"),
+                    bin=entry.get("bin"), **{"from": entry.get("from")},
+                    to=entry.get("to"), reason=entry.get("reason"))
     if obstrace.ENABLED:
         obstrace.emit("tune.adopt", link=entry.get("link"),
                       bin=entry.get("bin"),

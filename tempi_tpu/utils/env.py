@@ -65,7 +65,28 @@ Observability knobs (ISSUE 3; see obs/trace.py and the README
   TEMPI_TRACE_PATH     file stem or directory for trace dumps and the
                          automatic WaitTimeout/breaker-open snapshots
                          (default "" = snapshots stay in memory only,
-                         readable via obs.trace.failures())
+                         readable via obs.trace.failures()). In a
+                         multi-process world dump names gain a
+                         -r<rank> stamp so processes sharing one
+                         directory never clobber each other (the fleet
+                         merge prerequisite; obs/fleet.py)
+
+Fleet metrics knobs (ISSUE 15; see obs/metrics.py and the README
+"Fleet observability" section):
+  TEMPI_METRICS        = off | on — fixed-memory runtime metrics: log2-
+                         bucketed latency histograms per (span,
+                         strategy, tier) fed from the flight recorder's
+                         span closes, per-round arrival-spread /
+                         straggler attribution for persistent
+                         collective/reduction/step replays, and
+                         persistent-step critical paths (default off =
+                         one module-flag truth test per site, no state
+                         allocated — the established zero-cost
+                         pattern). Works with TEMPI_TRACE=off: the
+                         span-close hook arms the emit sites without
+                         arming the rings. Surfaces:
+                         api.metrics_snapshot() and the
+                         Prometheus-style api.metrics_report().
 
 Online performance-model adaptation knobs (ISSUE 4; see tune/online.py,
 tune/model.py and the README "Adaptive tuning" section):
@@ -381,10 +402,11 @@ KNOWN_KNOBS = (
     "TEMPI_BREAKER_COOLDOWN_S",
     "TEMPI_PUMP_HEARTBEAT_S",
     "TEMPI_PUMP_STOP_TIMEOUT_S",
-    # observability (ISSUE 3)
+    # observability (ISSUE 3) + fleet metrics (ISSUE 15)
     "TEMPI_TRACE",
     "TEMPI_TRACE_EVENTS",
     "TEMPI_TRACE_PATH",
+    "TEMPI_METRICS",
     # online adaptation (ISSUE 4)
     "TEMPI_TUNE",
     "TEMPI_TUNE_DRIFT",
@@ -538,6 +560,9 @@ class Environment:
     trace_mode: str = "off"        # off | flight | full
     trace_events: int = 4096       # per-thread ring capacity
     trace_path: str = ""           # dump/snapshot destination ("" = memory)
+    # fleet metrics (ISSUE 15) — see obs/metrics.py (histograms +
+    # straggler attribution) and obs/fleet.py (trace merging)
+    metrics_mode: str = "off"      # off | on
     # online performance-model adaptation (no reference analog; ISSUE 4) —
     # see tune/online.py (ingest), tune/model.py (drift + re-ranking)
     tune_mode: str = "off"         # off | observe | adapt
@@ -737,6 +762,13 @@ class Environment:
             raise ValueError(
                 f"bad TEMPI_TRACE_EVENTS={v!r}: want a positive integer")
         e.trace_path = getenv("TEMPI_TRACE_PATH") or ""
+        # the metrics knob parses as loudly as TEMPI_TRACE: a typo'd
+        # TEMPI_METRICS silently staying off would run the one fleet
+        # session that asked for straggler attribution blind
+        mm = (getenv("TEMPI_METRICS") or "off").lower()
+        if mm not in ("off", "on"):
+            raise ValueError(f"bad TEMPI_METRICS={mm!r}: want off | on")
+        e.metrics_mode = mm
 
         # tuning knobs parse as loudly as the rest: a typo'd TEMPI_TUNE
         # silently staying off would freeze AUTO decisions on the swept
@@ -983,6 +1015,9 @@ class Environment:
             # ...and our own introspection: the flight recorder observes
             # framework machinery the bail-out turns off
             e.trace_mode = "off"
+            # ...and the metrics layer for the same reason: histograms
+            # and straggler windows observe framework replay machinery
+            e.metrics_mode = "off"
             # ...and the adaptive layer: no strategy modeling means
             # nothing to observe or re-rank
             e.tune_mode = "off"

@@ -1,0 +1,89 @@
+"""Child process for the fleet-observability test (ISSUE 15).
+
+Run as: python _fleet_child.py <process_id> <num_processes> <coordinator>
+        <dump_dir>
+
+Joins the jax.distributed world with TEMPI_TRACE + TEMPI_METRICS armed,
+drives a cross-process exchange plus a persistent-collective replay
+(real round spans, real arrival stamps), and calls
+``api.trace_dump_fleet()`` — every process writes its rank-stamped dump
+into ``dump_dir`` and process 0 merges them clock-aligned. Exit 0 on
+success; prints ``FLEET-CHILD-OK <pid> <path>`` for the parent to
+assert on.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tempi_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(device_count=4)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    pid, nproc, coord, dump_dir = sys.argv[1:5]
+    os.environ["TEMPI_COORDINATOR"] = coord
+    os.environ["TEMPI_NUM_PROCESSES"] = nproc
+    os.environ["TEMPI_PROCESS_ID"] = pid
+    os.environ["TEMPI_TRACE"] = "flight"
+    os.environ["TEMPI_TRACE_PATH"] = dump_dir
+    os.environ["TEMPI_METRICS"] = "on"
+
+    from tempi_tpu import api
+    from tempi_tpu.obs import trace as obstrace
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.utils.env import AlltoallvMethod
+
+    comm = api.init()
+    assert comm.size == 4 * int(nproc), comm.size
+    # the init-time clock exchange must have stamped this process
+    info = obstrace.process_info()
+    assert info.get("rank") == int(pid), info
+    assert "clock" in info, "clock offset estimate missing"
+
+    # cross-process ring exchange: every rank r -> (r + half) % size
+    half = comm.size // 2
+    ty = dt.contiguous(128, dt.BYTE)
+    sbuf = comm.buffer_from_host(
+        [np.full(128, r + 1, np.uint8) for r in range(comm.size)])
+    rbuf = comm.alloc(128)
+    reqs = []
+    for r in range(comm.size):
+        reqs.append(p2p.isend(comm, r, sbuf, (r + half) % comm.size, ty))
+        reqs.append(p2p.irecv(comm, (r + half) % comm.size, rbuf, r, ty))
+    p2p.waitall(reqs)
+
+    # persistent collective replay: round spans + arrival windows
+    n = comm.size
+    sc = np.zeros((n, n), np.int64)
+    for a in range(n):
+        sc[a, (a + 1) % n] = 64
+    rc = sc.T.copy()
+    sd = np.zeros_like(sc)
+    rd = np.zeros_like(sc)
+    h = api.alltoallv_init(comm, sbuf, sc, sd, rbuf, rc, rd,
+                           method=AlltoallvMethod.REMOTE_FIRST)
+    for _ in range(2):
+        h.start()
+        h.wait()
+    snap = api.metrics_snapshot()
+    assert snap["enabled"], snap["mode"]
+    assert any(s["span"] == "coll.round" for s in snap["stragglers"]), \
+        snap["stragglers"]
+
+    out = api.trace_dump_fleet(dump_dir)
+    assert os.path.exists(out), out
+    own = os.path.join(dump_dir, f"tempi-trace-r{pid}.json")
+    assert os.path.exists(own), own
+    print(f"FLEET-CHILD-OK {pid} {out}", flush=True)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,629 @@
+"""Fleet-observability suite (ISSUE 15).
+
+Covers the three new obs layers and their satellites: loud TEMPI_METRICS
+parsing and the off-path zero-cost pins, histogram bucket geometry and
+the fixed-memory key bound, round-window straggler attribution (unit
+and seeded-slow-rank integration over a REAL persistent-collective
+replay), persistent-step critical paths, the clock-offset alignment
+property of the fleet merge (two synthetic dumps with known skew merge
+to a consistent timeline), the merge CLI, rank-stamped dump naming, the
+unified decision timeline's causal ordering across a breaker-open ->
+invalidation-bump -> recompile story, the trace summary's
+skew/straggler columns with their --json form, and the bench-JSON
+--compare regression diff. The 2-process end-to-end (real
+jax.distributed world, real clock exchange, real merged artifact) rides
+tests/_fleet_child.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.obs import export, fleet, metrics, timeline, trace
+from tempi_tpu.parallel import p2p
+from tempi_tpu.runtime import health
+from tempi_tpu.utils import env as envmod
+from tempi_tpu.utils.env import AlltoallvMethod
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+@pytest.fixture()
+def metrics_world(monkeypatch):
+    monkeypatch.setenv("TEMPI_METRICS", "on")
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def _ring_case(comm):
+    """A one-neighbor-each alltoallv: every rank sends 64 B to rank+1."""
+    n = comm.size
+    sc = np.zeros((n, n), np.int64)
+    for a in range(n):
+        sc[a, (a + 1) % n] = 64
+    sbuf = comm.buffer_from_host(
+        [np.full(512, r + 1, np.uint8) for r in range(n)])
+    rbuf = comm.alloc(512)
+    return sbuf, rbuf, sc, sc.T.copy(), np.zeros_like(sc), np.zeros_like(sc)
+
+
+# -- knob parsing (loud, like every observability knob) -----------------------
+
+
+def test_metrics_knob_rejects_unknown_mode(monkeypatch):
+    monkeypatch.setenv("TEMPI_METRICS", "maybe")
+    with pytest.raises(ValueError, match="TEMPI_METRICS"):
+        envmod.read_environment()
+
+
+def test_metrics_knob_parses(monkeypatch):
+    monkeypatch.setenv("TEMPI_METRICS", "ON")  # case-insensitive
+    assert envmod.read_environment().metrics_mode == "on"
+
+
+def test_tempi_disable_forces_metrics_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    monkeypatch.setenv("TEMPI_METRICS", "on")
+    assert envmod.read_environment().metrics_mode == "off"
+
+
+def test_configure_rejects_bad_explicit_mode():
+    with pytest.raises(metrics.MetricsConfigError):
+        metrics.configure("verbose")
+
+
+# -- off-path pins (the zero-cost contract) -----------------------------------
+
+
+def test_metrics_off_allocates_nothing(world):
+    """With TEMPI_METRICS unset (the default) an exchange arms no
+    histogram, opens no window, installs no span hook, and leaves the
+    flight recorder byte-for-byte in its off state."""
+    assert not metrics.ENABLED
+    assert not trace.ENABLED and trace.SPAN_HOOK is None
+    from test_faults import _post_pair
+    reqs, rbuf, row, dst = _post_pair(world)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    assert metrics._hist == {} and metrics._windows == {}
+    assert trace._rings == []  # no ring allocated through the new paths
+    snap = metrics.snapshot()
+    assert snap["histograms"] == [] and snap["stragglers"] == []
+    assert snap["open_windows"] == 0
+
+
+def test_metrics_on_without_trace_feeds_histograms(metrics_world):
+    """TEMPI_METRICS=on with TEMPI_TRACE=off: the span hook arms the
+    emit sites, spans land in histograms, and the RINGS stay off — no
+    ring allocated, snapshot empty."""
+    comm = metrics_world
+    assert metrics.ENABLED and trace.ENABLED and not trace.RECORDING
+    from test_faults import _post_pair
+    reqs, rbuf, row, dst = _post_pair(comm)
+    p2p.waitall(reqs)
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    assert trace._rings == [] and trace.snapshot() == []
+    spans = {h["span"] for h in metrics.snapshot()["histograms"]}
+    assert "p2p.dispatch" in spans or "p2p.drain" in spans
+
+
+# -- histogram geometry + fixed memory ----------------------------------------
+
+
+def test_bucket_edges_are_log2_microseconds():
+    edges = metrics.bucket_edges_us()
+    assert len(edges) == metrics.NUM_BUCKETS
+    assert edges[0] == 2.0 and edges[1] == 4.0
+    assert edges[-1] == float("inf")
+    # index property: a duration lands in the bucket whose range holds it
+    for us, want in ((0.5, 0), (1.0, 0), (3.0, 1), (1000.0, 9),
+                     (1e9, metrics.NUM_BUCKETS - 1)):
+        i = metrics.bucket_index(us * 1e-6)
+        assert i == want, (us, i, want)
+        if i < metrics.NUM_BUCKETS - 1:
+            lo = 0.0 if i == 0 else edges[i - 1]
+            assert lo <= us < edges[i]
+
+
+def test_histogram_key_space_is_bounded():
+    metrics.configure("on")
+    try:
+        for i in range(metrics.MAX_KEYS + 40):
+            metrics._observe_span(f"synthetic.span{i}", 1e-4, None)
+        snap = metrics.snapshot()
+        assert len(snap["histograms"]) <= metrics.MAX_KEYS
+        assert snap["dropped_keys"] >= 41  # overflow row included in bound
+        other = [h for h in snap["histograms"] if h["span"] == "(other)"]
+        assert other and other[0]["count"] >= 41
+        # total observations are never silently lost to the bound
+        assert sum(h["count"] for h in snap["histograms"]) \
+            == metrics.MAX_KEYS + 40
+    finally:
+        metrics.configure("off")
+
+
+def test_histogram_counts_and_sum():
+    metrics.configure("on")
+    try:
+        for dur in (1e-6, 3e-6, 1e-3, 2.0):
+            metrics._observe_span("synthetic.span", dur,
+                                  dict(strategy="s", tier="ici"))
+        (h,) = metrics.snapshot()["histograms"]
+        assert (h["span"], h["strategy"], h["tier"]) \
+            == ("synthetic.span", "s", "ici")
+        assert h["count"] == 4 and abs(h["sum_s"] - 2.001004) < 1e-9
+        assert sum(h["buckets"]) == 4
+        assert h["min_s"] == 1e-6 and h["max_s"] == 2.0
+        rep = metrics.report()
+        assert 'tempi_span_seconds_count{span="synthetic.span"' in rep
+    finally:
+        metrics.configure("off")
+
+
+# -- straggler attribution ----------------------------------------------------
+
+
+def test_round_window_attributes_seeded_slow_rank_unit():
+    metrics.configure("on")
+    try:
+        metrics.round_begin(7, "coll.round", "isir_staged")
+        t = 100.0
+        metrics.note_arrivals(7, list(range(8)), t)
+        metrics.note_arrivals(7, [5], t + 0.2)  # rank 5 arrives late
+        rec = metrics.round_end(7, "coll.round")
+        assert rec["slow_rank"] == 5
+        assert abs(rec["skew_us"] - 0.2e6) < 1.0
+        (s,) = metrics.snapshot()["stragglers"]
+        assert s["slowest_rank"] == 5 and s["slowest_counts"] == {5: 1}
+        assert s["ranks"] == 8 and abs(s["last_skew_s"] - 0.2) < 1e-9
+    finally:
+        metrics.configure("off")
+
+
+def test_zero_spread_round_names_no_straggler():
+    """A replay fast path stamps every destination with one batch
+    timestamp: zero spread has NO straggler, and the arbitrary
+    dict-order winner must not pollute the modal slowest-rank stats."""
+    metrics.configure("on")
+    try:
+        metrics.round_begin(9, "coll.round", "device_fused")
+        metrics.note_arrivals(9, [0, 1, 2], 50.0)
+        rec = metrics.round_end(9, "coll.round")
+        assert rec["slow_rank"] is None and rec["skew_us"] == 0.0
+        (s,) = metrics.snapshot()["stragglers"]
+        assert s["slowest_rank"] is None and s["slowest_counts"] == {}
+    finally:
+        metrics.configure("off")
+
+
+def test_round_windows_nest_and_discard_stale():
+    """A collective inside a step stacks its window above the step's;
+    arrivals stamp both; a stale inner window (failed replay that never
+    reached wait) is discarded when the outer closes."""
+    metrics.configure("on")
+    try:
+        metrics.round_begin(3, "step.replay", "fused")
+        metrics.round_begin(3, "coll.round", "device_fused")
+        metrics.note_arrivals(3, [0, 1], 10.0)
+        rec = metrics.round_end(3, "coll.round")
+        assert rec["ranks"] == 2
+        metrics.round_begin(3, "coll.round", "device_fused")  # no end: stale
+        rec = metrics.round_end(3, "step.replay")
+        assert rec["ranks"] == 2  # the step window kept its own stamps
+        assert metrics.snapshot()["open_windows"] == 0
+        assert metrics.round_end(3, "coll.round") is None
+    finally:
+        metrics.configure("off")
+
+
+def test_seeded_slow_rank_in_real_persistent_replay(metrics_world,
+                                                    monkeypatch):
+    """Acceptance: a seeded slow rank in a persistent collective replay
+    shows up as that rank's id in metrics_snapshot() straggler
+    attribution. The seed rides the real arrival seam (the p2p
+    completion path calls it), delaying rank 5's stamps only."""
+    comm = metrics_world
+    sbuf, rbuf, sc, rc, sd, rd = _ring_case(comm)
+    h = api.alltoallv_init(comm, sbuf, sc, sd, rbuf, rc, rd,
+                           method=AlltoallvMethod.REMOTE_FIRST)
+    orig = metrics.note_arrivals
+
+    def seeded(uid, ranks, t):
+        for r in ranks:
+            orig(uid, [r], t + (0.25 if r == 5 else 0.0))
+
+    monkeypatch.setattr(metrics, "note_arrivals", seeded)
+    for _ in range(3):
+        h.start()
+        h.wait()
+    monkeypatch.undo()
+    strag = [s for s in api.metrics_snapshot()["stragglers"]
+             if s["span"] == "coll.round"]
+    (s,) = strag
+    assert s["slowest_rank"] == 5, s
+    assert s["slowest_counts"].get(5) == 3
+    assert s["last_skew_s"] >= 0.2
+    assert s["ranks"] == comm.size  # every destination stamped
+    rep = api.metrics_report()
+    assert 'tempi_round_slowest_rank{span="coll.round"' in rep
+
+
+def test_step_replay_critical_path(metrics_world):
+    comm = metrics_world
+    sbuf, rbuf, sc, rc, sd, rd = _ring_case(comm)
+    with api.capture_step(comm) as rec:
+        h = api.alltoallv_init(comm, sbuf, sc, sd, rbuf, rc, rd)
+        h.start()
+        h.wait()
+    step = rec.compile()
+    step.start()
+    step.wait()
+    step.start()
+    step.wait()
+    steps = api.metrics_snapshot()["steps"]
+    st = steps[comm.uid]
+    assert st["replays"] == 2
+    assert 0.0 < st["last_critical_path_s"] <= st["max_critical_path_s"]
+    assert st["chain"], "critical-path chain empty"
+    assert sum(c["dur_s"] for c in st["chain"]) \
+        == pytest.approx(st["last_critical_path_s"])
+    step.free()
+    h.free()
+
+
+# -- clock-offset alignment property ------------------------------------------
+
+
+def _doc(rank, t0, offset_s, events):
+    return export.to_chrome(
+        events, metadata=dict(process=dict(
+            rank=rank, t0=t0, clock=dict(offset_s=offset_s,
+                                         uncertainty_s=0.001))))
+
+
+def test_merge_aligns_known_skew(tmp_path):
+    """Two synthetic dumps with a known clock skew merge to a consistent
+    timeline: global time = t0 + ts + offset, so an event interleaved
+    between two of the other rank's lands between them after the merge
+    (and would NOT without the offset)."""
+    d0 = _doc(0, 100.0, 0.0,
+              [dict(ts=0.010, name="A", tid=1, thread="main"),
+               dict(ts=0.030, name="B", tid=1, thread="main")])
+    d1 = _doc(1, 90.0, 10.005,
+              [dict(ts=0.020, name="C", tid=1, thread="main")])
+    merged = fleet.merge_docs([d0, d1])
+    data = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert [e["name"] for e in data] == ["A", "C", "B"]
+    # rebased at the earliest event: A=0, C=15ms, B=20ms (microseconds)
+    assert data[0]["ts"] == pytest.approx(0.0, abs=1.0)
+    assert data[1]["ts"] == pytest.approx(15000.0, abs=1.0)
+    assert data[2]["ts"] == pytest.approx(20000.0, abs=1.0)
+    # one pid block per process, rank-prefixed lane names
+    lanes = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any(x.startswith("r0/") for x in lanes)
+    assert any(x.startswith("r1/") for x in lanes)
+    pids = {e["pid"] for e in data}
+    assert pids == {0, fleet.PID_STRIDE}
+    # per-process event ORDER is preserved (a uniform shift cannot swap)
+    r0 = [e["name"] for e in data if e["pid"] == 0]
+    assert r0 == ["A", "B"]
+    # clock provenance rides along
+    procs = merged["otherData"]["processes"]
+    assert [p["rank"] for p in procs] == [0, 1]
+
+
+def test_merge_rejects_duplicate_ranks():
+    d = _doc(0, 0.0, 0.0, [dict(ts=0.0, name="x", tid=1, thread="t")])
+    with pytest.raises(ValueError, match="duplicate"):
+        fleet.merge_docs([d, json.loads(json.dumps(d))])
+
+
+def test_merge_cli_roundtrip(tmp_path):
+    """The offline CLI (python -m tempi_tpu.obs.merge <dir>) merges
+    rank-stamped dumps without importing jax."""
+    for rank, t0, off, evs in (
+            (0, 10.0, 0.0, [dict(ts=0.001, name="e0", tid=1, thread="m",
+                                 dur=0.0005)]),
+            (1, 20.0, -10.0, [dict(ts=0.002, name="e1", tid=1,
+                                   thread="m")])):
+        export.write(str(tmp_path / f"tempi-trace-r{rank}.json"),
+                     evs, metadata=dict(process=dict(
+                         rank=rank, t0=t0, clock=dict(offset_s=off))))
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tempi_tpu.obs.merge", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "merged 2 dump(s)" in r.stdout
+    out = tmp_path / fleet.FLEET_BASENAME
+    with open(out) as f:
+        doc = json.load(f)
+    data = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert {e["pid"] for e in data} == {0, fleet.PID_STRIDE}
+    # aligned: e0 at global 10.001, e1 at global 10.002 -> e0 first
+    assert [e["name"] for e in data] == ["e0", "e1"]
+
+
+def test_merge_dir_requires_dumps(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fleet.merge_dir(str(tmp_path))
+
+
+# -- rank-stamped dump naming (the clobbering satellite) ----------------------
+
+
+def test_dump_names_are_rank_stamped(tmp_path):
+    trace.configure("flight", capacity=64, path=str(tmp_path))
+    try:
+        trace.emit("stamped", rank=0)
+        # no process id known: the historical name
+        assert os.path.basename(trace.dump()) == "tempi-trace.json"
+        trace.set_process(3)
+        assert trace.default_dump_name() == "tempi-trace-r3.json"
+        out = trace.dump()
+        assert os.path.basename(out) == "tempi-trace-r3.json"
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["process"]["rank"] == 3
+        # auto-snapshots get the same stamp
+        snap = trace.failure_snapshot("test-reason", "detail")
+        assert "-r3-test-reason-" in os.path.basename(snap["path"])
+    finally:
+        trace.configure("off")
+
+
+def test_file_path_dump_is_rank_stamped(tmp_path):
+    """A FILE-path TEMPI_TRACE_PATH shared by N processes must not
+    clobber: the rank stamp splices before the extension."""
+    trace.configure("flight", capacity=64,
+                    path=str(tmp_path / "tt.json"))
+    try:
+        trace.emit("stamped", rank=0)
+        trace.set_process(2)
+        out = trace.dump()
+        assert os.path.basename(out) == "tt-r2.json"
+    finally:
+        trace.configure("off")
+
+
+def test_metrics_only_arming_writes_no_empty_snapshots(tmp_path):
+    """TEMPI_METRICS=on with the rings off arms the emit sites
+    (trace.ENABLED), but a WaitTimeout/breaker-open failure snapshot
+    must not write a zero-event JSON — noise is not evidence."""
+    trace.configure("off", path=str(tmp_path))
+    metrics.configure("on")
+    try:
+        assert trace.ENABLED and not trace.RECORDING
+        snap = trace.failure_snapshot("synthetic", "metrics-only")
+        assert snap["path"] == "" and snap["events"] == []
+        assert os.listdir(tmp_path) == []
+        assert trace.failures() == []  # history stays empty too
+    finally:
+        metrics.configure("off")
+        trace.configure("off")
+
+
+def test_single_process_fleet_dump_merges_trivially(world, tmp_path):
+    trace.configure("flight", capacity=64, path=str(tmp_path))
+    try:
+        trace.emit("solo", rank=0)
+        out = api.trace_dump_fleet(str(tmp_path))
+        assert os.path.basename(out) == fleet.FLEET_BASENAME
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["merged_from"] == 1
+    finally:
+        trace.configure("off")
+
+
+# -- the unified decision timeline --------------------------------------------
+
+
+def test_explain_orders_breaker_bump_recompile_story(world, monkeypatch):
+    """Acceptance: api.explain() tells the breaker-open ->
+    invalidation-bump -> recompile story in causal order, generation-
+    stamped — one call instead of seven snapshot diffs."""
+    from tempi_tpu.coll.persistent import _UNDERLYING
+    comm = world
+    sbuf, rbuf, sc, rc, sd, rd = _ring_case(comm)
+    h = api.alltoallv_init(comm, sbuf, sc, sd, rbuf, rc, rd)
+    before = h.method  # AUTO-chosen (sheet-dependent); we only need it
+    # to CHANGE once its transport's breakers open on every link
+    for lk in h.links:
+        for _ in range(int(envmod.env.breaker_threshold)):
+            health.record_failure(lk, _UNDERLYING[before],
+                                  error="seeded for explain()")
+    h.start()  # generation moved -> revalidate -> recompile off `before`
+    h.wait()
+    assert h.method != before
+    evs = api.explain()["events"]
+    kinds = [e["kind"] for e in evs]
+    i_open = kinds.index("breaker.open")
+    i_bump = next(i for i, e in enumerate(evs)
+                  if e["kind"] == "invalidation.bump"
+                  and e.get("cause") == "breaker")
+    i_rec = kinds.index("coll.recompile")
+    assert i_open < i_bump < i_rec
+    # generation stamps link cause to effect: the open predates its
+    # bump's generation; the recompile observed it
+    assert evs[i_open]["generation"] < evs[i_bump]["generation"]
+    assert evs[i_rec]["generation"] >= evs[i_bump]["generation"]
+    # causal order: seq strictly increases, at_monotonic never runs back
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    ts = [e["at_monotonic"] for e in evs]
+    assert all(b >= a for a, b in zip(ts, ts[1:]))
+
+
+def test_explain_reads_empty_after_finalize():
+    ex = api.explain()
+    assert ex["events"] == [] and ex["kept"] == 0
+
+
+def test_timeline_bound_holds():
+    timeline.reset()
+    try:
+        for i in range(timeline.KEEP + 50):
+            timeline.record("synthetic.decision", i=i)
+        ex = api.explain()
+        assert ex["kept"] == timeline.KEEP
+        assert ex["total"] == timeline.KEEP + 50
+        # the newest records survive, oldest-first
+        assert ex["events"][-1]["i"] == timeline.KEEP + 49
+        assert api.explain(limit=5)["events"][-1]["i"] \
+            == timeline.KEEP + 49
+        assert len(api.explain(limit=5)["events"]) == 5
+    finally:
+        timeline.reset()
+
+
+# -- trace summary skew columns + --json + --compare --------------------------
+
+
+def test_trace_summary_grows_skew_columns(metrics_world, tmp_path):
+    comm = metrics_world
+    trace.configure("flight", capacity=4096)
+    try:
+        sbuf, rbuf, sc, rc, sd, rd = _ring_case(comm)
+        h = api.alltoallv_init(comm, sbuf, sc, sd, rbuf, rc, rd,
+                               method=AlltoallvMethod.REMOTE_FIRST)
+        h.start()
+        h.wait()
+        path = str(tmp_path / "dump.json")
+        api.trace_dump(path)
+    finally:
+        trace.configure("off")
+    with open(path) as f:
+        doc = json.load(f)
+    rows = [r for r in export.summarize(doc) if r["name"] == "coll.round"]
+    assert rows and "max_skew_us" in rows[0]
+    assert rows[0]["max_skew_us"] >= 0.0
+    # and the --json report emits the machine-diffable form
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benches", "perf_report.py"),
+         "--trace", path, "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr + r.stdout
+    parsed = json.loads(r.stdout)
+    jrows = [x for x in parsed["rows"] if x["name"] == "coll.round"]
+    assert jrows and "max_skew_us" in jrows[0]
+
+
+def test_perf_report_compare_flags_drift(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(dict(
+        parsed=dict(pack_gbs=100.0, pingpong_us=50.0, steady=1.0,
+                    last_tpu=dict(halo_iters=1000.0)))))
+    b.write_text(json.dumps(dict(
+        parsed=dict(pack_gbs=50.0, pingpong_us=51.0, steady=1.0,
+                    last_tpu=dict(halo_iters=1001.0)))))
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable,
+           os.path.join(_REPO, "benches", "perf_report.py"),
+           "--compare", str(a), str(b)]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr  # drift -> loud exit
+    assert "DRIFT" in r.stdout and "pack_gbs" in r.stdout
+    assert "last_tpu.halo_iters" in r.stdout  # nested keys flatten
+    # a generous threshold sees the same diff quietly
+    r2 = subprocess.run(cmd + ["--threshold", "75"], capture_output=True,
+                        text=True, env=env, timeout=120)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "DRIFT" not in r2.stdout
+
+
+# -- the 2-process end-to-end (acceptance) ------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fleet_dump_and_merge(tmp_path):
+    """Acceptance: a 2-process CPU run produces per-rank dumps that the
+    merge aligns into one Chrome/Perfetto JSON with both pid lanes and
+    monotonically consistent cross-rank span ordering."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("TEMPI_")}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_fleet_child.py")
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(i), "2", coord, str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=210)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("fleet children timed out (distributed init or "
+                    "clock/dump barrier hang)")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        tail = "\n".join(out.splitlines()[-15:])
+        assert p.returncode == 0, f"child {i} failed:\n{tail}"
+        assert f"FLEET-CHILD-OK {i}" in out, f"child {i} incomplete:\n{tail}"
+    # per-rank dumps exist and the coordinator merged them
+    for i in range(2):
+        assert (tmp_path / f"tempi-trace-r{i}.json").exists()
+    merged = tmp_path / fleet.FLEET_BASENAME
+    assert merged.exists()
+    with open(merged) as f:
+        doc = json.load(f)
+    data = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    pids = {e["pid"] // fleet.PID_STRIDE for e in data}
+    assert pids == {0, 1}, pids  # both processes' lanes present
+    # monotonically consistent: the merged stream is globally time-
+    # sorted AND each rank's own span order survived the shift
+    ts = [float(e["ts"]) for e in data]
+    assert ts == sorted(ts)
+    for rank in (0, 1):
+        with open(tmp_path / f"tempi-trace-r{rank}.json") as f:
+            own = json.load(f)
+        own_names = [e["name"] for e in own["traceEvents"]
+                     if e.get("ph") == "X"]
+        merged_names = [e["name"] for e in data
+                        if e.get("ph") == "X"
+                        and e["pid"] // fleet.PID_STRIDE == rank]
+        assert merged_names == own_names
+    # clock provenance for both ranks (same host: offsets near zero,
+    # coordinator exactly zero)
+    procs_meta = doc["otherData"]["processes"]
+    assert [p["rank"] for p in procs_meta] == [0, 1]
+    assert procs_meta[0]["clock"]["offset_s"] == 0.0
+    assert abs(procs_meta[1]["clock"]["offset_s"]) < 5.0
+    # and the offline CLI reproduces the merge from the same directory
+    env2 = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tempi_tpu.obs.merge", str(tmp_path),
+         "-o", str(tmp_path / "cli-merged.json")],
+        capture_output=True, text=True, env=env2, timeout=60)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "merged 2 dump(s)" in r.stdout
