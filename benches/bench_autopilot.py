@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""SLO-autopilot chaos soak (ISSUE 16; runtime/autopilot.py).
+
+Three seeded degradation scenarios — a persistent straggler, a
+bulk-class flood, and a kill/rejoin churn cycle — each driven through
+THREE sessions in one process with identical seeds and an identical
+logical clock: ``observe`` (the policy decides but touches nothing),
+``act`` (the same decisions reach the real actuators and the world
+heals), and ``off`` (the control loop must be inert). The acceptance
+claim of the issue, made executable:
+
+* under ``act`` the measured tail metrics PASS the declared SLO, via
+  the same ``parse_slo``/``check_slo`` code path ``perf_report --slo``
+  uses in CI — one SLO gate, not two;
+* under ``observe`` the same seed provably would NOT have held the SLO
+  (check_slo reports violations), and the decision ledger records the
+  exact missed interventions (``acted=False, outcome="observed"``);
+* under ``off`` the workload runs byte-for-byte untouched: zero
+  decisions, every ``counters.autopilot`` counter pinned at zero, no
+  pinned breaker, the QoS weights never move.
+
+The straggler and flood scenarios synthesize their signals through the
+observatory's public surfaces (``metrics.round_begin/note_arrivals/
+round_end``, ``trace.emit_span``) so the skew and p99 inputs are
+exactly reproducible; the churn scenario goes through the REAL
+actuators end to end (``api.mark_failed`` -> autopilot shrink ->
+``api.announce_join`` -> autopilot grow, adopted via
+``api.autopilot_successor``).
+
+    python benches/bench_autopilot.py --cpu --quick
+"""
+
+import os
+import sys
+import time
+
+from _common import base_parser, devices_or_die, emit_csv, setup_platform
+from perf_report import check_slo, parse_slo
+
+#: env every session shares; per-scenario/per-mode deltas layer on top.
+_BASE_ENV = {
+    "TEMPI_METRICS": "on",
+    "TEMPI_AUTOPILOT_CONFIRM": "2/3",
+    "TEMPI_AUTOPILOT_COOLDOWN_S": "5",
+    "TEMPI_SLO_SKEW_MS": "2",
+    "TEMPI_SLO_P99_MS": "5",
+}
+
+
+def _session(mode, extra_env, drive):
+    """One init/drive/finalize cycle under ``mode`` (None = knob unset,
+    the off path). Restores every knob it touched so sessions cannot
+    contaminate each other."""
+    from tempi_tpu import api
+
+    touched = dict(_BASE_ENV)
+    touched.update(extra_env or {})
+    if mode is None:
+        touched.pop("TEMPI_AUTOPILOT", None)
+        os.environ.pop("TEMPI_AUTOPILOT", None)
+    else:
+        touched["TEMPI_AUTOPILOT"] = mode
+    saved = {k: os.environ.get(k) for k in touched}
+    os.environ.update(touched)
+    try:
+        comm = api.init()
+        try:
+            return drive(api, comm)
+        finally:
+            api.finalize()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _skewed_round(comm, slow_rank, skew_s, t0):
+    from tempi_tpu.obs import metrics as obsmetrics
+
+    obsmetrics.round_begin(comm.uid, "coll.round", "soak")
+    others = [r for r in range(comm.size) if r != slow_rank]
+    obsmetrics.note_arrivals(comm.uid, others, t0)
+    obsmetrics.note_arrivals(comm.uid, [slow_rank], t0 + skew_s)
+    obsmetrics.round_end(comm.uid, "coll.round")
+
+
+def _autopilot_counters(api):
+    return dict(api.counters_snapshot()["autopilot"])
+
+
+def _tail(vals, frac=0.5):
+    n = max(1, int(len(vals) * frac))
+    return vals[-n:]
+
+
+# -- scenario drivers ----------------------------------------------------------
+#
+# Each returns a dict: measured (flat name->value for check_slo),
+# decisions (the session ledger), counters, plus scenario-specific
+# world-state facts the verdict checks.
+
+
+def drive_straggler(windows, seed, victim):
+    """The same rank arrives late every round and every step replay runs
+    slow — until (act mode only) the autopilot's quarantine decision
+    lands, after which the fleet "re-places around it" and the synthetic
+    signals recover. Seeded jitter keeps the script deterministic."""
+    import random
+
+    def drive(api, comm):
+        from tempi_tpu.obs import trace as obstrace
+
+        rng = random.Random(seed)
+        healed = False
+        skews, lats = [], []
+        for w in range(windows):
+            skew_s = (0.0004 if healed else 0.005) * (1 + 0.1 * rng.random())
+            lat_s = (0.0010 if healed else 0.008) * (1 + 0.1 * rng.random())
+            _skewed_round(comm, victim, skew_s, t0=1000.0 + w)
+            obstrace.emit_span("step.replay", time.monotonic() - lat_s)
+            for dec in api.autopilot_step(comm, now=float(w)):
+                if dec["acted"] and dec["action"] == "quarantine":
+                    healed = True
+            skews.append(skew_s * 1e3)
+            lats.append(lat_s * 1e3)
+        pinned = [b for b in api.health_snapshot()["breakers"]
+                  if b.get("pinned") and b.get("last_error") == "autopilot"]
+        return dict(
+            measured={"soak.skew_ms": max(_tail(skews)),
+                      "soak.p99_step_ms": max(_tail(lats))},
+            decisions=api.autopilot_snapshot()["decisions"],
+            counters=_autopilot_counters(api),
+            pinned_breakers=len(pinned),
+        )
+
+    return drive
+
+
+def drive_flood(windows, seed):
+    """A bulk tenant floods the scheduler every window; the flood drains
+    only after the flood-profile weight flip (act mode), so observe
+    rides the whole soak at flood latency. The restore decision must
+    put the ORIGINAL weights back once the pressure clears."""
+    import random
+
+    def drive(api, comm):
+        from tempi_tpu.runtime import qos
+        from tempi_tpu.utils import env as envmod
+
+        rng = random.Random(seed)
+        original = dict(envmod.env.qos_weights)
+        flipped = False
+        lats = []
+        for w in range(windows):
+            flooding = not flipped
+            if flooding:
+                for _ in range(4):
+                    qos.count_backpressure("bulk")
+            lat_s = (0.010 if flooding else 0.0015) * (
+                1 + 0.1 * rng.random())
+            for dec in api.autopilot_step(comm, now=float(w)):
+                if dec["acted"] and dec["action"] == "qos_flood":
+                    flipped = True
+            lats.append(lat_s * 1e3)
+        return dict(
+            measured={"soak.p99_step_ms": max(_tail(lats))},
+            decisions=api.autopilot_snapshot()["decisions"],
+            counters=_autopilot_counters(api),
+            weights_flipped=flipped,  # the actuator ran mid-soak...
+            weights_restored=dict(envmod.env.qos_weights) == original,
+        )  # ...and the restore put the originals back by the end
+
+    return drive
+
+
+def drive_churn(windows):
+    """One rank dies for real (FT verdict via ``api.mark_failed``); the
+    autopilot shrinks, the replacement device announces itself, and —
+    after the SHARED resize cooldown — the autopilot grows back to full
+    size. The app adopts each successor at the epoch boundary. No
+    synthetic signals: these are the real actuators end to end."""
+
+    def drive(api, comm):
+        full = comm.size
+        victim = full - 1
+        victim_dev = comm.devices[comm.library_rank(victim)]
+        api.mark_failed(comm, victim)
+        announced = False
+        cur = comm
+        dead_counts = []
+        for w in range(windows):
+            for dec in api.autopilot_step(cur, now=float(w)):
+                if dec["acted"] and dec["action"] in ("shrink", "grow"):
+                    nxt = api.autopilot_successor(cur)
+                    if nxt is not None:
+                        cur = nxt
+                    if dec["action"] == "shrink" and not announced:
+                        api.announce_join(cur, [victim_dev])
+                        announced = True
+            dead_counts.append(float(len(cur.dead_ranks)))
+        return dict(
+            measured={"soak.dead_ranks": max(_tail(dead_counts))},
+            decisions=api.autopilot_snapshot()["decisions"],
+            counters=_autopilot_counters(api),
+            final_size=cur.size,
+            full_size=full,
+        )
+
+    return drive
+
+
+# -- verdicts ------------------------------------------------------------------
+
+
+def _slo_ok(slo_spec, measured):
+    return not check_slo(parse_slo(slo_spec), measured)
+
+
+def _fail(msg):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return False
+
+
+def verdict(name, slo_spec, act, obs, off, expect_act, expect_observe):
+    """The acceptance contract for one scenario: act holds the SLO,
+    observe provably would not and logged the missed interventions,
+    off stayed inert. ``expect_observe`` is the initial-intervention
+    subset of ``expect_act``: in observe mode the world never heals, so
+    follow-ups gated on recovery (restore after the flood drains, grow
+    after the shrink lands) legitimately never confirm."""
+    ok = True
+    if not _slo_ok(slo_spec, act["measured"]):
+        ok = _fail(f"{name}: act mode violated the SLO "
+                   f"({slo_spec} vs {act['measured']})")
+    if _slo_ok(slo_spec, obs["measured"]):
+        ok = _fail(f"{name}: observe mode unexpectedly held the SLO — "
+                   "the chaos is not biting")
+    missed = [d["action"] for d in obs["decisions"]]
+    for want in expect_observe:
+        if want not in missed:
+            ok = _fail(f"{name}: observe ledger is missing the would-have "
+                       f"{want!r} intervention (got {missed})")
+    if any(d["acted"] or d["outcome"] != "observed"
+           for d in obs["decisions"]):
+        ok = _fail(f"{name}: observe mode actuated something")
+    acted = [d["action"] for d in act["decisions"] if d["acted"]]
+    for want in expect_act:
+        if want not in acted:
+            ok = _fail(f"{name}: act mode never executed {want!r} "
+                       f"(got {acted})")
+    if off["decisions"]:
+        ok = _fail(f"{name}: off mode issued decisions")
+    if any(off["counters"].values()):
+        ok = _fail(f"{name}: off mode moved autopilot counters "
+                   f"({off['counters']})")
+    return ok
+
+
+def main() -> int:
+    p = base_parser("SLO-autopilot chaos soak: observe/act/off on "
+                    "identical seeds", multirank=True)
+    p.add_argument("--windows", type=int, default=40,
+                   help="evaluation windows per session")
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args()
+    if args.quick:
+        args.windows = 20
+    setup_platform(args)
+    devices_or_die(min_devices=4)
+
+    scenarios = [
+        ("straggler", "skew_ms=2,p99_step_ms=5",
+         drive_straggler(args.windows, args.seed, victim=2), {},
+         ["quarantine"], ["quarantine"]),
+        ("flood", "p99_step_ms=5",
+         drive_flood(args.windows, args.seed),
+         {"TEMPI_QOS_DEFAULT": "latency"},
+         ["qos_flood", "qos_restore"], ["qos_flood"]),
+        ("churn", "dead_ranks=0.5",
+         drive_churn(args.windows),
+         {"TEMPI_FT": "shrink", "TEMPI_ELASTIC": "grow"},
+         ["shrink", "grow"], ["shrink"]),
+    ]
+
+    rows = []
+    all_ok = True
+    for name, slo_spec, drive, extra, exp_act, exp_obs in scenarios:
+        runs = {}
+        for mode in ("observe", "act", None):
+            runs["off" if mode is None else mode] = _session(
+                mode, extra, drive)
+        act, obs, off = runs["act"], runs["observe"], runs["off"]
+        ok = verdict(name, slo_spec, act, obs, off, exp_act, exp_obs)
+        # scenario-specific world-state facts
+        if name == "straggler":
+            if not act.get("pinned_breakers"):
+                ok = _fail("straggler: act mode pinned no breakers")
+            if obs.get("pinned_breakers") or off.get("pinned_breakers"):
+                ok = _fail("straggler: observe/off mode pinned breakers")
+        if name == "flood":
+            if not (act["weights_flipped"] and act["weights_restored"]):
+                ok = _fail("flood: act mode did not flip-then-restore "
+                           "the weights")
+            if obs["weights_flipped"] or off["weights_flipped"]:
+                ok = _fail("flood: observe/off mode moved the weights")
+        if name == "churn":
+            if act["final_size"] != act["full_size"]:
+                ok = _fail(f"churn: act mode ended at size "
+                           f"{act['final_size']} != {act['full_size']}")
+        all_ok = all_ok and ok
+        for mode in ("act", "observe", "off"):
+            r = runs[mode]
+            m = r["measured"]
+            rows.append([
+                name, mode, args.windows, len(r["decisions"]),
+                sum(1 for d in r["decisions"] if d.get("acted")),
+                ";".join(f"{k.split('.')[-1]}={v:.3g}"
+                         for k, v in sorted(m.items())),
+                slo_spec.replace(",", ";"),
+                int(_slo_ok(slo_spec, m)),
+            ])
+
+    emit_csv(["scenario", "mode", "windows", "decisions", "acted",
+              "measured", "slo", "slo_ok"], rows)
+    print("SOAK " + ("PASS" if all_ok else "FAIL"), file=sys.stderr)
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

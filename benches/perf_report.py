@@ -20,11 +20,17 @@ Usage: python benches/perf_report.py [path-to-sheet.json]
        ISSUE 15)
 
        python benches/perf_report.py --compare A.json B.json [--threshold PCT]
+                                     [--slo p99_step_ms=5,skew_ms=2]
        (ISSUE 15: per-key regression diff between two bench JSONs —
        delta and % change per numeric key, loud DRIFT flags past the
        threshold (default 10%), exit 1 when anything drifted — so the
        BENCH_r*.json trajectory diffs mechanically in CI instead of by
-       eye)
+       eye. ISSUE 16: --slo declares upper bounds checked against the
+       NEW file's keys — a bound named N checks every flattened key
+       whose last dotted segment is N; any violation (or a bound that
+       matched no key) prints loudly and exits 1. parse_slo/check_slo
+       are importable: the autopilot bench and CI share this one
+       SLO-checking code path)
 
        python benches/perf_report.py --tune [path-to-tune.json]
        (ISSUE 4: summarize the learned online-tuning state — per-(link,
@@ -178,7 +184,62 @@ def _flatten_numeric(doc, prefix: str = "", out=None) -> dict:
     return out
 
 
-def compare_report(a_path: str, b_path: str, threshold: float) -> int:
+def parse_slo(spec: str) -> dict:
+    """Parse an ``--slo`` spec — ``"p99_step_ms=5,skew_ms=2"`` — into
+    ``{name: bound}``. Loud on anything malformed (an SLO that silently
+    parsed to nothing would vacuously pass CI): every entry must be
+    ``name=number`` with a positive bound."""
+    out = {}
+    for part in (p.strip() for p in spec.split(",")):
+        if not part:
+            continue
+        name, sep, val = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"bad --slo entry {part!r}: want name=value "
+                "(e.g. p99_step_ms=5)")
+        try:
+            bound = float(val)
+        except ValueError as exc:
+            raise ValueError(
+                f"bad --slo bound {part!r}: want a number") from exc
+        if not bound > 0 or math.isinf(bound) or math.isnan(bound):
+            raise ValueError(
+                f"bad --slo bound {part!r}: want a positive finite number")
+        out[name] = bound
+    if not out:
+        raise ValueError(f"empty --slo spec {spec!r}")
+    return out
+
+
+def check_slo(slo: dict, measured: dict) -> list:
+    """The ONE SLO-checking code path CI (``--compare --slo``) and the
+    autopilot bench share. ``measured`` is a flat dict (dotted keys
+    fine — ``_flatten_numeric`` output); a bound named ``N`` checks
+    every key equal to ``N`` or ending in ``.N``, upper-bound
+    semantics (value must be <= bound). Returns violation strings,
+    empty when the SLO holds. A bound that matches NO key is itself a
+    violation — an SLO nobody measured must not pass silently."""
+    violations = []
+    for name in sorted(slo):
+        bound = slo[name]
+        keys = [k for k in measured
+                if k == name or str(k).endswith("." + name)]
+        if not keys:
+            violations.append(
+                f"SLO {name}<={bound:g}: no measured key matches")
+            continue
+        for k in sorted(keys):
+            v = measured[k]
+            if v > bound:
+                violations.append(
+                    f"SLO {name}<={bound:g} VIOLATED: {k}={v:g}")
+    return violations
+
+
+def compare_report(a_path: str, b_path: str, threshold: float,
+                   slo: dict = None) -> int:
     """Per-key regression diff of two bench JSONs (ISSUE 15): old, new,
     delta, % change; keys whose |% change| crosses ``threshold`` get a
     loud DRIFT flag and the exit code turns 1 — the mechanical form of
@@ -214,7 +275,12 @@ def compare_report(a_path: str, b_path: str, threshold: float) -> int:
     print(f"{len(common)} shared key(s): {same} unchanged, "
           f"{len(common) - same} changed, {drifted} past the "
           f"{threshold * 100:.3g}% threshold")
-    return 1 if drifted else 0
+    violations = check_slo(slo, B) if slo else []
+    for v in violations:
+        print(v)
+    if slo and not violations:
+        print(f"SLO held: {','.join(f'{k}<={v:g}' for k, v in sorted(slo.items()))}")
+    return 1 if (drifted or violations) else 0
 
 
 def main() -> int:
@@ -245,11 +311,26 @@ def main() -> int:
                       file=sys.stderr)
                 return 2
             del rest[i: i + 2]
+        slo = None
+        if "--slo" in rest:
+            i = rest.index("--slo")
+            if i + 1 >= len(rest):
+                print("usage: perf_report.py --compare A.json B.json "
+                      "[--threshold PCT] [--slo name=v,name=v]",
+                      file=sys.stderr)
+                return 2
+            try:
+                slo = parse_slo(rest[i + 1])
+            except ValueError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            del rest[i: i + 2]
         if len(rest) != 2:
             print("usage: perf_report.py --compare A.json B.json "
-                  "[--threshold PCT]", file=sys.stderr)
+                  "[--threshold PCT] [--slo name=v,name=v]",
+                  file=sys.stderr)
             return 2
-        return compare_report(rest[0], rest[1], threshold)
+        return compare_report(rest[0], rest[1], threshold, slo=slo)
     if len(sys.argv) > 1 and sys.argv[1] == "--tune":
         if len(sys.argv) > 2:
             tpath = sys.argv[2]

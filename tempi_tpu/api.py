@@ -66,6 +66,10 @@ def init(devices=None) -> Communicator:
     # this clears any prior session's pending joins and join/admit
     # ledger — and bumps the session ordinal scoping admission keys, so
     # a stale session's join can never be replayed into this one)
+    from .runtime import autopilot
+    autopilot.configure()  # arm TEMPI_AUTOPILOT (knobs loud-parsed
+    # above; AFTER every actuator subsystem it steers — and this clears
+    # any prior session's decision ledger and hysteresis state)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -232,6 +236,10 @@ def finalize() -> None:
         elastic.configure()  # pending joins and the join/admit ledger
         # are per-session too (a joiner must re-announce into the new
         # session's scoped keys)
+        from .runtime import autopilot
+        autopilot.configure()  # the decision ledger and hysteresis
+        # state are per-session too — a new session's fleet starts with
+        # no confirmation streaks and no cooldowns in flight
         _world = None
 
 
@@ -386,6 +394,61 @@ def elastic_snapshot() -> dict:
     return elastic.snapshot()
 
 
+def autopilot_step(comm: Communicator, now: Optional[float] = None) -> list:
+    """One evaluation of the SLO-autopilot control loop (ISSUE 16;
+    runtime/autopilot.py): gather fleet signals (per-interval p99 over
+    the watched replay spans, straggler skew + slowest-rank
+    attribution, FT dead set, pending joiners, bulk backpressure),
+    run the hysteresis policy, and — in ``act`` mode — execute the
+    confirmed decisions against the real actuators. Epoch-boundary
+    call, like :func:`replace_ranks`: the caller guarantees no
+    operations are in flight on ``comm``. Returns the decision records
+    issued by this call (empty in the common healthy case). After a
+    resize decision, adopt the successor communicator via
+    :func:`autopilot_successor`. Inert (one truth test, no
+    counters) with ``TEMPI_AUTOPILOT`` unset/off. ``now`` overrides
+    the policy clock (logical seconds) for deterministic replay."""
+    from .runtime import autopilot
+    return autopilot.step(comm, now=now)
+
+
+def autopilot_successor(comm: Communicator) -> Optional[Communicator]:
+    """The communicator an autopilot resize decision built for ``comm``
+    (shrink's survivor or grow's enlarged world), or ``None``. The app
+    adopts it at the epoch boundary — the autopilot never swaps handles
+    out from under the caller (ISSUE 16)."""
+    from .runtime import autopilot
+    return autopilot.successor(comm)
+
+
+def declare_slo(p99_ms: Optional[float] = None,
+                skew_ms: Optional[float] = None,
+                min_ranks: Optional[int] = None) -> dict:
+    """Declare/override the autopilot's SLO bounds at runtime (ISSUE
+    16). ``None`` keeps the env-parsed value (``TEMPI_SLO_P99_MS``,
+    ``TEMPI_SLO_SKEW_MS``, ``TEMPI_SLO_MIN_RANKS``); 0 clears a bound.
+    Returns the effective SLO dict. Refuses when the autopilot is off
+    — a declared SLO nobody evaluates would be silent wishful
+    configuration."""
+    from .runtime import autopilot
+    return autopilot.declare_slo(p99_ms=p99_ms, skew_ms=skew_ms,
+                                 min_ranks=min_ranks)
+
+
+def autopilot_snapshot() -> dict:
+    """Diagnostic snapshot of the SLO autopilot (ISSUE 16): mode,
+    declared SLO bounds, the bounded decision ledger (every entry with
+    its action, target, mode, ``acted`` flag, outcome, the signals it
+    saw, the SLO violations at decision time, and the shared
+    invalidation generation), last-evaluation violations, and the
+    suppressed-by-cooldown count. In ``observe`` mode the ledger is
+    the record of interventions the autopilot WOULD have made — read
+    it before flipping to ``act``. Pure data — safe to serialize.
+    Callable before init and after finalize (reads empty)."""
+    from .runtime import autopilot
+    return autopilot.snapshot()
+
+
 def ft_snapshot() -> dict:
     """Diagnostic snapshot of the fault-tolerance layer (ISSUE 9): mode
     and knobs, the verdict ledger with per-verdict agreement provenance
@@ -453,11 +516,34 @@ def metrics_snapshot() -> dict:
     """Diagnostic snapshot of the fixed-memory metrics layer (ISSUE 15;
     ``TEMPI_METRICS=on``): per-(span, strategy, tier) log2-bucketed
     latency histograms with their shared bucket edges, per-round
-    arrival-spread straggler attribution (skew = max−median arrival,
-    slowest-rank id and per-rank slowest counts), and persistent-step
-    critical paths (the longest chain of dependent spans per replay).
-    Pure data — safe to serialize. Callable before init and after
-    finalize (reads empty)."""
+    arrival-spread straggler attribution, and persistent-step critical
+    paths (the longest chain of dependent spans per replay). Pure data
+    — safe to serialize. Callable before init and after finalize
+    (reads empty).
+
+    Stable schema (ISSUE 16 satellite — consumers, the SLO autopilot
+    included, read THESE keys rather than parsing the Prometheus text
+    from :func:`metrics_report`):
+
+    * ``stragglers`` — one row per (span, strategy) straggler window,
+      sorted by rounds descending, each with: ``span``, ``strategy``,
+      ``rounds`` (windows closed), ``ranks`` (of the last round),
+      ``last_skew_s`` / ``max_skew_s`` (arrival skew = max − median
+      arrival per round, seconds), ``slowest_rank`` (last round's
+      slowest arrival; None when the round had no spread),
+      ``slowest_counts`` (rank → times attributed slowest),
+      ``modal_rank`` / ``modal_share`` (the most-often-slowest rank
+      and its fraction of closed rounds — the persistent-straggler
+      signal).
+    * ``histograms`` — ``(span, strategy, tier) → {count, sum_us,
+      buckets}`` with ``bucket_edges_us`` the shared upper edges
+      (last edge +Inf).
+    * ``steps`` — per-step critical paths; ``open_windows``,
+      ``dropped_keys``, ``mode``, ``enabled`` as before.
+
+    The same attribution rows are available sorted by last-round skew
+    via ``tempi_tpu.obs.metrics.attribution()``, and histogram
+    quantiles via ``metrics.quantile_s(q, span=...)``."""
     from .obs import metrics as obsmetrics
     return obsmetrics.snapshot()
 
@@ -477,8 +563,11 @@ def explain(limit: Optional[int] = None) -> dict:
     obs/timeline.py): every subsystem's verdicts — breaker transitions
     and demotions, tune drift/adoptions, re-placement decisions, FT
     death verdicts and shrinks, QoS lane quarantines, elastic
-    join/admit records, plan-invalidation bumps, and the recompiles
-    they caused — as ONE causally-ordered, generation-stamped ledger.
+    join/admit records, SLO-autopilot decisions (``autopilot.*`` —
+    the causal story reads ``metrics.round → autopilot.quarantine →
+    breaker.open → replace.decision → coll.recompile``),
+    plan-invalidation bumps, and the recompiles they caused — as ONE
+    causally-ordered, generation-stamped ledger.
     "Why did my step recompile / why did p99 jump" is this one call
     instead of seven snapshot diffs: follow a record's ``generation``
     forward to the bump that moved it and the recompile that observed

@@ -81,6 +81,10 @@ EVENTS = (
     # window's skew + slowest-rank attribution; the trace summary's
     # skew/straggler columns key on these
     "metrics.round",     # span, strategy, ranks, skew_us, slow_rank
+    # runtime/autopilot.py — SLO autopilot decisions (ISSUE 16)
+    "autopilot.decision",  # one confirmed policy decision (action,
+                           # target, mode, acted, outcome) — the trace
+                           # twin of the autopilot ledger entry
     # obs/fleet.py — fleet clock alignment (ISSUE 15)
     "fleet.clock",       # this process's coordinator clock-offset estimate
 )

@@ -361,6 +361,76 @@ def note_step_replay(comm_uid: int, profile: List[tuple]) -> None:
 # -- surfaces ------------------------------------------------------------------
 
 
+def _attribution_rows_locked() -> List[dict]:
+    """One stable row per (span, strategy) straggler window — the
+    documented schema ``api.metrics_snapshot()["stragglers"]`` and
+    :func:`attribution` share. Caller holds ``_lock``."""
+    rows = []
+    for k, s in _stragglers.items():
+        modal, modal_share = None, 0.0
+        if s.slowest_counts:
+            modal = max(s.slowest_counts, key=lambda r: (
+                s.slowest_counts[r], -r))  # ties break to the lowest rank
+            if s.rounds:
+                modal_share = s.slowest_counts[modal] / s.rounds
+        rows.append(dict(span=k[0], strategy=k[1], rounds=s.rounds,
+                         ranks=s.last_ranks, last_skew_s=s.last_skew_s,
+                         max_skew_s=s.max_skew_s,
+                         slowest_rank=s.last_slowest,
+                         slowest_counts=dict(s.slowest_counts),
+                         modal_rank=modal, modal_share=modal_share))
+    return rows
+
+
+def attribution() -> List[dict]:
+    """Slowest-rank attribution as a stable API (ISSUE 16 satellite):
+    the straggler rows of :func:`snapshot`, sorted worst-last-skew
+    first — the order a triage (or the SLO autopilot's quarantine
+    policy) reads them in. Each row: ``span``, ``strategy``, ``rounds``,
+    ``ranks``, ``last_skew_s``, ``max_skew_s``, ``slowest_rank``,
+    ``slowest_counts``, ``modal_rank``, ``modal_share`` (see the
+    ``api.metrics_snapshot`` docstring for semantics). Empty when
+    TEMPI_METRICS is off or no round window has closed."""
+    with _lock:
+        rows = _attribution_rows_locked()
+    return sorted(rows, key=lambda d: -d["last_skew_s"])
+
+
+def quantile_s(q: float, span: Optional[str] = None,
+               strategy: Optional[str] = None) -> Optional[float]:
+    """Histogram quantile in seconds over every key matching ``span``/
+    ``strategy`` (None = any), merged bucket-wise. Upper-edge
+    convention — the reported value is the smallest bucket edge at or
+    above the requested rank, so it never understates the latency (the
+    overflow bucket reports the largest finite edge). None when nothing
+    matched. ``q`` in (0, 1]."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"bad quantile {q!r}: want 0 < q <= 1")
+    merged = [0] * NUM_BUCKETS
+    with _lock:
+        for k, h in _hist.items():
+            if span is not None and k[0] != span:
+                continue
+            if strategy is not None and k[1] != strategy:
+                continue
+            for i, c in enumerate(h.buckets):
+                merged[i] += c
+    total = sum(merged)
+    if not total:
+        return None
+    edges = bucket_edges_us()
+    target = q * total
+    seen = 0
+    for i, c in enumerate(merged):
+        seen += c
+        if seen >= target:
+            edge = edges[i]
+            if edge == math.inf:
+                edge = edges[-2] if len(edges) > 1 else 0.0
+            return edge / 1e6
+    return None
+
+
 def snapshot() -> dict:
     """Everything recorded this session as pure data — histograms (with
     the shared bucket edges), straggler attribution, step critical
@@ -372,11 +442,7 @@ def snapshot() -> dict:
                       min_s=(h.min_s if h.count else 0.0), max_s=h.max_s,
                       buckets=list(h.buckets))
                  for k, h in _hist.items()]
-        strag = [dict(span=k[0], strategy=k[1], rounds=s.rounds,
-                      ranks=s.last_ranks, last_skew_s=s.last_skew_s,
-                      max_skew_s=s.max_skew_s, slowest_rank=s.last_slowest,
-                      slowest_counts=dict(s.slowest_counts))
-                 for k, s in _stragglers.items()]
+        strag = _attribution_rows_locked()
         steps = {uid: dict(replays=st["replays"],
                            last_critical_path_s=st["last_s"],
                            max_critical_path_s=st["max_s"],

@@ -348,8 +348,10 @@ def replace_ranks(comm: Communicator) -> dict:
             log.warn(f"replace: apply failed, frozen mapping kept: {e!r}")
     with _lock:
         _decision_count += 1
+        from ..runtime import invalidation
         entry = {k: v for k, v in dec.items() if k != "slot_of"}
         entry["at_monotonic"] = time.monotonic()
+        entry["generation"] = invalidation.GENERATION
         _decisions.append(entry)
         del _decisions[:-_LEDGER_KEEP]
         _last_provenance = dec["provenance"]

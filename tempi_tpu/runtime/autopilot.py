@@ -1,0 +1,631 @@
+"""SLO autopilot: close the loop from fleet metrics to runtime actuators.
+
+ISSUE 16. Everything below this library — breaker demotion
+(runtime/health.py), rank re-placement (parallel/replacement.py),
+FT shrink (runtime/liveness.py), elastic grow (runtime/elastic.py),
+QoS weights (runtime/qos.py) — is an *actuator* an operator calls
+after watching the fleet observatory (span histograms, straggler
+attribution, ``api.explain()``). This module is the operator: a policy
+control loop that evaluates the metrics snapshot against declared SLOs
+and issues the same epoch-boundary actions autonomously
+(PAPER.md's premise — the library, not the human, makes performance
+decisions transparently; ROADMAP item 4's "no operator in the loop").
+
+Modes (``TEMPI_AUTOPILOT``, loud-parsed):
+
+* ``off`` (default) — ``step()`` is one module-attribute truth test;
+  no signals gathered, no policy state, autopilot counters pinned at
+  zero, byte-for-byte identical paths everywhere else.
+* ``observe`` — the policy runs in full (signals, hysteresis,
+  ledger, timeline, counters) but NO actuator is called; every entry
+  records the exact decision it *would* have taken (``acted=False``,
+  ``outcome="observed"``). The recommended first rollout: run a real
+  workload for a day, then read ``api.autopilot_snapshot()`` to see
+  what the autopilot would have done to it.
+* ``act`` — the same policy, and confirmed decisions call the
+  actuators. By construction the decision SEQUENCE is identical to
+  ``observe`` for identical inputs (the act/observe split happens
+  strictly after :meth:`Policy.evaluate`); the property tests in
+  tests/test_autopilot.py pin this.
+
+Four actions, each an epoch-boundary call an operator would make:
+
+* ``quarantine`` — the same rank is attributed slowest (straggler
+  skew over the SLO bound) in K of the last N evaluation windows:
+  force-open-and-pin every breaker touching it
+  (``health.force_open(reason="autopilot")``) and, when
+  ``TEMPI_REPLACE`` is armed, run ``replacement.replace_ranks`` so
+  traffic re-places around it. The causal story in ``api.explain()``
+  reads ``metrics.round → autopilot.quarantine → breaker.open →
+  replace.decision → coll.recompile``.
+* ``shrink`` — the FT layer holds a rank-failure verdict
+  (``TEMPI_FT=shrink``): build the survivor communicator. The
+  successor is retained; the app adopts it via :func:`successor`.
+* ``grow`` — joiners are pending (``TEMPI_ELASTIC=grow``), no dead
+  ranks, and skew is healthy (or the healthy-rank floor is breached,
+  which overrides the skew gate): admit them.
+* ``qos_flood`` / ``qos_restore`` — sustained bulk-class
+  backpressure: flip the live scheduler weights to a latency-heavy
+  flood profile (:func:`tempi_tpu.runtime.qos.set_weights`); restore
+  the saved weights after K clean windows.
+
+Every action carries hysteresis: K-of-N window confirmation (a single
+noisy window NEVER triggers — the env parser refuses K < 2) plus a
+per-action cooldown, with grow and shrink sharing ONE resize cooldown
+so the pair cannot flap. Decisions land in a bounded ledger (the
+eighth decision ledger registered with ``api.explain()``), on the
+unified timeline (``autopilot.<action>`` events), in the trace
+(``autopilot.decision``), and in ``counters.autopilot``.
+
+Determinism: ``step(comm, now=...)`` takes an optional logical clock so
+benches and property tests drive identical seeds through observe and
+act and compare the decision sequences exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..obs import metrics as obsmetrics
+from ..obs import timeline
+from ..obs import trace as obstrace
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import locks
+from ..utils import logging as log
+from . import elastic, faults, health, invalidation, liveness
+from . import qos as qosmod
+
+MODES = ("off", "observe", "act")
+
+#: Module-level fast-path flag: True iff mode != off. With
+#: ``TEMPI_AUTOPILOT`` unset, ``step()`` is one truth test — no signal
+#: gathering, no policy state, no ledger (the byte-for-byte guard).
+ENABLED = False
+MODE = "off"
+
+#: Decision vocabulary. Stable strings: ledger entries, timeline event
+#: suffixes (``autopilot.quarantine`` …), and snapshot keys use them.
+ACTIONS = ("quarantine", "shrink", "grow", "qos_flood", "qos_restore")
+
+_LEDGER_KEEP = 100  # bounded decision ledger (diagnostics, not logs)
+
+#: Span names whose histograms feed the p99 step/replay-latency signal.
+#: These are the replay/dispatch spans the observatory already records;
+#: the autopilot reads per-interval bucket DELTAS so one bad epoch in a
+#: long run cannot hide inside (or contaminate) the cumulative counts.
+WATCH_SPANS = ("step.replay", "coll.round", "redcoll.round")
+
+_lock = locks.named_lock("autopilot")
+
+
+# -- hysteresis primitives -----------------------------------------------------
+
+
+class KofN:
+    """K-of-N window confirmation: :meth:`note` records one boolean
+    evaluation window and returns True iff at least ``k`` of the last
+    ``n`` windows were True. Pure and seed-deterministic — no clock, no
+    side effects beyond the bounded window — so a single noisy window
+    never fires (the env parser enforces ``k >= 2``) and identical
+    input sequences confirm at identical offsets."""
+
+    __slots__ = ("k", "n", "_window")
+
+    def __init__(self, k: int, n: int):
+        if not (2 <= int(k) <= int(n)):
+            raise ValueError(
+                f"bad K-of-N confirmation ({k}/{n}): want 2 <= K <= N "
+                "(a single noisy window must never trigger an action)")
+        self.k, self.n = int(k), int(n)
+        self._window: List[bool] = []
+
+    def note(self, hit: bool) -> bool:
+        self._window.append(bool(hit))
+        if len(self._window) > self.n:
+            del self._window[: len(self._window) - self.n]
+        return sum(self._window) >= self.k
+
+    def reset(self) -> None:
+        del self._window[:]
+
+
+class Cooldown:
+    """Per-action cooldown: :meth:`ready` is True when at least
+    ``period_s`` has passed since the last :meth:`fire`. The clock is
+    caller-passed (logical seconds in tests/benches, monotonic seconds
+    live) so refusal is exactly reproducible: no action fires twice
+    inside its period."""
+
+    __slots__ = ("period_s", "_last")
+
+    def __init__(self, period_s: float):
+        self.period_s = float(period_s)
+        self._last: Optional[float] = None
+
+    def ready(self, now: float) -> bool:
+        return self._last is None or (now - self._last) >= self.period_s
+
+    def fire(self, now: float) -> None:
+        self._last = now
+
+
+# -- the policy ----------------------------------------------------------------
+
+
+class Policy:
+    """The pure decision core. :meth:`evaluate` maps one signals dict +
+    a logical clock to a list of decision dicts, mutating only its own
+    hysteresis state (K-of-N windows, cooldowns, the logical
+    quarantined/flooded sets). It calls NO actuator and reads NO global
+    — act vs observe diverge strictly after this point, which is what
+    makes "identical inputs produce identical decision sequences"
+    testable as a property rather than an aspiration.
+
+    ``slo`` keys (0/None = bound not declared): ``p99_ms``, ``skew_ms``,
+    ``min_ranks``. Signals (all optional but ``size``): ``p99_ms``,
+    ``skew_ms``, ``slowest_rank``, ``dead_ranks``, ``pending_joiners``,
+    ``bulk_pressure``, ``size``.
+    """
+
+    def __init__(self, slo: Dict, k: int, n: int, cooldown_s: float):
+        self.slo = dict(slo)
+        self.k, self.n = int(k), int(n)
+        self.cooldown_s = float(cooldown_s)
+        self._confirm: Dict[str, KofN] = {
+            a: KofN(k, n) for a in ACTIONS}
+        resize = Cooldown(cooldown_s)  # grow+shrink SHARE one cooldown:
+        # a shrink immediately followed by a grow (or vice versa) is the
+        # flapping this loop exists to prevent
+        self._cool: Dict[str, Cooldown] = {
+            "quarantine": Cooldown(cooldown_s),
+            "shrink": resize,
+            "grow": resize,
+            "qos_flood": Cooldown(cooldown_s),
+            "qos_restore": Cooldown(cooldown_s),
+        }
+        self._quarantined: set = set()   # logical: decided, ever
+        self._flooded = False            # logical: flood profile decided on
+        self.suppressed = 0              # confirmed but inside a cooldown
+        self.last_violations: List[str] = []
+
+    # helpers ------------------------------------------------------------
+
+    def _bound(self, name: str) -> Optional[float]:
+        v = self.slo.get(name)
+        return float(v) if v else None
+
+    def _decide(self, decisions: List[dict], action: str, now: float,
+                confirmed: bool, **fields) -> bool:
+        """Run one action's hysteresis gate; append the decision dict
+        when it confirms AND its cooldown is ready."""
+        if not self._confirm[action].note(confirmed):
+            return False
+        if not self._cool[action].ready(now):
+            self.suppressed += 1
+            return False
+        self._cool[action].fire(now)
+        self._confirm[action].reset()
+        decisions.append(dict(action=action, **fields))
+        return True
+
+    # the loop body ------------------------------------------------------
+
+    def evaluate(self, signals: Dict, now: float) -> List[dict]:
+        decisions: List[dict] = []
+        viol: List[str] = []
+        size = int(signals.get("size") or 0)
+        dead = list(signals.get("dead_ranks") or ())
+        healthy = max(0, size - len(dead))
+
+        p99 = signals.get("p99_ms")
+        p99_bound = self._bound("p99_ms")
+        p99_bad = (p99 is not None and p99_bound is not None
+                   and p99 > p99_bound)
+        if p99_bad:
+            viol.append(f"p99_ms {p99:.3f} > {p99_bound:g}")
+
+        skew = signals.get("skew_ms")
+        skew_bound = self._bound("skew_ms")
+        skew_bad = (skew is not None and skew_bound is not None
+                    and skew > skew_bound)
+        if skew_bad:
+            viol.append(f"skew_ms {skew:.3f} > {skew_bound:g}")
+
+        min_ranks = self.slo.get("min_ranks") or 0
+        floor_bad = bool(min_ranks) and healthy < int(min_ranks)
+        if floor_bad:
+            viol.append(f"healthy_ranks {healthy} < {int(min_ranks)}")
+
+        # quarantine: a PERSISTENT straggler — the latency/skew SLO is
+        # violated and the slowest-rank attribution names the same rank,
+        # K of the last N windows. A rank already decided on is never
+        # re-quarantined (the logical set keeps act and observe aligned:
+        # in act mode the fleet heals and the signal clears; in observe
+        # mode nothing heals, and without this set the policy would
+        # re-decide the same rank forever).
+        slowest = signals.get("slowest_rank")
+        straggling = ((skew_bad or p99_bad) and slowest is not None
+                      and not dead
+                      and int(slowest) not in self._quarantined)
+        if self._decide(decisions, "quarantine", now, straggling,
+                        target=None if slowest is None else int(slowest),
+                        skew_ms=skew, p99_ms=p99):
+            self._quarantined.add(int(slowest))
+
+        # shrink: the FT layer already holds a final verdict; the K-of-N
+        # gate only debounces the epoch (the dead set never un-declares,
+        # so confirmation is guaranteed after K windows).
+        self._decide(decisions, "shrink", now,
+                     bool(dead), target=sorted(int(r) for r in dead),
+                     healthy_ranks=healthy)
+
+        # grow: joiners pending, nothing dead (a shrink-vs-grow race is
+        # exactly the flap the shared cooldown forbids), and skew
+        # healthy — capacity added into a skewed fleet just dilutes the
+        # attribution. A breached healthy-rank floor overrides the skew
+        # gate: too few ranks beats a noisy tail.
+        pending = int(signals.get("pending_joiners") or 0)
+        growable = (pending > 0 and not dead
+                    and (not skew_bad or floor_bad))
+        self._decide(decisions, "grow", now, growable,
+                     target=pending, healthy_ranks=healthy)
+
+        # qos flood flip / restore: sustained bulk backpressure flips
+        # the live weights to the flood profile; K clean windows flip
+        # them back. The logical _flooded flag (not the actual weights,
+        # which observe mode never touches) sequences the pair.
+        bulk = int(signals.get("bulk_pressure") or 0)
+        if self._decide(decisions, "qos_flood", now,
+                        bulk > 0 and not self._flooded, target="bulk",
+                        bulk_pressure=bulk):
+            self._flooded = True
+        if self._decide(decisions, "qos_restore", now,
+                        self._flooded and bulk == 0, target="bulk",
+                        bulk_pressure=bulk):
+            self._flooded = False
+
+        self.last_violations = viol
+        for d in decisions:
+            d["violations"] = list(viol)
+        return decisions
+
+
+# -- module state --------------------------------------------------------------
+
+_policy: Optional[Policy] = None
+_decisions: List[dict] = []
+_decision_entries = 0
+_last_eval: Optional[float] = None
+_slo: Dict = {}
+# per-interval signal watermarks (previous cumulative values)
+_prev_buckets: Dict[tuple, List[int]] = {}
+_prev_rounds: Dict[tuple, int] = {}
+_prev_bulk = 0
+_saved_weights: Optional[Dict[str, int]] = None
+_successors: Dict[int, object] = {}
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm the autopilot. ``mode=None`` reads the parsed env's
+    ``autopilot_mode`` (call after ``read_environment``); an explicit
+    mode overrides (test convenience). Clears the policy's hysteresis
+    state, the decision ledger, and the per-interval signal watermarks
+    — autopilot history is per-session state, like counters."""
+    global ENABLED, MODE, _policy, _decision_entries, _last_eval
+    global _prev_bulk, _slo, _saved_weights
+    if mode is None:
+        mode = getattr(envmod.env, "autopilot_mode", "off")
+    if mode not in MODES:
+        raise ValueError(
+            f"bad TEMPI_AUTOPILOT mode {mode!r}: want one of {MODES}")
+    k, n = getattr(envmod.env, "autopilot_confirm", (2, 4))
+    cooldown = getattr(envmod.env, "autopilot_cooldown_s", 30.0)
+    with _lock:
+        MODE = mode
+        ENABLED = mode != "off"
+        _slo = dict(
+            p99_ms=getattr(envmod.env, "slo_p99_ms", 0.0),
+            skew_ms=getattr(envmod.env, "slo_skew_ms", 0.0),
+            min_ranks=getattr(envmod.env, "slo_min_ranks", 0),
+        )
+        _policy = Policy(_slo, k, n, cooldown) if ENABLED else None
+        del _decisions[:]
+        _decision_entries = 0
+        _last_eval = None
+        _prev_buckets.clear()
+        _prev_rounds.clear()
+        _prev_bulk = 0
+        _saved_weights = None
+        _successors.clear()
+    if ENABLED:
+        log.debug(f"SLO autopilot armed: mode={mode} confirm={k}/{n} "
+                  f"cooldown_s={cooldown} slo={_slo}")
+
+
+def disarm() -> None:
+    """Force the autopilot off (test/teardown convenience)."""
+    configure("off")
+
+
+def declare_slo(p99_ms: Optional[float] = None,
+                skew_ms: Optional[float] = None,
+                min_ranks: Optional[int] = None) -> Dict:
+    """Override declared SLO bounds at runtime (``api.declare_slo``).
+    ``None`` keeps the current value; 0 clears a bound. Returns the
+    effective SLO dict. The policy's hysteresis state is preserved —
+    tightening a bound mid-run must not forget an in-progress
+    confirmation streak."""
+    if not ENABLED:
+        raise RuntimeError(
+            "autopilot is off (set TEMPI_AUTOPILOT=observe|act)")
+    with _lock:
+        if p99_ms is not None:
+            if p99_ms < 0:
+                raise ValueError(f"bad p99_ms SLO {p99_ms!r}: want >= 0")
+            _slo["p99_ms"] = float(p99_ms)
+        if skew_ms is not None:
+            if skew_ms < 0:
+                raise ValueError(f"bad skew_ms SLO {skew_ms!r}: want >= 0")
+            _slo["skew_ms"] = float(skew_ms)
+        if min_ranks is not None:
+            if min_ranks < 0:
+                raise ValueError(
+                    f"bad min_ranks SLO {min_ranks!r}: want >= 0")
+            _slo["min_ranks"] = int(min_ranks)
+        if _policy is not None:
+            _policy.slo = dict(_slo)
+        return dict(_slo)
+
+
+# -- signal gathering ----------------------------------------------------------
+
+
+def _interval_p99_ms(snap: Optional[dict]) -> Optional[float]:
+    """p99 over the WATCH_SPANS histograms, computed on the bucket
+    DELTAS since the previous evaluation (upper-edge, conservative —
+    the same convention as ``metrics.quantile_s``). None when metrics
+    are off or no watched span recorded new observations."""
+    if not snap:
+        return None
+    edges = snap.get("bucket_edges_us") or []
+    merged = [0] * len(edges)
+    for h in snap.get("histograms") or []:
+        if h.get("span") not in WATCH_SPANS:
+            continue
+        key = (h.get("span"), h.get("strategy"), h.get("tier"))
+        buckets = list(h.get("buckets") or ())
+        prev = _prev_buckets.get(key)
+        _prev_buckets[key] = buckets
+        for i, c in enumerate(buckets[: len(merged)]):
+            d = c - (prev[i] if prev and i < len(prev) else 0)
+            if d > 0:
+                merged[i] += d
+    total = sum(merged)
+    if not total:
+        return None
+    target = 0.99 * total
+    seen = 0
+    for i, c in enumerate(merged):
+        seen += c
+        if seen >= target:
+            edge = edges[i]
+            if edge == float("inf"):  # overflow bucket: report the last
+                edge = edges[-2] if len(edges) > 1 else 0.0  # finite edge
+            return edge / 1e3  # µs -> ms
+    return None
+
+
+def _interval_skew(snap: Optional[dict]) -> tuple:
+    """(skew_ms, slowest_rank) from the straggler-attribution rows that
+    recorded NEW rounds since the previous evaluation; the worst new
+    row wins. (None, None) when nothing new arrived."""
+    if not snap:
+        return None, None
+    worst_ms, worst_rank = None, None
+    for row in snap.get("stragglers") or []:
+        key = (row.get("span"), row.get("strategy"))
+        rounds = int(row.get("rounds") or 0)
+        prev = _prev_rounds.get(key, 0)
+        _prev_rounds[key] = rounds
+        if rounds <= prev:
+            continue
+        skew_ms = float(row.get("last_skew_s") or 0.0) * 1e3
+        if worst_ms is None or skew_ms > worst_ms:
+            worst_ms = skew_ms
+            worst_rank = row.get("slowest_rank")
+    return worst_ms, worst_rank
+
+
+def _gather(comm) -> Dict:
+    """One signals dict for the policy. Reads only public subsystem
+    surfaces; every read degrades to None/0 when its subsystem is off
+    (the policy treats absent signals as healthy)."""
+    global _prev_bulk
+    snap = obsmetrics.snapshot() if obsmetrics.ENABLED else None
+    p99_ms = _interval_p99_ms(snap)
+    skew_ms, slowest = _interval_skew(snap)
+    dead = sorted(int(r) for r in (comm.dead_ranks or ()))
+    pending = elastic.pending_joiners(comm) if elastic.ENABLED else 0
+    q = ctr.counters.qos
+    bulk_now = q.backpressure_bulk + q.deferred_bulk
+    bulk = bulk_now - _prev_bulk
+    _prev_bulk = bulk_now
+    return dict(p99_ms=p99_ms, skew_ms=skew_ms, slowest_rank=slowest,
+                dead_ranks=dead, pending_joiners=int(pending),
+                bulk_pressure=max(0, bulk), size=comm.size)
+
+
+# -- actuation -----------------------------------------------------------------
+
+
+def _flood_profile(weights: Dict[str, int]) -> Dict[str, int]:
+    """The bulk-flood response: latency weight doubled (floor 8), bulk
+    pinned to 1 — starvation-free (the scheduler's credit refill keeps
+    every class draining) but decisively latency-first."""
+    return {
+        "latency": max(8, 2 * int(weights.get("latency", 4))),
+        "default": int(weights.get("default", 2)),
+        "bulk": 1,
+    }
+
+
+def _act(comm, dec: Dict) -> str:
+    """Execute one confirmed decision against the real actuators.
+    Returns the outcome string; raises only through the fault site (the
+    caller maps any exception to ``outcome="failed"`` and keeps the
+    frozen state)."""
+    global _saved_weights
+    action = dec["action"]
+    if faults.ENABLED:
+        faults.check("autopilot.act")
+    if action == "quarantine":
+        rank = int(dec["target"])
+        for other in range(comm.size):
+            if other == rank:
+                continue
+            for strat in health.STRATEGIES:
+                health.force_open(health.link(rank, other), strat,
+                                  reason="autopilot")
+        from ..parallel import replacement
+        if replacement.ENABLED:
+            rep = replacement.replace_ranks(comm)
+            dec["replace_outcome"] = rep.get("outcome")
+            return "quarantined+replaced"
+        return "quarantined"
+    if action == "shrink":
+        new = liveness.shrink(comm)
+        _successors[id(comm)] = new
+        dec["new_size"] = new.size
+        dec["new_uid"] = getattr(new, "uid", None)
+        return "shrunk"
+    if action == "grow":
+        new = elastic.grow(comm)
+        if new is None:
+            return "deferred"
+        _successors[id(comm)] = new
+        dec["new_size"] = new.size
+        dec["new_uid"] = getattr(new, "uid", None)
+        return "grown"
+    if action == "qos_flood":
+        _saved_weights = dict(envmod.env.qos_weights)
+        qosmod.set_weights(_flood_profile(_saved_weights),
+                           reason="autopilot flood response")
+        dec["weights"] = dict(envmod.env.qos_weights)
+        return "weights_flipped"
+    if action == "qos_restore":
+        if _saved_weights is not None:
+            qosmod.set_weights(dict(_saved_weights),
+                               reason="autopilot flood cleared")
+            _saved_weights = None
+        dec["weights"] = dict(envmod.env.qos_weights)
+        return "weights_restored"
+    raise ValueError(f"unknown autopilot action {action!r}")
+
+
+def _record(dec: Dict) -> None:
+    """Ledger + trace + counters for one finished decision (its
+    timeline record already landed at decision time — see step())."""
+    global _decision_entries
+    dec["at_monotonic"] = time.monotonic()
+    with _lock:
+        _decisions.append(dec)
+        _decision_entries += 1
+        if len(_decisions) > _LEDGER_KEEP:
+            del _decisions[: len(_decisions) - _LEDGER_KEEP]
+    if obstrace.ENABLED:
+        obstrace.emit("autopilot.decision", action=dec["action"],
+                      target=dec.get("target"), mode=dec["mode"],
+                      acted=dec["acted"], outcome=dec["outcome"])
+
+
+def step(comm, now: Optional[float] = None) -> List[dict]:
+    """One evaluation of the control loop (``api.autopilot_step``): an
+    epoch-boundary call, like ``replace_ranks`` — the caller guarantees
+    no operations are in flight on ``comm``. Gathers signals, runs the
+    policy, executes confirmed decisions (``act``) or records what it
+    would have done (``observe``). Returns the decision records issued
+    by THIS call (possibly empty). ``now`` is the policy's logical
+    clock (default: monotonic seconds) — benches/tests pass scripted
+    times for exact reproducibility.
+
+    Inert with ``TEMPI_AUTOPILOT`` unset/off: no evaluation, no
+    counters, no state."""
+    global _last_eval
+    if not ENABLED:
+        return []
+    if now is None:
+        now = time.monotonic()
+    with _lock:
+        period = getattr(envmod.env, "autopilot_period_s", 0.0)
+        if _last_eval is not None and period > 0 \
+                and (now - _last_eval) < period:
+            return []
+        _last_eval = now
+        policy = _policy
+    if policy is None:  # configure raced a disarm
+        return []
+    ctr.counters.autopilot.num_evaluations += 1
+    signals = _gather(comm)
+    with _lock:
+        before = policy.suppressed
+        decisions = policy.evaluate(signals, now)
+        ctr.counters.autopilot.num_suppressed += policy.suppressed - before
+    for dec in decisions:
+        dec["mode"] = MODE
+        dec["signals"] = dict(signals)
+        # the generation and the timeline record land AT DECISION TIME,
+        # before any actuator runs — so explain() reads causally:
+        # autopilot.quarantine -> breaker.open -> replace.decision ->
+        # invalidation.bump -> the recompile that observed it
+        dec["generation"] = invalidation.GENERATION
+        timeline.record("autopilot." + dec["action"],
+                        generation=dec["generation"],
+                        target=dec.get("target"), mode=MODE,
+                        violations=dec.get("violations") or None)
+        ctr.counters.autopilot.num_decisions += 1
+        if MODE == "act":
+            try:
+                dec["outcome"] = _act(comm, dec)
+                dec["acted"] = True
+                ctr.counters.autopilot.num_acted += 1
+            except Exception as e:  # noqa: BLE001 — the loop must ride
+                # through a failed actuator (chaos at autopilot.act):
+                # frozen state is kept, the failure is the record
+                dec["outcome"] = "failed"
+                dec["acted"] = False
+                dec["error"] = repr(e)[:200]
+                ctr.counters.autopilot.num_failed += 1
+        else:
+            dec["outcome"] = "observed"
+            dec["acted"] = False
+            ctr.counters.autopilot.num_observed += 1
+        _record(dec)
+    return decisions
+
+
+def successor(comm):
+    """The communicator a resize decision built for ``comm`` (shrink's
+    survivor or grow's enlarged comm), or None. The app adopts it at
+    the epoch boundary — the autopilot never swaps handles out from
+    under the caller."""
+    with _lock:
+        return _successors.get(id(comm))
+
+
+def snapshot() -> dict:
+    """Autopilot state for ``api.autopilot_snapshot()``: mode, declared
+    SLO, the bounded decision ledger (newest last), last-evaluation
+    violations, and hysteresis occupancy."""
+    with _lock:
+        return dict(
+            mode=MODE,
+            enabled=ENABLED,
+            slo=dict(_slo),
+            decisions=[dict(d) for d in _decisions],
+            decisions_total=_decision_entries,
+            last_violations=list(_policy.last_violations)
+            if _policy is not None else [],
+            suppressed=_policy.suppressed if _policy is not None else 0,
+        )

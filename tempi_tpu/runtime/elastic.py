@@ -157,10 +157,15 @@ def _require_enabled(what: str) -> None:
 
 
 def _ledger_append(entry: dict) -> None:
+    from . import invalidation
     global _ledger_entries
     with _lock:
         _ledger_entries += 1
         entry["at_monotonic"] = time.monotonic()
+        # every decision ledger carries the shared invalidation
+        # generation at decision time (ISSUE 16 satellite): explain()
+        # orders a grow's admit record against the bump it caused
+        entry["generation"] = invalidation.GENERATION
         _ledger.append(entry)
         del _ledger[:-_LEDGER_KEEP]
     # every join/admit record also lands in the unified decision

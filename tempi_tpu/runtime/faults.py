@@ -169,6 +169,14 @@ SITES = (
                           # exchange is never dropped; delay slows the
                           # posting producer; wedge refused like every
                           # non-engine site)
+    "autopilot.act",      # each act-mode decision execution
+                          # (runtime/autopilot._act — fires BEFORE any
+                          # actuator is called, so a raise maps to
+                          # outcome="failed" with the frozen fleet
+                          # state kept intact: a missed intervention is
+                          # never worse than a half-applied one; delay
+                          # slows the epoch-boundary caller; wedge
+                          # refused like every non-engine site)
 )
 
 KINDS = ("raise", "delay", "wedge")

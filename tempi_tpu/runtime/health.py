@@ -338,7 +338,8 @@ def note_demotion(peer: tuple, from_strategy: str, to_strategy: str) -> None:
         _demotion_count += 1
         if len(_demotions) < 100:
             _demotions.append(dict(peer=list(peer), **{"from": from_strategy},
-                                   to=to_strategy))
+                                   to=to_strategy,
+                                   generation=invalidation.GENERATION))
     timeline.record("breaker.demotion", link=list(peer),
                     **{"from": from_strategy}, to=to_strategy)
     if obstrace.ENABLED:

@@ -521,6 +521,7 @@ def _declare_dead(comm, dead_set: Set[int], provenance: dict) -> Set[int]:
                  size=comm.size, revoked_requests=len(doomed),
                  evidence={int(r): s for r, s in evidence.items()},
                  provenance=dict(provenance),
+                 generation=invalidation.GENERATION,
                  at_monotonic=time.monotonic())
     with _lock:
         _verdict_entries += 1
@@ -634,8 +635,10 @@ def shrink(comm):
         # them so survivor-to-survivor traffic recompiles clean
         comm.invalidate_plans()
     ctr.counters.ft.num_shrinks += 1
+    from . import invalidation
     entry = dict(kind="shrink", parent_size=comm.size, size=k,
                  dead=sorted(dead), shrink_s=time.monotonic() - t0,
+                 generation=invalidation.GENERATION,
                  at_monotonic=time.monotonic())
     with _lock:
         _verdicts.append(entry)

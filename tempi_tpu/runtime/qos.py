@@ -45,6 +45,7 @@ bounds, and bookkeeping consult the flag.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..obs import timeline
@@ -64,6 +65,12 @@ ENABLED = False
 # lane-quarantine verdicts this session (class -> count): the supervisor's
 # wedge verdicts attributed to the tenant's class, for qos_snapshot()
 _quarantine_verdicts: Dict[str, int] = {}
+# ...and the same verdicts as generation-stamped ledger RECORDS (ISSUE 16
+# satellite: every decision ledger carries the shared invalidation
+# generation so explain() ordering is unambiguous), bounded like every
+# other decision ledger
+_quarantine_ledger: List[dict] = []
+_LEDGER_KEEP = 100
 _verdict_lock = locks.named_lock("qos.verdicts")
 
 
@@ -76,6 +83,7 @@ def configure() -> None:
     ENABLED = bool(getattr(envmod.env, "qos_default", ""))
     with _verdict_lock:
         _quarantine_verdicts.clear()
+        del _quarantine_ledger[:]
     if ENABLED:
         log.debug(f"QoS armed: default class {envmod.env.qos_default!r}, "
                   f"weights {envmod.env.qos_weights}, "
@@ -90,6 +98,7 @@ def disarm() -> None:
     ENABLED = False
     with _verdict_lock:
         _quarantine_verdicts.clear()
+        del _quarantine_ledger[:]
 
 
 def arm() -> None:
@@ -142,9 +151,43 @@ def note_lane_quarantine(cls: str) -> None:
     quarantine itself stays per-communicator — runtime/progress.py — so
     innocent same-class tenants keep background service; this is the
     starvation-visibility ledger)."""
+    from . import invalidation
     with _verdict_lock:
         _quarantine_verdicts[cls] = _quarantine_verdicts.get(cls, 0) + 1
+        _quarantine_ledger.append(dict(
+            qos_class=cls, generation=invalidation.GENERATION,
+            at_monotonic=time.monotonic()))
+        if len(_quarantine_ledger) > _LEDGER_KEEP:
+            del _quarantine_ledger[: len(_quarantine_ledger) - _LEDGER_KEEP]
     timeline.record("qos.quarantine", qos_class=cls)
+
+
+def set_weights(weights: Dict[str, int], reason: str = "") -> Dict[str, int]:
+    """Swap the LIVE scheduler weights (ISSUE 16: the autopilot's
+    bulk-flood actuator, also a public operator surface). The scheduler
+    reads ``env.qos_weights`` at every credit-replenish round boundary,
+    so the new weights take effect on the next scheduling round — no
+    pump restart, no lane drain. Validates like the env parse: every
+    key a known class, every weight a positive int, every class
+    present. Returns the PREVIOUS weights (so a caller can restore
+    them); the swap lands on the timeline with its reason."""
+    if set(weights) != set(CLASSES):
+        raise ValueError(
+            f"bad QoS weights {weights!r}: want exactly the classes "
+            f"{CLASSES}")
+    clean = {}
+    for cls, w in weights.items():
+        if not isinstance(w, int) or isinstance(w, bool) or w < 1:
+            raise ValueError(
+                f"bad QoS weight {cls}={w!r}: want a positive integer")
+        clean[cls] = w
+    old = dict(envmod.env.qos_weights)
+    envmod.env.qos_weights = clean
+    timeline.record("qos.weights", old=old, new=dict(clean),
+                    reason=reason[:200] or None)
+    log.debug(f"qos weights {old} -> {clean}"
+              + (f" ({reason})" if reason else ""))
+    return old
 
 
 class ClassScheduler:
@@ -290,6 +333,7 @@ def snapshot() -> dict:
         )
     with _verdict_lock:
         verdicts = dict(_quarantine_verdicts)
+        verdict_ledger = [dict(v) for v in _quarantine_ledger]
     sched = progress.scheduler()
     if sched is not None:
         depths, credits = sched.depths(), sched.credits()
@@ -302,6 +346,7 @@ def snapshot() -> dict:
         queue_depth=envmod.env.qos_queue_depth,
         classes=classes,
         quarantine_verdicts=verdicts,
+        quarantine_ledger=verdict_ledger,
         quarantined_comms=[
             dict(qos_class=class_of(c)) for c in progress.quarantined()],
     )

@@ -314,9 +314,11 @@ def _judge_drift_locked(link: tuple, strategy: str, b: int,
         _bump_stale_locked(-1)
     if changed is None:
         return None
+    from ..runtime import invalidation
     event = dict(phase=changed, link=list(link), strategy=strategy,
                  bin=b, observed_s=st.mean_s, predicted_s=st.pred_s,
-                 rel_err=st.rel_err, samples=st.count)
+                 rel_err=st.rel_err, samples=st.count,
+                 generation=invalidation.GENERATION)
     _drift_total += 1
     _drift_audit.append(dict(event))
     del _drift_audit[:-_AUDIT_KEEP]
@@ -417,10 +419,13 @@ def note_adoption(entry: dict) -> None:
     """Record that an adapt-mode re-rank changed (or explored away from)
     the swept model's winner — the audit trail ``api.tune_snapshot``
     exposes, bounded like the breaker demotion trail."""
+    from ..runtime import invalidation
     global _adopt_total
     with _lock:
         _adopt_total += 1
-        _adopt_audit.append(dict(entry))
+        stamped = dict(entry)
+        stamped["generation"] = invalidation.GENERATION
+        _adopt_audit.append(stamped)
         del _adopt_audit[:-_AUDIT_KEEP]
     timeline.record("tune.adopt", link=entry.get("link"),
                     bin=entry.get("bin"), **{"from": entry.get("from")},

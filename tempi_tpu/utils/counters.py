@@ -186,6 +186,20 @@ class StepCounters:
 
 
 @dataclass
+class AutopilotCounters:
+    # SLO autopilot (ISSUE 16; runtime/autopilot.py): pinned at zero
+    # with TEMPI_AUTOPILOT unset — the counter-based byte-for-byte
+    # guard that the off path senses and decides nothing
+    num_evaluations: int = 0  # step() calls that evaluated the policy
+    num_decisions: int = 0    # confirmed decisions issued (both modes)
+    num_acted: int = 0        # act-mode decisions that ran an actuator
+    num_observed: int = 0     # observe-mode would-have-acted decisions
+    num_failed: int = 0       # act-mode actuator calls that raised
+                              # (chaos at autopilot.act); frozen state kept
+    num_suppressed: int = 0   # confirmed decisions refused by a cooldown
+
+
+@dataclass
 class LockCheckCounters:
     # lock-order race detector (ISSUE 11; utils/locks.py): pinned at zero
     # with TEMPI_LOCKCHECK unset — the counter-based byte-for-byte guard
@@ -224,6 +238,7 @@ class Counters:
     replace: ReplaceCounters = field(default_factory=ReplaceCounters)
     ft: FtCounters = field(default_factory=FtCounters)
     elastic: ElasticCounters = field(default_factory=ElasticCounters)
+    autopilot: AutopilotCounters = field(default_factory=AutopilotCounters)
     lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
 
     def as_dict(self) -> dict:

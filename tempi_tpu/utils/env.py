@@ -289,6 +289,48 @@ README "Elastic communicators" section):
                          joiners stay pending, and the next grow
                          retries (default 5)
 
+SLO-autopilot knobs (ISSUE 16; see runtime/autopilot.py and the README
+"SLO autopilot" section):
+  TEMPI_AUTOPILOT      = off | observe | act — the policy control loop
+                         that closes the metrics→actuator loop (default
+                         off = one truth test per api.autopilot_step,
+                         no policy state, counters pinned at zero).
+                         ``observe`` runs the full policy and records
+                         every decision it WOULD have taken without
+                         acting (the recommended first rollout);
+                         ``act`` additionally calls the actuators
+                         (quarantine-and-replace, shrink, grow, QoS
+                         weight flip) at epoch boundaries.
+  TEMPI_AUTOPILOT_PERIOD_S  minimum seconds between policy evaluations;
+                         api.autopilot_step calls inside the period
+                         return without evaluating (default 0 = every
+                         call evaluates — benches/tests drive the loop
+                         explicitly)
+  TEMPI_AUTOPILOT_CONFIRM  K-of-N window confirmation as "K/N": an
+                         action fires only when its predicate held in
+                         at least K of the last N evaluation windows
+                         (default 2/4). K must be >= 2 — a single
+                         noisy window must never trigger an action —
+                         and N >= K; anything else refuses loudly.
+  TEMPI_AUTOPILOT_COOLDOWN_S  per-action cooldown seconds: a confirmed
+                         action inside its cooldown is SUPPRESSED (and
+                         counted), never queued. Grow and shrink share
+                         ONE cooldown so the pair cannot flap
+                         (default 30).
+  TEMPI_SLO_P99_MS     declared p99 step/replay-latency bound in
+                         milliseconds over the watched spans
+                         (step.replay, coll.round, redcoll.round),
+                         evaluated on per-interval histogram deltas
+                         (default 0 = bound not declared)
+  TEMPI_SLO_SKEW_MS    declared straggler arrival-skew bound in
+                         milliseconds per collective round; sustained
+                         violation with a stable slowest-rank
+                         attribution is the quarantine trigger
+                         (default 0 = bound not declared)
+  TEMPI_SLO_MIN_RANKS  declared healthy-rank floor; a breach overrides
+                         the grow action's skew-health gate (default
+                         0 = floor not declared)
+
 Whole-step persistent schedule knobs (ISSUE 12; see coll/step.py and the
 README "Persistent steps" section):
   TEMPI_STEP           = on | off — the capture/replay machinery behind
@@ -437,6 +479,14 @@ KNOWN_KNOBS = (
     # elastic communicators (ISSUE 13)
     "TEMPI_ELASTIC",
     "TEMPI_GROW_AGREE_TIMEOUT_S",
+    # SLO autopilot (ISSUE 16)
+    "TEMPI_AUTOPILOT",
+    "TEMPI_AUTOPILOT_PERIOD_S",
+    "TEMPI_AUTOPILOT_CONFIRM",
+    "TEMPI_AUTOPILOT_COOLDOWN_S",
+    "TEMPI_SLO_P99_MS",
+    "TEMPI_SLO_SKEW_MS",
+    "TEMPI_SLO_MIN_RANKS",
     # whole-step persistent schedules (ISSUE 12)
     "TEMPI_STEP",
     "TEMPI_STEP_FUSE",
@@ -604,6 +654,14 @@ class Environment:
     # elastic communicators (ISSUE 13) — see runtime/elastic.py
     elastic_mode: str = "off"      # off | grow
     grow_agree_timeout_s: float = 5.0  # DCN join-admission vote budget
+    # SLO autopilot (ISSUE 16) — see runtime/autopilot.py
+    autopilot_mode: str = "off"    # off | observe | act
+    autopilot_period_s: float = 0.0  # min seconds between evaluations
+    autopilot_confirm: tuple = (2, 4)  # K-of-N window confirmation
+    autopilot_cooldown_s: float = 30.0  # per-action cooldown seconds
+    slo_p99_ms: float = 0.0        # p99 latency bound (0 = undeclared)
+    slo_skew_ms: float = 0.0       # arrival-skew bound (0 = undeclared)
+    slo_min_ranks: int = 0         # healthy-rank floor (0 = undeclared)
     # whole-step persistent schedules (ISSUE 12) — see coll/step.py
     step_mode: str = "on"          # on | off (off = replay degrades to
     #                                the eager per-step path, loudly)
@@ -973,6 +1031,38 @@ class Environment:
         e.grow_agree_timeout_s = _float_env("TEMPI_GROW_AGREE_TIMEOUT_S",
                                             5.0)
 
+        # autopilot knobs parse loudly too: a typo'd TEMPI_AUTOPILOT
+        # silently staying off would run the one deployment that asked
+        # for autonomous SLO enforcement with a human-free fleet and no
+        # pilot; a malformed CONFIRM quietly becoming 1/1 would let a
+        # single noisy window quarantine a healthy rank
+        ap = (getenv("TEMPI_AUTOPILOT") or "off").lower()
+        if ap not in ("off", "observe", "act"):
+            raise ValueError(
+                f"bad TEMPI_AUTOPILOT={ap!r}: want off | observe | act")
+        e.autopilot_mode = ap
+        e.autopilot_period_s = _float_env("TEMPI_AUTOPILOT_PERIOD_S", 0.0)
+        e.autopilot_cooldown_s = _float_env("TEMPI_AUTOPILOT_COOLDOWN_S",
+                                            30.0)
+        conf = getenv("TEMPI_AUTOPILOT_CONFIRM")
+        if conf:
+            parts = conf.split("/")
+            try:
+                k, n = (int(p) for p in parts)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad TEMPI_AUTOPILOT_CONFIRM={conf!r}: want K/N "
+                    "(two integers, e.g. 2/4)") from exc
+            if not (2 <= k <= n):
+                raise ValueError(
+                    f"bad TEMPI_AUTOPILOT_CONFIRM={conf!r}: want "
+                    "2 <= K <= N (a single noisy window must never "
+                    "trigger an action)")
+            e.autopilot_confirm = (k, n)
+        e.slo_p99_ms = _float_env("TEMPI_SLO_P99_MS", 0.0, "milliseconds")
+        e.slo_skew_ms = _float_env("TEMPI_SLO_SKEW_MS", 0.0, "milliseconds")
+        e.slo_min_ranks = _pos_int_env("TEMPI_SLO_MIN_RANKS", 0)
+
         # step knobs parse loudly too: a typo'd TEMPI_STEP silently
         # staying on would replay a compiled step in the one run that
         # asked for the eager A/B baseline (and vice versa)
@@ -1039,6 +1129,9 @@ class Environment:
             # ...and the elastic layer for the same reason: no grow/
             # rejoin semantics exist beneath the interposition
             e.elastic_mode = "off"
+            # ...and the autopilot: with every actuator and the metrics
+            # layer disarmed there is nothing to sense or steer
+            e.autopilot_mode = "off"
             # ...and step replay: captured steps degrade to the eager
             # re-issue path — the bail-out measures the baseline engine,
             # not the framework's fused replay

@@ -69,6 +69,11 @@ def pytest_configure(config):
         "step: whole-step persistent schedule tests — capture/replay, "
         "pack fusion, the shared invalidation contract (the <30s smoke "
         "is `pytest -m step`)")
+    config.addinivalue_line(
+        "markers",
+        "autopilot: SLO-autopilot tests — hysteresis primitives, "
+        "act/observe decision equivalence, quarantine/shrink/grow/QoS "
+        "actuation (the <30s smoke is `pytest -m autopilot`)")
 
 
 @pytest.fixture(autouse=True)
@@ -79,7 +84,8 @@ def _reset_globals():
     wedged thread so it can exit)."""
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.parallel import replacement
-    from tempi_tpu.runtime import elastic, faults, health, liveness, qos
+    from tempi_tpu.runtime import (autopilot, elastic, faults, health,
+                                   liveness, qos)
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env, locks
 
@@ -94,6 +100,7 @@ def _reset_globals():
     replacement.configure()
     liveness.configure()
     elastic.configure()
+    autopilot.configure()
     counters.init()
     health.reset()
     yield
@@ -110,4 +117,5 @@ def _reset_globals():
     replacement.configure("off")
     liveness.configure("off")
     elastic.configure("off")
+    autopilot.disarm()
     locks.configure("off")

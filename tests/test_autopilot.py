@@ -1,0 +1,510 @@
+"""SLO-autopilot suite (ISSUE 16; runtime/autopilot.py).
+
+Pins the control loop's contracts: loud knob parsing, the inert
+off path (byte-for-byte, counter-pinned), the hysteresis primitives as
+pure seed-deterministic units (a single noisy window never triggers; no
+action fires twice inside its cooldown; act and observe produce
+IDENTICAL decision sequences for identical inputs), the
+quarantine-and-replace episode end to end (synthetic skewed rounds →
+pinned breakers + a causally-ordered explain() story), shrink/grow
+through the real actuators with the shared no-flapping cooldown, the
+QoS flood flip/restore pair, the generation stamp every decision
+ledger now carries, and the perf_report ``--slo`` gate CI shares with
+the autopilot bench."""
+
+import contextlib
+import json
+import random
+import subprocess
+import sys
+import os
+
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.obs import metrics as obsmetrics
+from tempi_tpu.obs import trace as obstrace
+from tempi_tpu.runtime import autopilot, health, invalidation, qos
+from tempi_tpu.tune import online as tune_online
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.autopilot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@contextlib.contextmanager
+def _world(monkeypatch, **env):
+    """An initialized world with autopilot knobs armed; value None
+    deletes the variable."""
+    defaults = dict(TEMPI_AUTOPILOT="act", TEMPI_METRICS="on",
+                    TEMPI_AUTOPILOT_CONFIRM="2/3",
+                    TEMPI_AUTOPILOT_COOLDOWN_S="10",
+                    TEMPI_SLO_SKEW_MS="2")
+    defaults.update(env)
+    for k, v in defaults.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+    comm = api.init()
+    try:
+        yield comm
+    finally:
+        api.finalize()
+
+
+def _skewed_round(comm, slow_rank, skew_s, t0=100.0):
+    """One synthetic collective round window: every rank arrives at
+    ``t0`` except ``slow_rank`` at ``t0 + skew_s`` (metrics' public
+    window surface — skew is computed from the stamps, so the signal is
+    exactly deterministic)."""
+    obsmetrics.round_begin(comm.uid, "coll.round", "synthetic")
+    others = [r for r in range(comm.size) if r != slow_rank]
+    obsmetrics.note_arrivals(comm.uid, others, t0)
+    obsmetrics.note_arrivals(comm.uid, [slow_rank], t0 + skew_s)
+    return obsmetrics.round_end(comm.uid, "coll.round")
+
+
+# -- knob parsing --------------------------------------------------------------
+
+
+def test_knobs_parse_loudly(monkeypatch):
+    monkeypatch.setenv("TEMPI_AUTOPILOT", "autopilot")
+    with pytest.raises(ValueError, match="TEMPI_AUTOPILOT"):
+        envmod.Environment.from_environ()
+    monkeypatch.setenv("TEMPI_AUTOPILOT", "act")
+    for bad in ("1/3", "3/2", "x", "2/4/8", "0/0"):
+        monkeypatch.setenv("TEMPI_AUTOPILOT_CONFIRM", bad)
+        with pytest.raises(ValueError, match="TEMPI_AUTOPILOT_CONFIRM"):
+            envmod.Environment.from_environ()
+    monkeypatch.setenv("TEMPI_AUTOPILOT_CONFIRM", "3/7")
+    monkeypatch.setenv("TEMPI_SLO_P99_MS", "-1")
+    with pytest.raises(ValueError, match="TEMPI_SLO_P99_MS"):
+        envmod.Environment.from_environ()
+    monkeypatch.setenv("TEMPI_SLO_P99_MS", "5.5")
+    monkeypatch.setenv("TEMPI_SLO_SKEW_MS", "2")
+    monkeypatch.setenv("TEMPI_SLO_MIN_RANKS", "4")
+    e = envmod.Environment.from_environ()
+    assert e.autopilot_mode == "act"
+    assert e.autopilot_confirm == (3, 7)
+    assert e.slo_p99_ms == 5.5 and e.slo_skew_ms == 2.0
+    assert e.slo_min_ranks == 4
+
+
+def test_tempi_disable_forces_autopilot_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_AUTOPILOT", "act")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    assert envmod.Environment.from_environ().autopilot_mode == "off"
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="TEMPI_AUTOPILOT"):
+        autopilot.configure("pilot")
+
+
+# -- off path ------------------------------------------------------------------
+
+
+def test_off_path_is_inert_and_counter_pinned(monkeypatch):
+    with _world(monkeypatch, TEMPI_AUTOPILOT=None) as comm:
+        assert not autopilot.ENABLED
+        assert api.autopilot_step(comm) == []
+        with pytest.raises(RuntimeError, match="TEMPI_AUTOPILOT"):
+            api.declare_slo(skew_ms=1.0)
+        snap = api.autopilot_snapshot()
+        assert snap["mode"] == "off" and snap["decisions"] == []
+        ap = api.counters_snapshot()["autopilot"]
+        assert all(v == 0 for v in ap.values())
+        assert not any(ev["kind"].startswith("autopilot.")
+                       for ev in api.explain()["events"])
+
+
+# -- hysteresis primitives (pure, seed-deterministic) --------------------------
+
+
+def test_kofn_rejects_single_window_confirmation():
+    with pytest.raises(ValueError, match="single noisy window"):
+        autopilot.KofN(1, 1)
+    with pytest.raises(ValueError):
+        autopilot.KofN(3, 2)
+
+
+def test_kofn_single_noisy_window_never_triggers():
+    for n in (2, 3, 5, 8):
+        for k in range(2, n + 1):
+            g = autopilot.KofN(k, n)
+            assert g.note(True) is False  # the single noisy window
+            for _ in range(n):
+                assert g.note(False) is False
+
+
+def test_kofn_matches_reference_on_seeded_sequences():
+    rng = random.Random(1234)
+    for _ in range(50):
+        n = rng.randint(2, 8)
+        k = rng.randint(2, n)
+        g = autopilot.KofN(k, n)
+        window = []
+        for _ in range(200):
+            hit = rng.random() < 0.4
+            window.append(hit)
+            expect = sum(window[-n:]) >= k
+            assert g.note(hit) is expect
+
+
+def test_cooldown_never_fires_twice_inside_period():
+    rng = random.Random(99)
+    cd = autopilot.Cooldown(7.5)
+    last_fired = None
+    t = 0.0
+    for _ in range(500):
+        t += rng.random() * 3.0
+        if cd.ready(t):
+            cd.fire(t)
+            if last_fired is not None:
+                assert t - last_fired >= 7.5
+            last_fired = t
+
+
+def test_policy_act_observe_identical_decision_sequences():
+    """The act/observe split happens strictly AFTER Policy.evaluate, so
+    two policies fed identical signal/clock sequences must emit
+    identical decision sequences — the property that makes an observe
+    ledger a faithful preview of act mode."""
+    rng = random.Random(7)
+    script = []
+    for i in range(120):
+        script.append(dict(
+            size=8,
+            skew_ms=rng.choice([0.1, 0.1, 5.0, 9.0]),
+            slowest_rank=rng.choice([3, 3, 3, 5]),
+            p99_ms=rng.choice([None, 1.0, 12.0]),
+            dead_ranks=[7] if rng.random() < 0.1 else [],
+            pending_joiners=rng.choice([0, 0, 1]),
+            bulk_pressure=rng.choice([0, 0, 0, 4]),
+        ))
+    slo = dict(skew_ms=2.0, p99_ms=8.0, min_ranks=0)
+    a = autopilot.Policy(slo, 2, 4, 9.0)
+    b = autopilot.Policy(slo, 2, 4, 9.0)
+    seq_a = [a.evaluate(dict(s), float(i)) for i, s in enumerate(script)]
+    seq_b = [b.evaluate(dict(s), float(i)) for i, s in enumerate(script)]
+    assert seq_a == seq_b
+    assert any(seq_a), "the seeded script must provoke some decision"
+    assert a.suppressed == b.suppressed
+
+
+def test_policy_no_grow_shrink_flapping():
+    """Grow and shrink share ONE resize cooldown: right after a shrink
+    decision, a fully-confirmed grow must be suppressed until the
+    cooldown elapses."""
+    p = autopilot.Policy(dict(skew_ms=2.0), 2, 3, 20.0)
+    decs = []
+    for t in range(3):  # dead rank present -> shrink confirms at K=2
+        decs += p.evaluate(dict(size=8, dead_ranks=[5]), float(t))
+    assert [d["action"] for d in decs] == ["shrink"]  # fired at t=1.0
+    suppressed_before = p.suppressed
+    fired_at = []
+    for t in range(2, 30):  # dead gone, joiner pending -> grow confirms
+        for d in p.evaluate(dict(size=7, pending_joiners=1), float(t)):
+            fired_at.append((d["action"], float(t)))
+    # exactly one grow, and only after the SHARED cooldown from the
+    # shrink at t=1.0 elapsed (>= 21.0); the held-back confirmed
+    # windows moved the suppression counter
+    assert [a for a, _ in fired_at] == ["grow"]
+    assert fired_at[0][1] >= 21.0
+    assert p.suppressed > suppressed_before
+
+
+def test_policy_single_noisy_window_triggers_nothing():
+    p = autopilot.Policy(dict(skew_ms=2.0, p99_ms=5.0), 2, 4, 1.0)
+    assert p.evaluate(dict(size=8, skew_ms=50.0, slowest_rank=2,
+                           p99_ms=50.0, dead_ranks=[3],
+                           pending_joiners=2, bulk_pressure=100),
+                      0.0) == []
+
+
+# -- quarantine end to end -----------------------------------------------------
+
+
+def test_quarantine_episode_end_to_end(monkeypatch):
+    with _world(monkeypatch) as comm:
+        victim = 3
+        decs = []
+        for w in range(3):
+            _skewed_round(comm, victim, skew_s=0.005, t0=100.0 + w)
+            decs += api.autopilot_step(comm, now=float(w))
+        assert [d["action"] for d in decs] == ["quarantine"]
+        dec = decs[0]
+        assert dec["target"] == victim and dec["acted"]
+        assert dec["outcome"] == "quarantined"  # TEMPI_REPLACE unset
+        # the generation is stamped AT DECISION TIME — the breaker pins
+        # the decision caused bumped it afterwards
+        assert isinstance(dec["generation"], int)
+        assert dec["generation"] < invalidation.GENERATION
+        assert any(v.startswith("skew_ms") for v in dec["violations"])
+        # the breakers touching the victim are force-opened and pinned
+        hs = api.health_snapshot()
+        pinned = [b for b in hs["breakers"]
+                  if b.get("pinned") and victim in b["peer"]]
+        assert pinned and all(
+            b["last_error"] == "autopilot" for b in pinned)
+        # the causal story is on the unified timeline, in order: the
+        # decision record precedes the breaker pins it caused
+        kinds = [ev["kind"] for ev in api.explain()["events"]]
+        assert kinds.index("autopilot.quarantine") \
+            < kinds.index("breaker.open")
+        ap = api.counters_snapshot()["autopilot"]
+        assert ap["num_acted"] == 1 and ap["num_decisions"] == 1
+        # the same rank is never re-quarantined, even if skew persists
+        for w in range(3, 30):
+            _skewed_round(comm, victim, skew_s=0.005, t0=100.0 + w)
+            decs += api.autopilot_step(comm, now=float(w))
+        assert len(decs) == 1
+
+
+def test_observe_records_missed_intervention(monkeypatch):
+    with _world(monkeypatch, TEMPI_AUTOPILOT="observe") as comm:
+        victim = 2
+        decs = []
+        for w in range(3):
+            _skewed_round(comm, victim, skew_s=0.004, t0=200.0 + w)
+            decs += api.autopilot_step(comm, now=float(w))
+        assert [d["action"] for d in decs] == ["quarantine"]
+        assert decs[0]["acted"] is False
+        assert decs[0]["outcome"] == "observed"
+        # no actuator ran: nothing pinned, no breaker opened
+        assert not any(b.get("pinned")
+                       for b in api.health_snapshot()["breakers"])
+        snap = api.autopilot_snapshot()
+        assert snap["decisions"][-1]["outcome"] == "observed"
+        ap = api.counters_snapshot()["autopilot"]
+        assert ap["num_observed"] == 1 and ap["num_acted"] == 0
+
+
+def test_act_failure_keeps_frozen_state(monkeypatch):
+    """Chaos at autopilot.act: the decision records outcome=failed, the
+    fleet state is untouched, and the loop keeps running."""
+    with _world(monkeypatch,
+                TEMPI_FAULTS="autopilot.act:raise:1:7") as comm:
+        decs = []
+        for w in range(3):
+            _skewed_round(comm, 1, skew_s=0.003, t0=300.0 + w)
+            decs += api.autopilot_step(comm, now=float(w))
+        assert decs and decs[0]["outcome"] == "failed"
+        assert not decs[0]["acted"] and "error" in decs[0]
+        assert not any(b.get("pinned")
+                       for b in api.health_snapshot()["breakers"])
+        assert api.counters_snapshot()["autopilot"]["num_failed"] == 1
+
+
+# -- shrink / grow through the real actuators ----------------------------------
+
+
+def test_shrink_then_grow_with_shared_cooldown(monkeypatch):
+    with _world(monkeypatch, TEMPI_FT="shrink", TEMPI_ELASTIC="grow",
+                TEMPI_AUTOPILOT_COOLDOWN_S="10") as world:
+        from tempi_tpu.parallel import communicator as comm_mod
+        comm = comm_mod.Communicator(world.devices[:6])
+        api.mark_failed(comm, comm.size - 1)
+        decs = []
+        for t in range(3):
+            decs += api.autopilot_step(comm, now=float(t))
+        assert [d["action"] for d in decs] == ["shrink"]
+        assert decs[0]["acted"] and decs[0]["outcome"] == "shrunk"
+        small = autopilot.successor(comm)
+        assert small is not None and small.size == 5
+        # a joiner pends on the survivor comm; grow is confirmed by
+        # t=4 but the SHARED resize cooldown (shrink fired at t=1)
+        # suppresses it until t>=11
+        api.announce_join(small, [world.devices[6]])
+        grew = []
+        for t in range(3, 14):
+            grew += api.autopilot_step(small, now=float(t))
+        assert [d["action"] for d in grew] == ["grow"]
+        assert grew[0]["acted"] and grew[0]["outcome"] == "grown"
+        assert grew[0]["signals"]["pending_joiners"] == 1
+        big = autopilot.successor(small)
+        assert big is not None and big.size == 6
+        ap = api.counters_snapshot()["autopilot"]
+        assert ap["num_suppressed"] >= 1  # the held-back grow windows
+
+
+# -- QoS flood flip / restore --------------------------------------------------
+
+
+def test_qos_set_weights_validates_and_is_live(monkeypatch):
+    with _world(monkeypatch, TEMPI_QOS_DEFAULT="latency"):
+        with pytest.raises(ValueError, match="classes"):
+            qos.set_weights({"latency": 4})
+        with pytest.raises(ValueError, match="positive integer"):
+            qos.set_weights({"latency": 0, "default": 2, "bulk": 1})
+        before = dict(envmod.env.qos_weights)
+        old = qos.set_weights(dict(latency=9, default=2, bulk=1),
+                              reason="test")
+        assert old == before
+        assert envmod.env.qos_weights == dict(latency=9, default=2, bulk=1)
+        assert any(ev["kind"] == "qos.weights"
+                   for ev in api.explain()["events"])
+
+
+def test_qos_flood_flip_and_restore(monkeypatch):
+    with _world(monkeypatch, TEMPI_QOS_DEFAULT="latency") as comm:
+        original = dict(envmod.env.qos_weights)
+        decs = []
+        for t in range(3):  # sustained bulk backpressure
+            qos.count_backpressure("bulk")
+            decs += api.autopilot_step(comm, now=float(t))
+        assert [d["action"] for d in decs] == ["qos_flood"]
+        flood = dict(envmod.env.qos_weights)
+        assert flood["bulk"] == 1
+        assert flood["latency"] >= 2 * original["latency"]
+        # clean windows past the cooldown -> restore fires once
+        for t in range(3, 20):
+            decs += api.autopilot_step(comm, now=float(t))
+        assert [d["action"] for d in decs] == ["qos_flood", "qos_restore"]
+        assert envmod.env.qos_weights == original
+
+
+# -- the generation stamp across all decision ledgers --------------------------
+
+
+def test_decision_ledgers_carry_generation(monkeypatch):
+    """ISSUE 16 satellite: every decision-ledger entry carries the
+    shared invalidation generation at decision time, so explain()
+    ordering is unambiguous across subsystems."""
+    with _world(monkeypatch, TEMPI_FT="shrink", TEMPI_ELASTIC="grow",
+                TEMPI_QOS_DEFAULT="latency") as world:
+        from tempi_tpu.parallel import communicator as comm_mod
+        comm = comm_mod.Communicator(world.devices[:6])
+        # liveness verdict + shrink entries
+        api.mark_failed(comm, comm.size - 1)
+        small = api.shrink(comm)
+        ft_ledger = api.ft_snapshot()["ledger"]
+        assert ft_ledger and all(
+            isinstance(e["generation"], int) for e in ft_ledger)
+        assert any(e.get("kind") == "shrink" for e in ft_ledger)
+        # elastic join/admit ledger
+        api.announce_join(small, [world.devices[6]])
+        api.grow(small)
+        ledger = api.elastic_snapshot()["ledger"]
+        assert ledger and all(
+            isinstance(e["generation"], int) for e in ledger)
+        # health demotion trail
+        health.note_demotion((0, 1), "device", "staged")
+        demo = api.health_snapshot()["demoted"]
+        assert demo and isinstance(demo[-1]["generation"], int)
+        # qos lane-quarantine ledger
+        qos.note_lane_quarantine("bulk")
+        ql = api.qos_snapshot()["quarantine_ledger"]
+        assert ql and isinstance(ql[-1]["generation"], int)
+        # tune adoption audit
+        tune_online.note_adoption(dict(link=(0, 1), bin=3,
+                                       **{"from": "device"}, to="staged",
+                                       reason="test"))
+        adopt = api.tune_snapshot()["adopted"]
+        assert adopt and isinstance(adopt[-1]["generation"], int)
+        # autopilot ledger
+        for w in range(3):
+            _skewed_round(small, 1, skew_s=0.005, t0=400.0 + w)
+            api.autopilot_step(small, now=float(w))
+        decs = api.autopilot_snapshot()["decisions"]
+        assert decs and isinstance(decs[-1]["generation"], int)
+
+
+def test_replace_ledger_carries_generation(monkeypatch):
+    with _world(monkeypatch, TEMPI_REPLACE="observe") as comm:
+        size = comm.size
+        sources = [[(r - 1) % size] for r in range(size)]
+        dests = [[(r + 1) % size] for r in range(size)]
+        g = api.dist_graph_create_adjacent(comm, sources, dests, reorder=False)
+        api.replace_ranks(g)
+        led = api.replace_snapshot()["ledger"]
+        assert led and isinstance(led[-1]["generation"], int)
+
+
+# -- metrics attribution as a stable API ---------------------------------------
+
+
+def test_metrics_attribution_stable_schema(monkeypatch):
+    with _world(monkeypatch) as comm:
+        for w in range(4):
+            _skewed_round(comm, 6, skew_s=0.002, t0=500.0 + w)
+        rows = obsmetrics.attribution()
+        assert rows
+        row = rows[0]
+        for key in ("span", "strategy", "rounds", "ranks", "last_skew_s",
+                    "max_skew_s", "slowest_rank", "slowest_counts",
+                    "modal_rank", "modal_share"):
+            assert key in row
+        assert row["slowest_rank"] == 6 and row["modal_rank"] == 6
+        assert row["modal_share"] == 1.0
+        # the same rows (any order) are in the documented snapshot key
+        snap = api.metrics_snapshot()
+        assert {r["modal_rank"] for r in snap["stragglers"]} == {6}
+
+
+def test_metrics_quantile_conservative(monkeypatch):
+    with _world(monkeypatch) as _:
+        import time as _time
+        t0 = _time.monotonic()
+        obstrace.emit_span("step.replay", t0 - 0.003)  # ~3 ms
+        q = obsmetrics.quantile_s(0.99, span="step.replay")
+        assert q is not None and q >= 0.003  # upper edge never understates
+        with pytest.raises(ValueError):
+            obsmetrics.quantile_s(0.0)
+
+
+# -- declare_slo ---------------------------------------------------------------
+
+
+def test_declare_slo_overrides_and_validates(monkeypatch):
+    with _world(monkeypatch) as _:
+        slo = api.declare_slo(p99_ms=7.5, min_ranks=4)
+        assert slo["p99_ms"] == 7.5 and slo["min_ranks"] == 4
+        assert slo["skew_ms"] == 2.0  # env-declared bound kept
+        assert api.autopilot_snapshot()["slo"] == slo
+        with pytest.raises(ValueError, match="p99_ms"):
+            api.declare_slo(p99_ms=-3)
+
+
+# -- the shared SLO-check code path (perf_report --slo) ------------------------
+
+
+def test_perf_report_slo_parse_and_check():
+    sys.path.insert(0, os.path.join(REPO, "benches"))
+    try:
+        from perf_report import check_slo, parse_slo
+    finally:
+        sys.path.pop(0)
+    slo = parse_slo("p99_step_ms=5, skew_ms=2")
+    assert slo == {"p99_step_ms": 5.0, "skew_ms": 2.0}
+    for bad in ("", "x", "p99=-1", "p99=0", "p99=zzz"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+    flat = {"a.p99_step_ms": 4.0, "b.skew_ms": 3.0}
+    viol = check_slo(slo, flat)
+    assert viol == ["SLO skew_ms<=2 VIOLATED: b.skew_ms=3"]
+    assert check_slo({"nothing_ms": 1.0}, flat) \
+        == ["SLO nothing_ms<=1: no measured key matches"]
+
+
+def test_perf_report_slo_flag_exit_codes(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(dict(p99_step_ms=3.0, skew_ms=1.0)))
+    b.write_text(json.dumps(dict(p99_step_ms=6.0, skew_ms=1.5)))
+    script = os.path.join(REPO, "benches", "perf_report.py")
+    base = [sys.executable, script, "--compare", str(a), str(b),
+            "--threshold", "1000"]
+    ok = subprocess.run(base + ["--slo", "p99_step_ms=10,skew_ms=2"],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(base + ["--slo", "p99_step_ms=5,skew_ms=2"],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "p99_step_ms" in bad.stdout and "VIOLATED" in bad.stdout
+    malformed = subprocess.run(base + ["--slo", "oops"],
+                               capture_output=True, text=True)
+    assert malformed.returncode == 2

@@ -159,8 +159,10 @@ def test_auto_choice_demotes_quarantined_strategy(world, monkeypatch):
     assert p2p.choose_strategy_message(world, msg(2, 3)) == "device"
     snap = api.health_snapshot()
     assert snap["demotions"] >= 1
-    assert snap["demoted"][0] == {"peer": [0, 1], "from": "device",
-                                  "to": "staged"}
+    dem = snap["demoted"][0]
+    assert isinstance(dem.pop("generation"), int)  # ISSUE 16: every
+    # decision-ledger entry carries the shared invalidation generation
+    assert dem == {"peer": [0, 1], "from": "device", "to": "staged"}
     # half-open probe + success close the breaker: device comes back
     monkeypatch.setenv("TEMPI_BREAKER_COOLDOWN_S", "0")
     envmod.read_environment()
