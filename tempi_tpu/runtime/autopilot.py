@@ -50,9 +50,13 @@ Four actions, each an epoch-boundary call an operator would make:
   the saved weights after K clean windows.
 
 Every action carries hysteresis: K-of-N window confirmation (a single
-noisy window NEVER triggers — the env parser refuses K < 2) plus a
-per-action cooldown, with grow and shrink sharing ONE resize cooldown
-so the pair cannot flap. Decisions land in a bounded ledger (the
+noisy window NEVER triggers — the env parser refuses K < 2 — and the
+CURRENT window must itself be a hit, so a confirmation suppressed by a
+cooldown never fires later on stale evidence after the condition has
+cleared; quarantine confirms on the attributed rank, so a rotating
+slowest rank never quarantines anyone) plus a per-action cooldown,
+with grow and shrink sharing ONE resize cooldown so the pair cannot
+flap. Decisions land in a bounded ledger (the
 eighth decision ledger registered with ``api.explain()``), on the
 unified timeline (``autopilot.<action>`` events), in the trace
 (``autopilot.decision``), and in ``counters.autopilot``.
@@ -131,6 +135,39 @@ class KofN:
         del self._window[:]
 
 
+class RankKofN:
+    """K-of-N confirmation keyed by an attributed value (the quarantine
+    gate): :meth:`note` records one window's attribution (``None`` = no
+    hit) and returns the value only when the SAME value was attributed
+    in at least ``k`` of the last ``n`` windows, *including the current
+    one*. A rotating attribution — a different rank slowest every
+    window, generic noise rather than a persistent straggler — never
+    confirms, no matter how many windows violate the SLO."""
+
+    __slots__ = ("k", "n", "_window")
+
+    def __init__(self, k: int, n: int):
+        if not (2 <= int(k) <= int(n)):
+            raise ValueError(
+                f"bad K-of-N confirmation ({k}/{n}): want 2 <= K <= N "
+                "(a single noisy window must never trigger an action)")
+        self.k, self.n = int(k), int(n)
+        self._window: List[Optional[int]] = []
+
+    def note(self, value: Optional[int]) -> Optional[int]:
+        self._window.append(value)
+        if len(self._window) > self.n:
+            del self._window[: len(self._window) - self.n]
+        if value is None:
+            return None
+        if sum(1 for v in self._window if v == value) >= self.k:
+            return value
+        return None
+
+    def reset(self) -> None:
+        del self._window[:]
+
+
 class Cooldown:
     """Per-action cooldown: :meth:`ready` is True when at least
     ``period_s`` has passed since the last :meth:`fire`. The clock is
@@ -173,8 +210,12 @@ class Policy:
         self.slo = dict(slo)
         self.k, self.n = int(k), int(n)
         self.cooldown_s = float(cooldown_s)
+        # quarantine confirms on the ATTRIBUTED RANK (the same rank must
+        # be slowest in K of N windows — a rotating slowest rank is
+        # noise, not a straggler); the other actions confirm on booleans
         self._confirm: Dict[str, KofN] = {
-            a: KofN(k, n) for a in ACTIONS}
+            a: KofN(k, n) for a in ACTIONS if a != "quarantine"}
+        self._confirm["quarantine"] = RankKofN(k, n)
         resize = Cooldown(cooldown_s)  # grow+shrink SHARE one cooldown:
         # a shrink immediately followed by a grow (or vice versa) is the
         # flapping this loop exists to prevent
@@ -196,12 +237,13 @@ class Policy:
         v = self.slo.get(name)
         return float(v) if v else None
 
-    def _decide(self, decisions: List[dict], action: str, now: float,
-                confirmed: bool, **fields) -> bool:
-        """Run one action's hysteresis gate; append the decision dict
-        when it confirms AND its cooldown is ready."""
-        if not self._confirm[action].note(confirmed):
-            return False
+    def _fire(self, decisions: List[dict], action: str, now: float,
+              **fields) -> bool:
+        """Cooldown gate for one CONFIRMED action: append the decision
+        dict when the cooldown is ready, count a suppression otherwise.
+        The confirmation window is cleared only on a fire — a suppressed
+        confirmation must re-earn itself against LIVE windows, never
+        coast on the stale ones that confirmed it."""
         if not self._cool[action].ready(now):
             self.suppressed += 1
             return False
@@ -209,6 +251,17 @@ class Policy:
         self._confirm[action].reset()
         decisions.append(dict(action=action, **fields))
         return True
+
+    def _decide(self, decisions: List[dict], action: str, now: float,
+                hit: bool, **fields) -> bool:
+        """Run one boolean action's hysteresis gate. Fires only when the
+        window confirms AND the CURRENT window is itself a hit: after a
+        cooldown suppression the retained window may still sum to K, but
+        if the condition has since cleared the action must not fire on
+        that stale evidence."""
+        if not (self._confirm[action].note(hit) and hit):
+            return False
+        return self._fire(decisions, action, now, **fields)
 
     # the loop body ------------------------------------------------------
 
@@ -246,13 +299,15 @@ class Policy:
         # mode nothing heals, and without this set the policy would
         # re-decide the same rank forever).
         slowest = signals.get("slowest_rank")
-        straggling = ((skew_bad or p99_bad) and slowest is not None
-                      and not dead
-                      and int(slowest) not in self._quarantined)
-        if self._decide(decisions, "quarantine", now, straggling,
-                        target=None if slowest is None else int(slowest),
-                        skew_ms=skew, p99_ms=p99):
-            self._quarantined.add(int(slowest))
+        straggler: Optional[int] = None
+        if ((skew_bad or p99_bad) and slowest is not None and not dead
+                and int(slowest) not in self._quarantined):
+            straggler = int(slowest)
+        target = self._confirm["quarantine"].note(straggler)
+        if target is not None and self._fire(
+                decisions, "quarantine", now, target=target,
+                skew_ms=skew, p99_ms=p99):
+            self._quarantined.add(target)
 
         # shrink: the FT layer already holds a final verdict; the K-of-N
         # gate only debounces the epoch (the dead set never un-declares,
@@ -304,6 +359,9 @@ _prev_buckets: Dict[tuple, List[int]] = {}
 _prev_rounds: Dict[tuple, int] = {}
 _prev_bulk = 0
 _saved_weights: Optional[Dict[str, int]] = None
+# keyed by the parent's Communicator.uid — a process-monotonic creation
+# ordinal that is never reused, unlike id(), which a new object can
+# inherit after the parent is garbage-collected
 _successors: Dict[int, object] = {}
 
 
@@ -496,7 +554,8 @@ def _act(comm, dec: Dict) -> str:
         return "quarantined"
     if action == "shrink":
         new = liveness.shrink(comm)
-        _successors[id(comm)] = new
+        with _lock:
+            _successors[comm.uid] = new
         dec["new_size"] = new.size
         dec["new_uid"] = getattr(new, "uid", None)
         return "shrunk"
@@ -504,7 +563,8 @@ def _act(comm, dec: Dict) -> str:
         new = elastic.grow(comm)
         if new is None:
             return "deferred"
-        _successors[id(comm)] = new
+        with _lock:
+            _successors[comm.uid] = new
         dec["new_size"] = new.size
         dec["new_uid"] = getattr(new, "uid", None)
         return "grown"
@@ -567,8 +627,11 @@ def step(comm, now: Optional[float] = None) -> List[dict]:
     if policy is None:  # configure raced a disarm
         return []
     ctr.counters.autopilot.num_evaluations += 1
-    signals = _gather(comm)
     with _lock:
+        # signal gathering holds the lock too: _gather advances the
+        # per-interval watermarks (_prev_buckets/_prev_rounds/_prev_bulk),
+        # which configure()/disarm() clear from other threads
+        signals = _gather(comm)
         before = policy.suppressed
         decisions = policy.evaluate(signals, now)
         ctr.counters.autopilot.num_suppressed += policy.suppressed - before
@@ -611,7 +674,7 @@ def successor(comm):
     the epoch boundary — the autopilot never swaps handles out from
     under the caller."""
     with _lock:
-        return _successors.get(id(comm))
+        return _successors.get(comm.uid)
 
 
 def snapshot() -> dict:

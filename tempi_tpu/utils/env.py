@@ -309,14 +309,20 @@ SLO-autopilot knobs (ISSUE 16; see runtime/autopilot.py and the README
   TEMPI_AUTOPILOT_CONFIRM  K-of-N window confirmation as "K/N": an
                          action fires only when its predicate held in
                          at least K of the last N evaluation windows
-                         (default 2/4). K must be >= 2 — a single
-                         noisy window must never trigger an action —
-                         and N >= K; anything else refuses loudly.
+                         INCLUDING the current one (default 2/4) —
+                         quarantine additionally requires the SAME
+                         rank attributed slowest in those K windows
+                         (a rotating slowest rank is noise, not a
+                         straggler). K must be >= 2 — a single noisy
+                         window must never trigger an action — and
+                         N >= K; anything else refuses loudly.
   TEMPI_AUTOPILOT_COOLDOWN_S  per-action cooldown seconds: a confirmed
                          action inside its cooldown is SUPPRESSED (and
-                         counted), never queued. Grow and shrink share
-                         ONE cooldown so the pair cannot flap
-                         (default 30).
+                         counted), never queued — it must re-confirm
+                         against live windows after the cooldown, so a
+                         condition that has since cleared never fires
+                         on stale evidence. Grow and shrink share ONE
+                         cooldown so the pair cannot flap (default 30).
   TEMPI_SLO_P99_MS     declared p99 step/replay-latency bound in
                          milliseconds over the watched spans
                          (step.replay, coll.round, redcoll.round),

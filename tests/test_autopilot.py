@@ -225,6 +225,71 @@ def test_policy_single_noisy_window_triggers_nothing():
                       0.0) == []
 
 
+def test_policy_suppressed_confirmation_never_fires_on_healthy_window():
+    """A confirmation suppressed by cooldown must NOT coast on its
+    stale window: once the condition clears, the action never fires —
+    and the quarantine decision never carries target=None (the crash a
+    stale fire used to produce)."""
+    p = autopilot.Policy(dict(skew_ms=2.0), 2, 4, 30.0)
+    straggle = dict(size=8, skew_ms=9.0, slowest_rank=3)
+    decs = []
+    decs += p.evaluate(dict(straggle), 0.0)
+    decs += p.evaluate(dict(straggle), 1.0)  # confirms -> fires
+    assert [d["action"] for d in decs] == ["quarantine"]
+    assert decs[0]["target"] == 3
+    # a SECOND rank straggles inside the cooldown: confirmed twice,
+    # suppressed both times, window retained
+    straggle2 = dict(size=8, skew_ms=9.0, slowest_rank=5)
+    assert p.evaluate(dict(straggle2), 2.0) == []
+    assert p.evaluate(dict(straggle2), 3.0) == []
+    assert p.suppressed >= 1
+    # the fleet heals; the cooldown expires — the stale window must not
+    # fire (and must not crash on int(None))
+    healthy = dict(size=8, skew_ms=0.1, slowest_rank=None)
+    assert p.evaluate(dict(healthy), 39.0) == []
+    assert p.evaluate(dict(healthy), 45.0) == []
+
+
+def test_policy_qos_flood_never_fires_on_cleared_pressure():
+    """Finding-3 twin of the stale-window test: a qos_flood suppressed
+    inside its cooldown must not flip the weights later in a window
+    whose bulk pressure is already zero."""
+    p = autopilot.Policy(dict(), 2, 4, 30.0)
+    base = dict(size=8)
+    decs = []
+    for t in (0.0, 1.0):
+        decs += p.evaluate(dict(base, bulk_pressure=4), t)
+    assert [d["action"] for d in decs] == ["qos_flood"]
+    # restore, then a second flood confirms inside the flood cooldown
+    for t in (2.0, 3.0):
+        decs += p.evaluate(dict(base, bulk_pressure=0), t)
+    assert [d["action"] for d in decs] == ["qos_flood", "qos_restore"]
+    for t in (4.0, 5.0):
+        assert p.evaluate(dict(base, bulk_pressure=4), t) == []
+    # pressure cleared before the cooldown expired: no stale flip, ever
+    for t in (35.0, 40.0, 45.0):
+        assert p.evaluate(dict(base, bulk_pressure=0), t) == []
+
+
+def test_policy_rotating_slowest_rank_never_quarantines():
+    """Quarantine confirms on the ATTRIBUTED RANK: every window may
+    violate the skew SLO, but if the slowest rank rotates (generic
+    noise, not a persistent straggler) no rank reaches K matching
+    windows and nothing is quarantined."""
+    p = autopilot.Policy(dict(skew_ms=2.0), 2, 4, 1.0)
+    for t in range(40):
+        decs = p.evaluate(dict(size=8, skew_ms=9.0,
+                               slowest_rank=t % 4), float(t))
+        assert decs == []
+    # the same violations with a PERSISTENT rank confirm immediately
+    decs = []
+    for t in range(40, 43):
+        decs += p.evaluate(dict(size=8, skew_ms=9.0, slowest_rank=6),
+                           float(t))
+    assert [d["action"] for d in decs] == ["quarantine"]
+    assert decs[0]["target"] == 6
+
+
 # -- quarantine end to end -----------------------------------------------------
 
 
