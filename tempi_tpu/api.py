@@ -70,6 +70,9 @@ def init(devices=None) -> Communicator:
     autopilot.configure()  # arm TEMPI_AUTOPILOT (knobs loud-parsed
     # above; AFTER every actuator subsystem it steers — and this clears
     # any prior session's decision ledger and hysteresis state)
+    from .runtime import integrity
+    integrity.configure()  # arm TEMPI_INTEGRITY (knobs loud-parsed
+    # above; this clears any prior session's corruption-incident ledger)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -240,6 +243,10 @@ def finalize() -> None:
         autopilot.configure()  # the decision ledger and hysteresis
         # state are per-session too — a new session's fleet starts with
         # no confirmation streaks and no cooldowns in flight
+        from .runtime import integrity
+        integrity.configure()  # the corruption-incident ledger is
+        # per-session evidence too (env-armed integrity survives:
+        # configure re-reads the parsed mode)
         _world = None
 
 
@@ -275,6 +282,21 @@ def tune_snapshot() -> dict:
     (everything simply reads empty)."""
     from .tune import online as tune_online
     return tune_online.snapshot()
+
+
+def integrity_snapshot() -> dict:
+    """Diagnostic snapshot of the end-to-end integrity layer (ISSUE 17;
+    runtime/integrity.py): mode and checksum-chunk config, the total
+    corruption-incident count, and the bounded incident ledger — each
+    entry naming the corrupted seam (site), link, strategy,
+    round/segment, mismatching chunk indices, the action taken
+    (``retransmit`` or ``surface``), and the shared invalidation
+    generation current at detection (the join key that lets
+    :func:`explain` narrate corruption → breaker.open → demotion
+    causally). Pure data — safe to serialize. Callable before init and
+    after finalize (reads empty)."""
+    from .runtime import integrity
+    return integrity.snapshot()
 
 
 def comm_set_qos(comm: Communicator, qos_class: Optional[str]) -> None:
@@ -566,6 +588,9 @@ def explain(limit: Optional[int] = None) -> dict:
     join/admit records, SLO-autopilot decisions (``autopilot.*`` —
     the causal story reads ``metrics.round → autopilot.quarantine →
     breaker.open → replace.decision → coll.recompile``),
+    integrity corruption incidents (``integrity.corruption``, ISSUE
+    17 — the data-plane story reads ``integrity.corruption →
+    breaker.open [reason=corruption] → breaker.demotion``),
     plan-invalidation bumps, and the recompiles they caused — as ONE
     causally-ordered, generation-stamped ledger.
     "Why did my step recompile / why did p99 jump" is this one call

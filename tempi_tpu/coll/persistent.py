@@ -62,7 +62,7 @@ from ..obs import timeline
 from ..obs import trace as obstrace
 from ..ops import dtypes
 from ..ops.dtypes import Datatype
-from ..runtime import faults, health, invalidation, liveness
+from ..runtime import faults, health, integrity, invalidation, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..utils import counters as ctr
@@ -308,11 +308,19 @@ class _StagedLowering:
         self.comm, self.sendbuf, self.recvbuf = comm, sendbuf, recvbuf
         ar, pr = np.nonzero(sc)
         self._stats = (int(ar.size), int(sc.sum()))
-        self._segments = None
+        # per-message (lib-src, lib-dst, send-off, recv-off, nbytes)
+        # tuples: the copy plan of the segment path, and the verification
+        # plan of the integrity seam — built unconditionally so verified
+        # delivery covers the flat-gather fast path too (the flats move
+        # exactly these segments, flattened)
+        self._segments = []
         self._flats = None
         if ar.size:
             lib = _lib_perm(comm)
             n = sc[ar, pr].astype(np.int64)
+            self._segments = [(int(lib[a]), int(lib[p]), int(sd[a, p]),
+                               int(rd[p, a]), int(nn))
+                              for a, p, nn in zip(ar, pr, n)]
             if int(n.sum()) <= _STAGED_GATHER_BYTES:
                 seg = (np.arange(int(n.sum()), dtype=np.int64)
                        - np.repeat(np.cumsum(n) - n, n))
@@ -325,10 +333,6 @@ class _StagedLowering:
                 dst_flat = np.repeat(lib[pr] * rrow
                                      + rd[pr, ar].astype(np.int64), n) + seg
                 self._flats = (src_flat, dst_flat)
-            else:
-                self._segments = [(int(lib[a]), int(lib[p]), int(sd[a, p]),
-                                   int(rd[p, a]), int(nn))
-                                  for a, p, nn in zip(ar, pr, n)]
 
     def run_round(self, ri: int) -> None:
         import jax
@@ -339,9 +343,29 @@ class _StagedLowering:
             if self._flats is not None:
                 src_flat, dst_flat = self._flats
                 host_r.reshape(-1)[dst_flat] = host_s.reshape(-1)[src_flat]
-            elif self._segments is not None:
+            elif self._segments:
                 for la, lp, so, ro, nn in self._segments:
                     host_r[lp, ro: ro + nn] = host_s[la, so: so + nn]
+            if integrity.ENABLED:
+                # verified delivery (ISSUE 17): each segment validated
+                # against producer checksums BEFORE host_r commits to the
+                # device. host_s is pristine (fresh D2H), so a corrupt
+                # segment re-copies in place — per-segment retransmit,
+                # not per-round: one flaky segment must not force the
+                # whole round (and every OTHER segment's re-verification)
+                # through the retry loop. A surfaced raise still leaves
+                # recvbuf untouched for that loop's idempotent
+                # re-dispatch, the second line of defense.
+                for si, (la, lp, so, ro, nn) in enumerate(self._segments):
+                    def redo(la=la, lp=lp, so=so, ro=ro, nn=nn):
+                        host_r[lp, ro: ro + nn] = host_s[la, so: so + nn]
+
+                    integrity.verify_delivery(
+                        host_r[lp, ro: ro + nn],
+                        integrity.checksums(host_s[la, so: so + nn]),
+                        site="coll.staged", link=health.link(la, lp),
+                        strategy="staged", round_=ri, segment=si,
+                        redo=redo)
             self.recvbuf.data = jax.device_put(host_r, comm.sharding())
 
     def round_stats(self, ri: int) -> Tuple[int, int]:
@@ -516,6 +540,23 @@ class _HierLowering:
             host_g = np.zeros(self._gstage.data.shape, np.uint8)
             for ls, ld, so, ro, nb in self._gather_segs:
                 host_g[ld, ro: ro + nb] = host_s[ls, so: so + nb]
+            if integrity.ENABLED:
+                # verified delivery (ISSUE 17): the gather pass's staged
+                # segments validate before the leader staging commits to
+                # device; host_s is pristine, so a corrupt segment
+                # re-copies in place (the per-segment retransmit of the
+                # staged lowering) — a surfaced raise still falls back to
+                # the round loop, which rebuilds host_g from scratch
+                for si, (ls, ld, so, ro, nb) in \
+                        enumerate(self._gather_segs):
+                    def redo(ls=ls, ld=ld, so=so, ro=ro, nb=nb):
+                        host_g[ld, ro: ro + nb] = host_s[ls, so: so + nb]
+
+                    integrity.verify_delivery(
+                        host_g[ld, ro: ro + nb],
+                        integrity.checksums(host_s[ls, so: so + nb]),
+                        site="coll.hier_gather", link=health.link(ls, ld),
+                        strategy="staged", segment=si, redo=redo)
             self._gstage.data = jax.device_put(host_g, comm.sharding())
 
     def _scatter(self) -> None:
@@ -538,6 +579,36 @@ class _HierLowering:
                 host_s = np.ascontiguousarray(np.asarray(self.sendbuf.data))
                 for ls, ld, so, ro, nb in self._direct_segs:
                     host_r[ld, ro: ro + nb] = host_s[ls, so: so + nb]
+            if integrity.ENABLED:
+                # verified delivery (ISSUE 17): scatter-forwarded and
+                # direct segments validate before recvbuf commits, each
+                # re-copyable in place from its pristine source staging;
+                # the DCN leader batches themselves ride the p2p staged
+                # seam (plan.run_staged) when they host-stage
+                for si, (ls, ld, so, ro, nb) in \
+                        enumerate(self._scatter_segs):
+                    def redo(ls=ls, ld=ld, so=so, ro=ro, nb=nb):
+                        host_r[ld, ro: ro + nb] = host_in[ls, so: so + nb]
+
+                    integrity.verify_delivery(
+                        host_r[ld, ro: ro + nb],
+                        integrity.checksums(host_in[ls, so: so + nb]),
+                        site="coll.hier_scatter",
+                        link=health.link(ls, ld),
+                        strategy="staged", segment=si, redo=redo)
+                if self._direct_segs:
+                    for si, (ls, ld, so, ro, nb) in \
+                            enumerate(self._direct_segs):
+                        def redo(ls=ls, ld=ld, so=so, ro=ro, nb=nb):
+                            host_r[ld, ro: ro + nb] = \
+                                host_s[ls, so: so + nb]
+
+                        integrity.verify_delivery(
+                            host_r[ld, ro: ro + nb],
+                            integrity.checksums(host_s[ls, so: so + nb]),
+                            site="coll.hier_direct",
+                            link=health.link(ls, ld),
+                            strategy="staged", segment=si, redo=redo)
             self.recvbuf.data = jax.device_put(host_r, comm.sharding())
 
     def round_stats(self, ri: int) -> Tuple[int, int]:
@@ -916,8 +987,14 @@ class PersistentColl:
                                 faults.check("coll.hier_round")
                         low.run_round(ri)
                         break
-                    except Exception:
-                        if attempt >= retries:
+                    except Exception as e:
+                        # an IntegrityError may only ride this loop in
+                        # retransmit mode (the re-dispatch IS the
+                        # retransmit); verify mode surfaces it. Budget
+                        # first: an exhausted attempt never counts as a
+                        # retransmit
+                        if attempt >= retries \
+                                or not integrity.allow_round_retry(e):
                             raise
                         attempt += 1
                         delay = envmod.env.retry_backoff_s \
@@ -1150,7 +1227,7 @@ class _RoundsReduceLowering:
         if ri == 0:
             self._stage_in()
         elif ri <= len(self._rounds):
-            self._apply(self._rounds[ri - 1][1])
+            self._apply(self._rounds[ri - 1][1], ri)
         else:
             self._stage_out()
 
@@ -1180,8 +1257,31 @@ class _RoundsReduceLowering:
             work.append(w)
         self._work = work
 
-    def _apply(self, rnd) -> None:
-        redsched.apply_round(self._work, rnd, self._np_op)
+    def _apply(self, rnd, ri: int) -> None:
+        wire = None
+        if integrity.ENABLED:
+            # verified delivery (ISSUE 17): every round payload — phase-B
+            # leader aggregates included, since hier plans lower through
+            # this same apply — is copied into a staging buffer, passed
+            # through the integrity.wire chaos site, and validated
+            # against producer checksums BEFORE the elementwise op
+            # accumulates it. apply_round is transactional (no write
+            # until every payload verified), so a surfaced raise leaves
+            # the work buffers untouched for the round retry loop.
+            def wire(payload, m, _ri=ri):
+                staged = payload.copy()
+
+                def redo():
+                    np.copyto(staged, payload)
+
+                integrity.verify_delivery(
+                    staged, integrity.checksums(payload),
+                    site="redcoll.apply",
+                    link=health.link(int(self._lib[m.src]),
+                                     int(self._lib[m.dst])),
+                    strategy="staged", round_=_ri, redo=redo)
+                return staged
+        redsched.apply_round(self._work, rnd, self._np_op, wire=wire)
 
     def _stage_out(self) -> None:
         import jax
@@ -1598,8 +1698,13 @@ class PersistentReduce:
                             faults.check("redcoll.round")
                         low.run_round(ri)
                         break
-                    except Exception:
-                        if attempt >= retries:
+                    except Exception as e:
+                        # same integrity gate as the collective loop:
+                        # verify-mode IntegrityErrors surface, retransmit
+                        # mode rides the re-dispatch (budget first so an
+                        # exhausted attempt never counts as a retransmit)
+                        if attempt >= retries \
+                                or not integrity.allow_round_retry(e):
                             raise
                         attempt += 1
                         delay = envmod.env.retry_backoff_s \

@@ -78,7 +78,8 @@ def is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
 
-def apply_round(bufs: Sequence[np.ndarray], rnd, np_op) -> None:
+def apply_round(bufs: Sequence[np.ndarray], rnd, np_op,
+                wire=None) -> None:
     """Apply one round's messages over per-rank element buffers — THE
     executable definition of a round, shared by both ``simulate``
     flavors and the runtime lowering so the spec and the executor cannot
@@ -88,10 +89,18 @@ def apply_round(bufs: Sequence[np.ndarray], rnd, np_op) -> None:
     computing leaves the buffers untouched — the per-round retry loop
     may re-dispatch safely (the remaining writes are precomputed-array
     slice assignments, which cannot raise after the shape-matched
-    compute)."""
+    compute).
+
+    ``wire``, when set, is ``wire(payload, m) -> verified_payload`` —
+    the runtime lowering's verified-delivery hook (ISSUE 17): it returns
+    the (possibly re-copied) payload the op may consume, or raises
+    before anything commits. The default keeps this module pure
+    numpy — ``simulate`` and the spec tests never touch the runtime."""
     commits = []
     for m in rnd:
         payload = bufs[m.src][m.offset: m.offset + m.nelems]
+        if wire is not None:
+            payload = wire(payload, m)
         seg = bufs[m.dst][m.offset: m.offset + m.nelems]
         commits.append((seg, np_op(seg, payload) if m.action == "reduce"
                         else payload.copy()))

@@ -87,4 +87,10 @@ EVENTS = (
                            # twin of the autopilot ledger entry
     # obs/fleet.py — fleet clock alignment (ISSUE 15)
     "fleet.clock",       # this process's coordinator clock-offset estimate
+    # runtime/integrity.py — end-to-end payload integrity (ISSUE 17)
+    "integrity.verify",  # one covered copy validated (span; site, nbytes,
+                         # ok, retransmits)
+    "integrity.retransmit",  # a mismatch triggered a re-delivery (site,
+                             # link, strategy, attempt; attempt=0 marks a
+                             # round re-dispatch)
 )

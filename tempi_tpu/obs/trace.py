@@ -355,12 +355,16 @@ def failures() -> List[dict]:
 
 def _snapshot_file(reason: str, seq: int) -> str:
     """Where an auto-snapshot lands for the configured TEMPI_TRACE_PATH:
-    a directory gets ``tempi-trace[-r<rank>]-<reason>-<seq>.json``
+    a directory gets ``tempi-trace[-r<rank>]-p<pid>-<reason>-<seq>.json``
     inside it; a file path gets the suffixes spliced before its
     extension. The seq keeps repeated failures from overwriting each
     other's evidence; the rank stamp (when a process id is known) keeps
-    N processes sharing one path from clobbering each other's."""
+    N processes sharing one path from clobbering each other's; the pid
+    stamp covers the window BEFORE ``jax.distributed`` init assigns
+    ranks — two local processes snapshotting an init-time failure would
+    otherwise share a rank-less stem (ISSUE 17 satellite)."""
     rs = "" if _process_rank is None else f"-r{_process_rank}"
+    rs += f"-p{os.getpid()}"
     if os.path.isdir(_path):
         return os.path.join(_path,
                             f"tempi-trace{rs}-{reason}-{seq}.json")

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence
 from ..measure import system as msys
 from ..obs import metrics as obsmetrics
 from ..obs import trace as obstrace
-from ..runtime import faults, health, invalidation, liveness
+from ..runtime import faults, health, integrity, invalidation, liveness
 from ..tune import model as tune_model
 from ..tune import online as tune_online
 from ..ops import type_cache
@@ -697,9 +697,13 @@ def _execute_matched(comm: Communicator, messages, consumed,
             # compiled plan keeps faulting on this link must eventually
             # trip its breaker and be skipped in AUTO decisions. ONE
             # failure per link per event — a multi-message batch failing
-            # once must not burn the whole consecutive-failure threshold
-            for lk in {health.link(m.src, m.dst) for m in batch}:
-                health.record_failure(lk, strat, error=repr(e))
+            # once must not burn the whole consecutive-failure threshold.
+            # An IntegrityError is excepted: the integrity seam already
+            # recorded the corrupted link with reason="corruption", and a
+            # second generic record here would double-charge its breaker
+            if not isinstance(e, integrity.IntegrityError):
+                for lk in {health.link(m.src, m.dst) for m in batch}:
+                    health.record_failure(lk, strat, error=repr(e))
             abandoned = [op for _, rest in order[gi + 1:]
                          for i in rest
                          for op in (consumed[2 * i], consumed[2 * i + 1])]

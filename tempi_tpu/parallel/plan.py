@@ -31,6 +31,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..obs import trace as obstrace
 from ..runtime import faults
+from ..runtime import health
+from ..runtime import integrity
 from ..utils import compat
 from ..utils import counters as ctr
 from ..utils import env as envmod
@@ -539,6 +541,27 @@ class ExchangePlan:
                         moved[d, :nb] = host[s, :nb]
                 else:
                     moved[dsts, :nb] = host[srcs, :nb]
+            if integrity.ENABLED:
+                # verified delivery (ISSUE 17): producer checksums from the
+                # still-pristine packed payload, validated on the staging
+                # rows BEFORE they are pushed back to device — a corrupt
+                # row re-copies in place (retransmit mode) or raises with
+                # the (link, strategy, round) named. Runs under the same
+                # progress lock as the round itself: health/trace calls
+                # here add no lock edges _execute_matched does not already
+                # have.
+                strategy = "oneshot" if host_kind else "staged"
+                for nb, srcs, dsts in self._round_moves(ri):
+                    for s, d in zip(srcs, dsts):
+                        def redo(s=int(s), d=int(d), nb=int(nb)):
+                            moved[d, :nb] = host[s, :nb]
+
+                        integrity.verify_delivery(
+                            moved[d, :nb],
+                            integrity.checksums(host[s, :nb]),
+                            site="p2p.staged_copy",
+                            link=health.link(int(s), int(d)),
+                            strategy=strategy, round_=ri, redo=redo)
             ctr.counters.device.num_transfers += 1
             with ctr.timed(ctr.counters.device, "transfer_time"):
                 dev = jax.device_put(moved, comm.sharding())   # H2D

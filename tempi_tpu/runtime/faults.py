@@ -15,7 +15,7 @@ Spec grammar (comma-separated entries)::
 
   site — a registered name from ``SITES`` (typos fail loudly: a chaos run
          that silently tests nothing is worse than no chaos run)
-  kind — ``raise`` | ``delay`` | ``wedge``
+  kind — ``raise`` | ``delay`` | ``wedge`` | ``corrupt``
   rate — firing probability per pass through the site, 0 < rate <= 1
   seed — seeds this entry's private RNG; the draw sequence is a pure
          function of (seed, pass number), so a failure observed at pass N
@@ -60,6 +60,15 @@ Kind semantics:
           (``_WEDGE_SITES``): elsewhere a blocked thread is a harness
           hang no deadline can bound — sites under the progress lock
           would deadlock every waiter before any deadline check runs.
+  corrupt — flips one seeded byte of the IN-FLIGHT payload buffer the
+          call site hands to :func:`corrupt_bytes` (a data-plane fault:
+          the exchange proceeds, the bytes are wrong). Allowed ONLY at
+          the ``integrity.wire`` buffer sites (``_CORRUPT_SITES``),
+          refused elsewhere like wedge: other sites pass no buffer, so
+          the kind would silently test nothing. Fired positions/masks
+          are drawn from the entry's RNG, so a corruption observed at
+          pass N reproduces exactly — the detection story in
+          runtime/integrity.py is property-testable end to end.
 """
 
 from __future__ import annotations
@@ -169,6 +178,16 @@ SITES = (
                           # exchange is never dropped; delay slows the
                           # posting producer; wedge refused like every
                           # non-engine site)
+    "integrity.wire",     # each verified payload delivery at a covered
+                          # copy boundary (runtime/integrity.py,
+                          # ISSUE 17 — the only site that accepts the
+                          # 'corrupt' kind: the call site passes the
+                          # in-flight staging/segment buffer to
+                          # corrupt_bytes() right before validation, so
+                          # an armed flip is exactly what the checksum
+                          # compare must catch; raise/delay behave as
+                          # everywhere; wedge refused — several covered
+                          # seams run under the progress lock)
     "autopilot.act",      # each act-mode decision execution
                           # (runtime/autopilot._act — fires BEFORE any
                           # actuator is called, so a raise maps to
@@ -179,7 +198,7 @@ SITES = (
                           # refused like every non-engine site)
 )
 
-KINDS = ("raise", "delay", "wedge")
+KINDS = ("raise", "delay", "wedge", "corrupt")
 
 #: The only sites where ``wedge`` is meaningful — the engine/thread sites
 #: whose call sites opt into the right blocking behavior (progress.pump_step
@@ -194,6 +213,13 @@ KINDS = ("raise", "delay", "wedge")
 #: inside the lock; the real wedged-copy mitigation is the watchdog-bounded
 #: completion sync.)
 _WEDGE_SITES = ("p2p.progress", "progress.pump_step")
+
+#: The only sites where ``corrupt`` is meaningful — the buffer sites whose
+#: call sites hand the in-flight payload to :func:`corrupt_bytes`.
+#: Everywhere else the kind is refused at configure time: no buffer is
+#: passed, so an armed entry would draw, "fire", and mutate nothing — the
+#: exact quiet-chaos outcome this module rejects.
+_CORRUPT_SITES = ("integrity.wire",)
 
 #: Module-level fast-path flag: True iff at least one site is armed. Hot
 #: sites test this before calling into the module (see module docstring).
@@ -273,6 +299,13 @@ def configure(spec: Optional[str] = None) -> None:
                 "sites blocks a thread no deadline can bound — and under "
                 "the progress lock it would deadlock every waiter; use "
                 "raise or delay")
+        if kind == "corrupt" and site not in _CORRUPT_SITES:
+            raise FaultSpecError(
+                f"kind 'corrupt' not supported at site {site!r} (supported "
+                f"sites: {_CORRUPT_SITES}): only the integrity buffer "
+                "sites hand the in-flight payload to corrupt_bytes(); "
+                "elsewhere the kind would silently flip nothing — a chaos "
+                "run that tests nothing; use raise or delay")
         try:
             rate = float(rate_s)
             seed = int(seed_s)
@@ -349,6 +382,12 @@ def check(site: str, wedge: str = "block") -> bool:
     with _state_lock:
         release_event = _release_event
         for e in _table.get(site, ()):
+            # corrupt-kind entries belong to corrupt_bytes() exclusively:
+            # skipping them here (no pass count, no draw) keeps their
+            # (seed, pass number) sequence a pure function of the buffer
+            # passes, even at sites that also run check() for raise/delay
+            if e.kind == "corrupt":
+                continue
             e.passes += 1
             # sticky wedges skip the draw: once dead, stays dead (and the
             # draw sequence up to the first firing stays seed-reproducible)
@@ -378,6 +417,40 @@ def check(site: str, wedge: str = "block") -> bool:
     if newly_wedged and wedge == "block":
         release_event.wait()
     return hit
+
+
+def corrupt_bytes(site: str, view) -> int:
+    """One pass of every ``corrupt``-kind entry at buffer site ``site``
+    over the in-flight payload ``view`` (a writable flat uint8 array —
+    the integrity seams pass the REAL staging/segment buffer, so a fired
+    flip is exactly the corruption the downstream checksum compare must
+    catch). Each firing XORs one byte with a non-zero seeded mask — a
+    guaranteed change, never a no-op flip. Draws and bookkeeping happen
+    under the state lock (pass numbers and the rng sequence stay
+    deterministic under concurrent passes — a fired pass consumes
+    exactly two extra draws, position and mask); the mutation itself
+    happens after release. Zero-length buffers draw but cannot flip.
+    Returns the number of bytes flipped. Callers guard with
+    ``faults.ENABLED``."""
+    n = int(view.shape[0]) if hasattr(view, "shape") else len(view)
+    flips: List[tuple] = []
+    with _state_lock:
+        for e in _table.get(site, ()):
+            if e.kind != "corrupt":
+                continue
+            e.passes += 1
+            if not (e.rng.random() < e.rate and n > 0):
+                continue
+            e.fired += 1
+            if len(e.fired_passes) < 1000:
+                e.fired_passes.append(e.passes)
+            flips.append((e.rng.randrange(n), e.rng.randrange(1, 256)))
+    for pos, mask in flips:
+        view[pos] = int(view[pos]) ^ mask
+    if flips:
+        log.warn(f"injected corruption at {site}: "
+                 + ", ".join(f"byte {p}^={m:#04x}" for p, m in flips))
+    return len(flips)
 
 
 class _Watchdog:

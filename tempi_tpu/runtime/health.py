@@ -103,6 +103,11 @@ class _Breaker:
     # clobbered by one in-flight failure would quarantine the
     # replacement's healthy link forever
     pin_reason: str = ""
+    # failure CLASS of the most recent record_failure that carried one
+    # ("" = unclassified timeout/error; "corruption" = an integrity
+    # checksum mismatch, ISSUE 17) — lets the snapshot and api.explain()
+    # distinguish a link that is SLOW from a link that is LYING
+    last_reason: str = ""
 
 
 _lock = locks.named_lock("health")
@@ -124,12 +129,16 @@ def _recompute_flags_locked() -> None:
     TRIPPED = any(b.state != CLOSED for b in _table.values())
 
 
-def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
-                   ) -> bool:
+def record_failure(peer: tuple, strategy: str, error: Optional[str] = None,
+                   reason: str = "") -> bool:
     """One failure of ``strategy`` on ``peer`` (a :func:`link` key). Returns
     True when this failure OPENED the breaker (closed/half-open -> open) —
     the retry layer uses that edge to demote the exchange toward STAGED.
-    Negative ranks (ANY_SOURCE envelopes) are not a link; ignored."""
+    ``reason`` classifies the failure (``"corruption"`` from the integrity
+    seam, ISSUE 17; "" = unclassified) — it rides the breaker state, the
+    timeline record, and the snapshot so triage can tell a slow link from
+    a lying one. Negative ranks (ANY_SOURCE envelopes) are not a link;
+    ignored."""
     if not isinstance(peer, tuple) or any(r < 0 for r in peer):
         return False
     threshold = getattr(envmod.env, "breaker_threshold", 3)
@@ -139,6 +148,8 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
         b.consecutive += 1
         if error:
             b.last_error = str(error)[:200]
+        if reason:
+            b.last_reason = reason[:60]
         opened = False
         if b.state == HALF_OPEN or (b.state == CLOSED and threshold > 0
                                     and b.consecutive >= threshold):
@@ -158,7 +169,7 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
         # run outside the registry lock
         timeline.record("breaker.open", link=list(peer),
                         strategy=strategy, consecutive=consecutive,
-                        error=(error or "")[:200])
+                        reason=reason, error=(error or "")[:200])
         # breaker-open trigger of the shared plan-invalidation contract
         # (runtime/invalidation.py): every compiled artifact riding this
         # strategy re-validates before its next replay
@@ -167,7 +178,8 @@ def record_failure(peer: tuple, strategy: str, error: Optional[str] = None
         # outside the registry lock: the snapshot walks every thread's
         # ring and must not serialize breaker bookkeeping behind it
         obstrace.emit("breaker.open", link=list(peer), strategy=strategy,
-                      consecutive=consecutive, error=(error or "")[:200])
+                      consecutive=consecutive, reason=reason,
+                      error=(error or "")[:200])
         obstrace.failure_snapshot(
             "breaker-open",
             detail=f"link {peer} strategy {strategy!r}: "
@@ -361,6 +373,7 @@ def snapshot() -> dict:
                 consecutive_failures=b.consecutive, failures=b.failures,
                 successes=b.successes, times_opened=b.times_opened,
                 probes=b.probes, last_error=b.last_error,
+                last_reason=b.last_reason,
                 pinned=b.pinned, pin_reason=b.pin_reason,
                 # monotonic age of the CURRENT state (seconds since the
                 # last transition; 0 for a closed breaker that never

@@ -1,0 +1,332 @@
+"""End-to-end payload integrity: wire checksums + verified retransmit.
+
+No reference analog: the reference TEMPI stack trusts the bytes MPI
+delivers. This build rewrites every payload path — pack kernels, host
+staging, round-based collectives — so a bit flip in a staged buffer or a
+mis-stitched segment would be delivered silently; the whole robustness
+ladder (faults → breakers → retry → FT → autopilot) injects and detects
+only control-plane failures. This module closes the data plane: segment
+checksums computed at the producer side of every bulk copy boundary,
+carried out-of-band, and validated at the consumer BEFORE the bytes are
+handed to the application or accumulated into a reduction.
+
+``TEMPI_INTEGRITY`` modes (loud-parsed in utils/env.py):
+
+  off        — inert: one module-flag truth test per seam, counters
+               pinned at zero, byte-for-byte the unverified transport
+               (the established faults/tune/FT zero-cost contract).
+  verify     — checksum + validate every covered copy; a mismatch raises
+               :class:`IntegrityError` naming the corrupted (link,
+               strategy, round) and records a ``reason=corruption``
+               failure against the (link, strategy) breaker.
+  retransmit — verify, and on mismatch re-deliver through the existing
+               ``TEMPI_RETRY_ATTEMPTS`` machinery before surfacing.
+               Every seam re-copies the affected segment in place from
+               its still-pristine producer staging
+               (:func:`verify_delivery`'s ``redo`` — per-SEGMENT, so one
+               flaky segment never forces a whole round back through
+               verification); a segment that exhausts its budget raises
+               into the enclosing per-round retry loop, which
+               re-dispatches idempotently (the lowerings rebuild host
+               staging from the unmodified device input — the second
+               line of defense; :func:`allow_round_retry` gates which
+               mode lets that loop catch the error).
+
+Covered seams (each computes producer checksums, passes the in-flight
+consumer view through the ``integrity.wire`` chaos site, validates, and
+only then commits):
+
+  * ``parallel/plan.run_staged``       — every staged/oneshot p2p round
+    (eager sends, persistent replays, and the alltoallv strategies that
+    funnel through the exchange plan);
+  * ``coll/persistent._StagedLowering``   — per-segment host permute;
+  * ``coll/persistent._HierLowering``     — gather/scatter host passes
+    (the DCN leader batches ride the p2p seam transitively);
+  * ``coll/reduce.apply_round``        — every reduction-round payload,
+    including the two-level plan's phase-B leader aggregates, validated
+    before the elementwise op accumulates it.
+
+The device-path exchange (one compiled XLA program, no host staging) has
+no framework-touched buffer to checksum or corrupt: bytes never leave
+XLA's management, so there is no wire seam to cover — the covered seams
+are exactly the copies this framework itself performs.
+
+Detection evidence: ``integrity.*`` counters (checked/verified/corrupt/
+retransmits + checked_bytes), ``integrity.verify`` spans, a bounded
+incident ledger stamped with the shared invalidation generation
+(``api.integrity_snapshot()``), and ``integrity.corruption`` timeline
+records so ``api.explain()`` narrates corruption → breaker.open →
+demotion causally.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import timeline
+from ..obs import trace as obstrace
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import locks
+
+#: Module-level fast-path flags (the established zero-cost pattern): hot
+#: seams test ``integrity.ENABLED`` before calling into the module, so
+#: TEMPI_INTEGRITY=off costs one attribute truth test per copy boundary.
+ENABLED = False
+MODE = "off"
+RETRANSMIT = False
+
+#: Incident-ledger bound: corruption is expected to be RARE; a bounded
+#: ledger keeps the evidence of a bad link without growing in a long
+#: chaos soak (the failure-ring precedent of obs/trace._failures).
+_KEEP = 64
+
+_chunk = 1 << 20
+_incidents: List[dict] = []
+_total = 0
+_lock = locks.named_lock("integrity.ledger")
+
+
+class IntegrityError(RuntimeError):
+    """A wire checksum mismatched at a covered copy boundary: the payload
+    the consumer observed is not the payload the producer checksummed,
+    and the delivery was withheld (staged bytes are never committed to
+    the application buffer, reduction payloads never accumulated, past a
+    failed validation).
+
+    Diagnostics name the corrupted (link, strategy, round/segment) and
+    the mismatching chunk indices — the coordinates the breaker record
+    and the incident ledger share. Like :class:`p2p.WaitTimeout`, the
+    constructor takes a flight-recorder auto-snapshot so every raise
+    site gets the evidence uniformly; it rides the exception as
+    ``.trace`` and lands on disk when TEMPI_TRACE_PATH is set."""
+
+    def __init__(self, site: str, link, strategy: str,
+                 round_: Optional[int] = None,
+                 segment: Optional[int] = None,
+                 nbytes: int = 0, bad_chunks: Sequence[int] = ()):
+        lk = tuple(int(x) for x in link) if link is not None else None
+        where = f"link={lk} strategy={strategy!r}"
+        if round_ is not None:
+            where += f" round={round_}"
+        if segment is not None:
+            where += f" segment={segment}"
+        super().__init__(
+            f"payload corruption detected at {site}: {where} "
+            f"({nbytes}B, bad chunk(s) {list(bad_chunks)}, "
+            f"mode={MODE}) — producer-side checksums did not match the "
+            "bytes at the consumer; the delivery was withheld. The "
+            "failure is recorded against the link's breaker "
+            "(reason=corruption); TEMPI_INTEGRITY=retransmit re-posts "
+            "the exchange/round under TEMPI_RETRY_ATTEMPTS before "
+            "surfacing")
+        self.site = site
+        self.link = lk
+        self.strategy = strategy
+        self.round = round_
+        self.segment = segment
+        self.nbytes = int(nbytes)
+        self.bad_chunks = tuple(int(c) for c in bad_chunks)
+        self.trace = None
+        if obstrace.ENABLED:
+            try:
+                self.trace = obstrace.failure_snapshot(
+                    "integrity", detail=str(self))
+            except Exception:  # noqa: BLE001
+                pass  # evidence capture must never mask the corruption
+
+
+def configure(mode: Optional[str] = None,
+              chunk_bytes: Optional[int] = None) -> None:
+    """(Re)arm from the parsed env (``mode=None`` reads
+    ``env.integrity_mode`` — call after ``read_environment``); explicit
+    arguments override (test convenience). Clears the incident ledger:
+    incidents are session evidence, not cross-configuration state."""
+    global ENABLED, MODE, RETRANSMIT, _chunk, _incidents, _total
+    m = mode if mode is not None else \
+        getattr(envmod.env, "integrity_mode", "off")
+    cb = chunk_bytes if chunk_bytes is not None else \
+        getattr(envmod.env, "integrity_chunk_bytes", 1 << 20)
+    if m not in ("off", "verify", "retransmit"):
+        raise ValueError(
+            f"bad integrity mode {m!r}: want off | verify | retransmit")
+    with _lock:
+        MODE = m
+        RETRANSMIT = m == "retransmit"
+        ENABLED = m != "off"
+        _chunk = max(1, int(cb))
+        _incidents = []
+        _total = 0
+
+
+def _as_bytes(view) -> np.ndarray:
+    """The flat uint8 alias of an array view. Covered seams hand in
+    C-contiguous slices, so this is a true alias (the chaos flip mutates
+    the real in-flight buffer); a non-contiguous input degrades to a
+    copy, which still checksums correctly."""
+    a = np.asarray(view)
+    if a.dtype != np.uint8 or not a.flags.c_contiguous:
+        a = np.ascontiguousarray(a).view(np.uint8)
+    return a.reshape(-1)
+
+
+def checksums(view) -> Tuple[int, Tuple[int, ...]]:
+    """Producer-side segment checksum: ``(nbytes, per-chunk crc32s)``
+    over the raw bytes of ``view``, chunked at
+    ``TEMPI_INTEGRITY_CHUNK_BYTES`` so a mismatch localizes to a chunk
+    index and huge segments never hash as one opaque unit. zlib's crc32
+    is the fast host-side rolling checksum available without new
+    dependencies (the crc32c role). Zero-length segments checksum to
+    ``(0, ())`` and always verify."""
+    raw = _as_bytes(view)
+    mv = memoryview(raw)
+    return (raw.size,
+            tuple(zlib.crc32(mv[off: off + _chunk])
+                  for off in range(0, raw.size, _chunk)))
+
+
+def _mismatched(raw: np.ndarray, expected) -> List[int]:
+    """Chunk indices whose crc differs from ``expected`` (a
+    :func:`checksums` result); a byte-count drift marks every chunk."""
+    nbytes, crcs = expected
+    if raw.size != nbytes:
+        return list(range(max(1, len(crcs))))
+    mv = memoryview(raw)
+    return [i for i, (off, want) in enumerate(
+                zip(range(0, raw.size, _chunk), crcs))
+            if zlib.crc32(mv[off: off + _chunk]) != want]
+
+
+def _record_incident(site: str, link, strategy: str, round_,
+                     segment, nbytes: int, bad, action: str) -> None:
+    """Append one corruption incident to the bounded ledger, stamped with
+    the shared invalidation generation (the join key ``api.explain()``
+    uses to narrate corruption → breaker.open → demotion causally), and
+    mirror it onto the timeline."""
+    from . import invalidation
+    global _total
+    lk = [int(x) for x in link] if link is not None else None
+    with _lock:
+        _total += 1
+        _incidents.append(dict(
+            seq=_total, site=site, link=lk, strategy=strategy,
+            round=round_, segment=segment, nbytes=int(nbytes),
+            bad_chunks=[int(c) for c in bad], action=action,
+            generation=invalidation.GENERATION, time=time.time()))
+        del _incidents[:-_KEEP]
+    timeline.record("integrity.corruption", site=site, link=lk,
+                    strategy=strategy, round=round_, action=action)
+
+
+def verify_delivery(view, expected, *, site: str, link, strategy: str,
+                    round_: Optional[int] = None,
+                    segment: Optional[int] = None,
+                    redo: Optional[Callable[[], None]] = None) -> None:
+    """Consumer-side validation of one covered copy: pass the in-flight
+    ``view`` through the ``integrity.wire`` chaos site, recompute its
+    checksums, and compare against the producer's ``expected``
+    (:func:`checksums` output taken from the SOURCE bytes).
+
+    On mismatch: the corrupt/verified counters move, the (link,
+    strategy) breaker records a ``reason=corruption`` failure, the
+    incident lands in the ledger, and — in ``retransmit`` mode with a
+    ``redo`` callable (the in-place re-copy seams: plan.run_staged's
+    staging rows) — the copy is re-executed and re-verified up to
+    ``TEMPI_RETRY_ATTEMPTS`` times with ``TEMPI_RETRY_BACKOFF_S``
+    doubling backoff before :class:`IntegrityError` surfaces. Seams
+    whose enclosing round loop already re-dispatches idempotently (the
+    persistent collective/reduction rounds) pass ``redo=None`` and let
+    :func:`allow_round_retry` route the raise into that loop instead.
+
+    Callers guard with ``integrity.ENABLED``."""
+    from . import faults
+    from . import health
+    attempts = int(envmod.env.retry_attempts) \
+        if (RETRANSMIT and redo is not None) else 0
+    t0 = time.monotonic() if obstrace.ENABLED else 0.0
+    lk = tuple(int(x) for x in link) if link is not None else None
+    attempt = 0
+    while True:
+        if faults.ENABLED:
+            # the in-flight buffer site: raise/delay chaos via check(),
+            # seeded byte flips via the corrupt kind — applied to the
+            # very bytes the validation below must catch
+            faults.check("integrity.wire")
+            faults.corrupt_bytes("integrity.wire", _as_bytes(view))
+        ig = ctr.counters.integrity
+        ig.num_checked += 1
+        raw = _as_bytes(view)
+        bad = _mismatched(raw, expected)
+        if not bad:
+            ig.num_verified += 1
+            ig.checked_bytes += raw.size
+            if obstrace.ENABLED:
+                obstrace.emit_span("integrity.verify", t0, site=site,
+                                   nbytes=int(raw.size), ok=True,
+                                   retransmits=attempt)
+            return
+        ig.num_corrupt += 1
+        _record_incident(site, lk, strategy, round_, segment, raw.size,
+                         bad, "retransmit" if attempt < attempts
+                         else "surface")
+        if lk is not None:
+            health.record_failure(lk, strategy, error=f"corruption at "
+                                  f"{site} (chunks {bad})",
+                                  reason="corruption")
+        if attempt >= attempts:
+            if obstrace.ENABLED:
+                obstrace.emit_span("integrity.verify", t0, site=site,
+                                   nbytes=int(raw.size), ok=False,
+                                   retransmits=attempt)
+            raise IntegrityError(site, lk, strategy, round_=round_,
+                                 segment=segment, nbytes=raw.size,
+                                 bad_chunks=bad)
+        attempt += 1
+        ig.num_retransmits += 1
+        if obstrace.ENABLED:
+            obstrace.emit("integrity.retransmit", site=site,
+                          link=list(lk) if lk else None,
+                          strategy=strategy, attempt=attempt)
+        delay = envmod.env.retry_backoff_s * (2 ** (attempt - 1))
+        if delay > 0:
+            time.sleep(delay)
+        redo()
+
+
+def allow_round_retry(exc: BaseException) -> bool:
+    """The per-round ``TEMPI_RETRY_ATTEMPTS`` loops' integrity gate.
+
+    Those loops catch ANY exception and re-dispatch the round — which is
+    exactly retransmission for a detected corruption (the lowerings
+    rebuild host staging from the unmodified device input), but must NOT
+    swallow an :class:`IntegrityError` in ``verify`` mode, whose
+    contract is detect-and-surface. Returns True when the loop may
+    retry; counts the re-dispatch as a retransmit when it is one."""
+    if not isinstance(exc, IntegrityError):
+        return True
+    if RETRANSMIT:
+        ctr.counters.integrity.num_retransmits += 1
+        if obstrace.ENABLED:
+            obstrace.emit("integrity.retransmit", site=exc.site,
+                          link=list(exc.link) if exc.link else None,
+                          strategy=exc.strategy, attempt=0)
+        return True
+    return False
+
+
+def snapshot() -> dict:
+    """The bounded corruption-incident ledger plus mode/config, joined to
+    the shared invalidation generation (each incident carries the
+    generation current when it was detected — the key ``api.explain()``
+    correlates with breaker opens and demotions). Pure data — safe to
+    serialize. Callable before init and after finalize (reads empty)."""
+    from . import invalidation
+    with _lock:
+        return dict(mode=MODE, chunk_bytes=_chunk,
+                    generation=invalidation.GENERATION,
+                    total_incidents=_total,
+                    incidents=[dict(i) for i in _incidents])

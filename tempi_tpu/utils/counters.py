@@ -210,6 +210,20 @@ class LockCheckCounters:
 
 
 @dataclass
+class IntegrityCounters:
+    # end-to-end payload integrity (ISSUE 17; runtime/integrity.py):
+    # pinned at zero with TEMPI_INTEGRITY unset — the counter-based
+    # byte-for-byte guard that the off path checksums and verifies
+    # nothing
+    num_checked: int = 0      # covered copy deliveries validated
+    num_verified: int = 0     # deliveries whose checksums matched
+    num_corrupt: int = 0      # checksum mismatches detected
+    num_retransmits: int = 0  # re-deliveries (in-place redo copies and
+                              # round re-dispatches) driven by a mismatch
+    checked_bytes: int = 0    # payload bytes that passed verification
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -240,6 +254,7 @@ class Counters:
     elastic: ElasticCounters = field(default_factory=ElasticCounters)
     autopilot: AutopilotCounters = field(default_factory=AutopilotCounters)
     lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
+    integrity: IntegrityCounters = field(default_factory=IntegrityCounters)
 
     def as_dict(self) -> dict:
         out = {}

@@ -371,6 +371,29 @@ section):
                          ``log`` records and warns once per inverted
                          pair, then continues (production triage).
 
+End-to-end data integrity knobs (ISSUE 17; see runtime/integrity.py and
+the README "Data integrity" section):
+  TEMPI_INTEGRITY      = off | verify | retransmit — end-to-end payload
+                         verification at every framework-performed copy
+                         boundary (default off = one module-flag truth
+                         test per seam, integrity counters pinned at
+                         zero, byte-for-byte the unverified transport).
+                         ``verify`` checksums every covered copy at the
+                         producer and validates at the consumer BEFORE
+                         delivery/accumulation; a mismatch raises
+                         IntegrityError naming the corrupted (link,
+                         strategy, round) and records a
+                         reason=corruption breaker failure.
+                         ``retransmit`` additionally re-posts the
+                         affected exchange/round through the existing
+                         TEMPI_RETRY_ATTEMPTS machinery before
+                         surfacing.
+  TEMPI_INTEGRITY_CHUNK_BYTES  checksum chunk granularity in bytes: a
+                         segment larger than this hashes as several
+                         chunks so a mismatch localizes (default 1 MiB;
+                         zero/negative rejected loudly — a zero chunk
+                         would loop forever carving empty slices)
+
 Per-call boolean/integer escape hatches read OUTSIDE read_environment
 (consulted at call time so tests and benches can flip them mid-session;
 loud-parsed via bool_env/int_env below):
@@ -399,6 +422,7 @@ stall-forever/race-unchecked behavior the knob exists to prevent).
 from __future__ import annotations
 
 import enum
+import math
 import os
 from dataclasses import dataclass, field
 
@@ -498,6 +522,9 @@ KNOWN_KNOBS = (
     "TEMPI_STEP_FUSE",
     # correctness tooling (ISSUE 11)
     "TEMPI_LOCKCHECK",
+    # end-to-end data integrity (ISSUE 17)
+    "TEMPI_INTEGRITY",
+    "TEMPI_INTEGRITY_CHUNK_BYTES",
     # multi-host world coordinates (parallel/multihost.py)
     "TEMPI_COORDINATOR",
     "TEMPI_NUM_PROCESSES",
@@ -674,6 +701,9 @@ class Environment:
     step_fuse: bool = True         # cross-batch pack fusion in a step
     # lock-order race detector (ISSUE 11) — see utils/locks.py
     lockcheck_mode: str = "off"    # off | assert | log
+    # end-to-end payload integrity (ISSUE 17) — see runtime/integrity.py
+    integrity_mode: str = "off"    # off | verify | retransmit
+    integrity_chunk_bytes: int = 1 << 20  # checksum chunk granularity
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -771,12 +801,16 @@ class Environment:
                 f = float(v) if v else default
             except ValueError as exc:
                 raise ValueError(
-                    f"bad {name}={v!r}: want a non-negative number "
-                    f"({unit})") from exc
-            if f < 0:
+                    f"bad {name}={v!r}: want a finite non-negative "
+                    f"number ({unit})") from exc
+            if not math.isfinite(f) or f < 0:
+                # float() happily parses "nan"/"inf"/"-inf", and every
+                # non-finite value corrupts the arithmetic downstream
+                # (nan compares False against any deadline; inf backoffs
+                # sleep forever) — refuse as loudly as negatives
                 raise ValueError(
-                    f"bad {name}={v!r}: want a non-negative number "
-                    f"({unit})")
+                    f"bad {name}={v!r}: want a finite non-negative "
+                    f"number ({unit})")
             return f
 
         def _pos_int_env(name: str, default: int) -> int:
@@ -991,12 +1025,14 @@ class Environment:
             raise ValueError(
                 f"bad TEMPI_REPLACE_PENALTY={v!r}: want a multiplier "
                 ">= 1") from exc
-        if pen < 1.0:
+        if not math.isfinite(pen) or pen < 1.0:
             # a penalty below 1 DISCOUNTS degraded links, steering the
-            # re-placement toward the very hardware it should avoid
+            # re-placement toward the very hardware it should avoid; a
+            # non-finite one (float() parses "nan"/"inf") poisons every
+            # live-cost sum it multiplies into
             raise ValueError(
-                f"bad TEMPI_REPLACE_PENALTY={v!r}: want a multiplier "
-                ">= 1 (values below 1 reward degraded links)")
+                f"bad TEMPI_REPLACE_PENALTY={v!r}: want a finite "
+                "multiplier >= 1 (values below 1 reward degraded links)")
         e.replace_penalty = pen
 
         # fault-tolerance knobs parse loudly too: a typo'd TEMPI_FT
@@ -1090,6 +1126,32 @@ class Environment:
                 f"bad TEMPI_LOCKCHECK={lc!r}: want off | assert | log")
         e.lockcheck_mode = lc
 
+        # integrity knobs parse loudly too: a typo'd TEMPI_INTEGRITY
+        # silently staying off would run the one deployment that asked
+        # for payload verification with the transport unchecked — a
+        # byte-wrong delivery passing straight through
+        im = (getenv("TEMPI_INTEGRITY") or "off").lower()
+        if im not in ("off", "verify", "retransmit"):
+            raise ValueError(
+                f"bad TEMPI_INTEGRITY={im!r}: want off | verify | "
+                "retransmit")
+        e.integrity_mode = im
+        v = getenv("TEMPI_INTEGRITY_CHUNK_BYTES")
+        try:
+            cb = int(v) if v else 1 << 20
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_INTEGRITY_CHUNK_BYTES={v!r}: want a positive "
+                "integer (bytes)") from exc
+        if cb <= 0:
+            # no silent clamp: a zero chunk would carve empty slices
+            # forever; a negative one would checksum nothing — loud
+            # refusal, like TEMPI_TRACE_EVENTS
+            raise ValueError(
+                f"bad TEMPI_INTEGRITY_CHUNK_BYTES={v!r}: want a positive "
+                "integer (bytes)")
+        e.integrity_chunk_bytes = cb
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -1142,6 +1204,10 @@ class Environment:
             # re-issue path — the bail-out measures the baseline engine,
             # not the framework's fused replay
             e.step_mode = "off"
+            # ...and payload verification: the bail-out's exchanges are
+            # the library's own lowerings — there is no framework-
+            # performed copy boundary left to checksum
+            e.integrity_mode = "off"
             # TEMPI_LOCKCHECK deliberately survives the bail-out: the
             # lock-order checker observes the framework's own locks (which
             # exist regardless of interposition) and is developer tooling,

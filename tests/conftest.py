@@ -74,6 +74,11 @@ def pytest_configure(config):
         "autopilot: SLO-autopilot tests — hysteresis primitives, "
         "act/observe decision equivalence, quarantine/shrink/grow/QoS "
         "actuation (the <30s smoke is `pytest -m autopilot`)")
+    config.addinivalue_line(
+        "markers",
+        "integrity: end-to-end payload integrity tests — checksum "
+        "properties, seeded corruption chaos, verified retransmit (the "
+        "<30s smoke is `pytest -m integrity`)")
 
 
 @pytest.fixture(autouse=True)
@@ -85,7 +90,7 @@ def _reset_globals():
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import (autopilot, elastic, faults, health,
-                                   liveness, qos)
+                                   integrity, liveness, qos)
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env, locks
 
@@ -101,6 +106,7 @@ def _reset_globals():
     liveness.configure()
     elastic.configure()
     autopilot.configure()
+    integrity.configure()
     counters.init()
     health.reset()
     yield
@@ -118,4 +124,5 @@ def _reset_globals():
     liveness.configure("off")
     elastic.configure("off")
     autopilot.disarm()
+    integrity.configure("off")
     locks.configure("off")

@@ -381,9 +381,12 @@ def test_dump_names_are_rank_stamped(tmp_path):
         with open(out) as f:
             doc = json.load(f)
         assert doc["otherData"]["process"]["rank"] == 3
-        # auto-snapshots get the same stamp
+        # auto-snapshots get the same stamp, plus the pid (ISSUE 17
+        # satellite: two local processes sharing one path must not
+        # clobber even before a rank is known)
         snap = trace.failure_snapshot("test-reason", "detail")
-        assert "-r3-test-reason-" in os.path.basename(snap["path"])
+        assert f"-r3-p{os.getpid()}-test-reason-" \
+            in os.path.basename(snap["path"])
     finally:
         trace.configure("off")
 

@@ -1,0 +1,548 @@
+"""End-to-end payload integrity (ISSUE 17): the checksum helpers'
+properties, the ``corrupt`` fault kind's seeded determinism, the off-mode
+byte-for-byte pins, and the detect/retransmit acceptance stories across
+every covered seam — eager p2p staging, the persistent alltoallv
+lowerings, and the reduction rounds.
+
+Marker ``integrity`` is the tier-1-compatible <30s smoke (`pytest -m
+integrity`); the chaos variants are dual-marked ``faults`` so the
+TEMPI_LOCKCHECK=assert chaos smoke exercises the ``integrity.wire`` site
+and the verified-retransmit recovery under lock-order checking."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.obs import trace as obstrace
+from tempi_tpu.ops import dtypes as dt
+from tempi_tpu.parallel import p2p
+from tempi_tpu.runtime import faults, health, integrity
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+from tempi_tpu.utils.env import AlltoallvMethod
+
+pytestmark = pytest.mark.integrity
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def _bf16():
+    import jax.numpy as jnp
+    return np.dtype(jnp.bfloat16)
+
+
+TY = lambda: dt.contiguous(64, dt.BYTE)  # noqa: E731
+
+
+def _post_pair(world, it=0, tag=0):
+    """One send/recv pair with a verifiable payload (the test_faults
+    shape); returns (reqs, rbuf, expected_row, receiver)."""
+    size = world.size
+    src, dst = it % size, (it + 1) % size
+    row = np.full(64, (it % 250) + 1, np.uint8)
+    sbuf = world.buffer_from_host(
+        [row if r == src else np.zeros(64, np.uint8) for r in range(size)])
+    rbuf = world.alloc(64)
+    reqs = [p2p.isend(world, src, sbuf, dst, TY(), tag=tag),
+            p2p.irecv(world, dst, rbuf, src, TY(), tag=tag)]
+    return reqs, rbuf, row, dst
+
+
+def make_case(comm, seed=0, hi=32, density=0.7):
+    """Random sparse alltoallv counts + packed buffers + python oracle
+    (the test_coll shape)."""
+    size = comm.size
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, hi, (size, size))
+    counts[rng.random((size, size)) > density] = 0
+    sdispls = np.zeros_like(counts)
+    rdispls = np.zeros_like(counts)
+    recvcounts = counts.T.copy()
+    for r in range(size):
+        sdispls[r] = np.concatenate([[0], np.cumsum(counts[r])[:-1]])
+        rdispls[r] = np.concatenate([[0], np.cumsum(recvcounts[r])[:-1]])
+    nb_s = max(1, int(counts.sum(1).max()))
+    nb_r = max(1, int(recvcounts.sum(1).max()))
+    rows = [rng.integers(0, 256, nb_s, np.uint8) for _ in range(size)]
+    sendbuf = comm.buffer_from_host(rows)
+    recvbuf = comm.alloc(nb_r)
+    want = [np.zeros(nb_r, np.uint8) for _ in range(size)]
+    for s in range(size):
+        for d in range(size):
+            n = counts[s, d]
+            if n:
+                want[d][rdispls[d, s]: rdispls[d, s] + n] = \
+                    rows[s][sdispls[s, d]: sdispls[s, d] + n]
+    return counts, sdispls, recvcounts, rdispls, sendbuf, recvbuf, want
+
+
+def _check(comm, recvbuf, want):
+    for r in range(comm.size):
+        np.testing.assert_array_equal(recvbuf.get_rank(r), want[r])
+
+
+# -- checksum helper properties (no mesh) -------------------------------------
+
+
+@pytest.mark.parametrize("dtype,_label", [
+    (np.float32, "f32"), ("bf16", "bf16"), (np.int32, "i32")])
+def test_checksums_detect_any_single_byte_flip(dtype, _label):
+    """Property: for every covered dtype, flipping ANY single byte of a
+    payload changes its checksum — and the pristine copy always
+    verifies. Small chunk size so the sweep crosses chunk boundaries."""
+    if dtype == "bf16":
+        dtype = _bf16()
+    integrity.configure("verify", chunk_bytes=16)
+    rng = np.random.default_rng(7)
+    arr = rng.integers(1, 100, 37).astype(dtype)  # 37 elems: ragged tail
+    expected = integrity.checksums(arr)
+    nbytes, crcs = expected
+    assert nbytes == arr.nbytes
+    assert len(crcs) == -(-arr.nbytes // 16)  # ceil-div chunk count
+    assert integrity._mismatched(integrity._as_bytes(arr), expected) == []
+    for pos in range(arr.nbytes):
+        bad = arr.copy()
+        raw = bad.view(np.uint8).reshape(-1)
+        raw[pos] ^= 0x5A
+        got = integrity._mismatched(integrity._as_bytes(bad), expected)
+        # the mismatch localizes to exactly the flipped byte's chunk
+        assert got == [pos // 16], f"flip at byte {pos} missed"
+
+
+def test_checksums_zero_length_and_ragged_segments():
+    """Zero-length segments checksum to (0, ()) and always verify;
+    ragged segment lengths (including straddling the chunk size by one
+    byte either way) round-trip."""
+    integrity.configure("verify", chunk_bytes=8)
+    empty = np.zeros(0, np.uint8)
+    assert integrity.checksums(empty) == (0, ())
+    assert integrity._mismatched(integrity._as_bytes(empty), (0, ())) == []
+    for n in (1, 7, 8, 9, 15, 16, 17, 64):
+        seg = np.arange(n, dtype=np.uint8)
+        exp = integrity.checksums(seg)
+        assert exp[0] == n
+        assert integrity._mismatched(integrity._as_bytes(seg), exp) == []
+    # byte-count drift (truncated delivery) marks every chunk
+    seg = np.arange(24, dtype=np.uint8)
+    exp = integrity.checksums(seg)
+    got = integrity._mismatched(integrity._as_bytes(seg[:16]), exp)
+    assert got == [0, 1, 2]
+
+
+def test_verify_delivery_passes_clean_and_counts():
+    integrity.configure("verify", chunk_bytes=32)
+    arr = np.arange(100, dtype=np.uint8)
+    integrity.verify_delivery(arr, integrity.checksums(arr),
+                              site="p2p.staged_copy", link=(0, 1),
+                              strategy="staged", round_=0)
+    ig = ctr.counters.integrity
+    assert ig.num_checked == 1 and ig.num_verified == 1
+    assert ig.num_corrupt == 0 and ig.checked_bytes == 100
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="bad integrity mode"):
+        integrity.configure("paranoid")
+
+
+# -- env knobs (satellites) ---------------------------------------------------
+
+
+def test_integrity_knobs_parse(monkeypatch):
+    monkeypatch.setenv("TEMPI_INTEGRITY", "VERIFY")  # case-insensitive
+    monkeypatch.setenv("TEMPI_INTEGRITY_CHUNK_BYTES", "4096")
+    e = envmod.read_environment()
+    assert e.integrity_mode == "verify"
+    assert e.integrity_chunk_bytes == 4096
+    integrity.configure()  # arms from the parsed env
+    assert integrity.ENABLED and integrity.MODE == "verify"
+    assert not integrity.RETRANSMIT
+    assert integrity._chunk == 4096
+    monkeypatch.setenv("TEMPI_INTEGRITY", "retransmit")
+    envmod.read_environment()
+    integrity.configure()
+    assert integrity.RETRANSMIT
+
+
+def test_integrity_knobs_reject_garbage(monkeypatch):
+    monkeypatch.setenv("TEMPI_INTEGRITY", "vreify")
+    with pytest.raises(ValueError, match="TEMPI_INTEGRITY"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_INTEGRITY", "verify")
+    for bad in ("0", "-4096", "big"):
+        monkeypatch.setenv("TEMPI_INTEGRITY_CHUNK_BYTES", bad)
+        with pytest.raises(ValueError, match="TEMPI_INTEGRITY_CHUNK_BYTES"):
+            envmod.read_environment()
+
+
+def test_api_init_arms_integrity_from_env(monkeypatch):
+    """The env knob must reach the runtime through api.init() itself —
+    not only through the test harness's configure calls."""
+    monkeypatch.setenv("TEMPI_INTEGRITY", "verify")
+    api.init()
+    try:
+        assert integrity.ENABLED and integrity.MODE == "verify"
+    finally:
+        api.finalize()
+
+
+def test_no_tempi_forces_integrity_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_INTEGRITY", "verify")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    e = envmod.read_environment()
+    assert e.integrity_mode == "off"
+
+
+@pytest.mark.parametrize("knob", [
+    "TEMPI_WAIT_TIMEOUT_S", "TEMPI_RETRY_BACKOFF_S", "TEMPI_FAULT_DELAY_S",
+    "TEMPI_INIT_BACKOFF_S", "TEMPI_BREAKER_COOLDOWN_S",
+    "TEMPI_PUMP_HEARTBEAT_S", "TEMPI_FT_HEARTBEAT_S", "TEMPI_SLO_P99_MS",
+    "TEMPI_TUNE_DRIFT", "TEMPI_REPLACE_MIN_GAIN"])
+@pytest.mark.parametrize("bad", ["nan", "inf", "-inf", "NaN", "Infinity"])
+def test_float_knobs_reject_non_finite(monkeypatch, knob, bad):
+    """Satellite regression: float() happily parses "nan"/"inf", and a
+    non-finite deadline/backoff/ratio corrupts downstream arithmetic
+    silently (nan compares False against every deadline) — the loud
+    parsers must refuse, naming the knob."""
+    monkeypatch.setenv(knob, bad)
+    with pytest.raises(ValueError, match=knob):
+        envmod.read_environment()
+
+
+@pytest.mark.parametrize("bad", ["nan", "inf", "-inf"])
+def test_replace_penalty_rejects_non_finite(monkeypatch, bad):
+    monkeypatch.setenv("TEMPI_REPLACE_PENALTY", bad)
+    with pytest.raises(ValueError, match="TEMPI_REPLACE_PENALTY"):
+        envmod.read_environment()
+
+
+# -- the corrupt fault kind ---------------------------------------------------
+
+
+def test_corrupt_spec_refused_outside_wire_sites():
+    """corrupt is only meaningful where a buffer is handed to
+    corrupt_bytes(); anywhere else an armed entry would fire and flip
+    nothing — the quiet-chaos outcome the spec parser rejects."""
+    for site in ("p2p.post", "p2p.staged_copy", "coll.round",
+                 "redcoll.round"):
+        with pytest.raises(faults.FaultSpecError, match="not supported"):
+            faults.configure(f"{site}:corrupt:1.0:1")
+    faults.configure("integrity.wire:corrupt:1.0:1")  # the one buffer site
+    with pytest.raises(faults.FaultSpecError, match="not supported"):
+        faults.configure("integrity.wire:wedge:1.0:1")  # progress lock
+    faults.configure("integrity.wire:raise:1.0:1")  # raise/delay stay fine
+    faults.reset()
+
+
+def test_corrupt_bytes_seeded_determinism(world):
+    """The reproduction contract, exercised with the background pump
+    running (api.init's pump passes through its own sites but must not
+    perturb the corrupt entry's private rng): two identically-seeded
+    arming cycles flip the same (position, mask) sequence, and a fired
+    flip is a guaranteed byte change."""
+    def run():
+        faults.configure("integrity.wire:corrupt:0.6:42")
+        out = []
+        for _ in range(12):
+            buf = np.zeros(64, np.uint8)
+            faults.corrupt_bytes("integrity.wire", buf)
+            out.append(buf.copy())
+        st = faults.stats()["integrity.wire"][0]
+        return out, st["passes"], st["fired_passes"]
+
+    a, passes_a, fired_a = run()
+    b, passes_b, fired_b = run()
+    assert passes_a == passes_b == 12
+    assert fired_a == fired_b and len(fired_a) > 0
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # a fired pass changed the buffer (the non-zero mask guarantee)
+    assert any(bool(x.any()) for x in a)
+
+
+def test_check_skips_corrupt_entries():
+    """check() passes must not advance a corrupt entry's pass counter or
+    draw from its rng: the (seed, pass number) sequence is a pure
+    function of corrupt_bytes passes alone, even at a site that also
+    runs check() for co-armed raise/delay entries."""
+    faults.configure("integrity.wire:corrupt:1.0:9")
+    for _ in range(5):
+        faults.check("integrity.wire")
+    st = faults.stats()["integrity.wire"][0]
+    assert st["passes"] == 0 and st["fired"] == 0
+    buf = np.zeros(8, np.uint8)
+    assert faults.corrupt_bytes("integrity.wire", buf) == 1
+    assert faults.stats()["integrity.wire"][0]["passes"] == 1
+
+
+def test_corrupt_zero_length_buffer_draws_but_cannot_flip():
+    faults.configure("integrity.wire:corrupt:1.0:3")
+    assert faults.corrupt_bytes("integrity.wire",
+                                np.zeros(0, np.uint8)) == 0
+    assert faults.stats()["integrity.wire"][0]["passes"] == 1
+
+
+# -- off mode: inert and counter-pinned ---------------------------------------
+
+
+def test_off_mode_is_inert_and_counter_pinned(world):
+    """The byte-for-byte contract: with TEMPI_INTEGRITY unset the seams
+    cost one module-flag truth test — no checksums, no counters, no
+    incidents — across eager p2p, a persistent alltoallv, and an
+    allreduce; and an armed corrupt entry never fires because nothing
+    hands it a buffer."""
+    assert not integrity.ENABLED
+    faults.configure("integrity.wire:corrupt:1.0:1")
+    reqs, rbuf, row, dst = _post_pair(world, it=0, tag=3)
+    p2p.waitall(reqs, strategy="staged")
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    counts, sd, rc, rd, sbuf, rb, want = make_case(world, seed=2)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rb, rc, rd,
+                            method=AlltoallvMethod.STAGED)
+    pc.start()
+    pc.wait()
+    _check(world, rb, want)
+    envmod.env.redcoll = "ring"
+    n = 16
+    vals = [np.arange(n, dtype=np.float32) + r for r in range(world.size)]
+    buf = world.buffer_from_host(
+        [np.ascontiguousarray(v).view(np.uint8).copy() for v in vals])
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    ig = ctr.counters.integrity
+    assert (ig.num_checked, ig.num_verified, ig.num_corrupt,
+            ig.num_retransmits, ig.checked_bytes) == (0, 0, 0, 0, 0)
+    assert faults.stats()["integrity.wire"][0]["passes"] == 0
+    snap = api.integrity_snapshot()
+    assert snap["mode"] == "off" and snap["incidents"] == []
+    assert snap["total_incidents"] == 0
+
+
+# -- verify mode: clean traffic and the detection story -----------------------
+
+
+def test_verify_mode_clean_traffic_counts_and_delivers(world):
+    """Healthy payloads under verify: byte-exact delivery everywhere,
+    every check verified, zero corrupt/retransmits."""
+    integrity.configure("verify")
+    reqs, rbuf, row, dst = _post_pair(world, it=1, tag=4)
+    p2p.waitall(reqs, strategy="staged")
+    np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    counts, sd, rc, rd, sbuf, rb, want = make_case(world, seed=3)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rb, rc, rd,
+                            method=AlltoallvMethod.STAGED)
+    pc.start()
+    pc.wait()
+    _check(world, rb, want)
+    envmod.env.redcoll = "halving"
+    n = 16
+    vals = [np.arange(n, dtype=np.float32) + r for r in range(world.size)]
+    buf = world.buffer_from_host(
+        [np.ascontiguousarray(v).view(np.uint8).copy() for v in vals])
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    want_r = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        got = buf.get_rank(r)[: n * 4].view(np.float32)
+        np.testing.assert_array_equal(got, want_r)
+    ig = ctr.counters.integrity
+    assert ig.num_checked > 0
+    assert ig.num_verified == ig.num_checked
+    assert ig.num_corrupt == 0 and ig.num_retransmits == 0
+    assert ig.checked_bytes > 0
+
+
+@pytest.mark.faults
+def test_verify_mode_raises_naming_link_strategy_round(world):
+    """Acceptance: a seeded flip on the staged p2p wire raises
+    IntegrityError naming the corrupted (link, strategy, round), feeds
+    the (link, strategy) breaker a reason=corruption failure, and lands
+    a generation-stamped incident in the ledger."""
+    integrity.configure("verify")
+    faults.configure("integrity.wire:corrupt:1.0:11")
+    reqs, rbuf, row, dst = _post_pair(world, it=2, tag=5)
+    with pytest.raises(integrity.IntegrityError) as ei:
+        p2p.waitall(reqs, strategy="staged")
+    e = ei.value
+    assert e.site == "p2p.staged_copy"
+    assert e.strategy == "staged" and e.round is not None
+    assert e.link is not None and len(e.link) == 2
+    assert "corruption" in str(e) and "withheld" in str(e)
+    ig = ctr.counters.integrity
+    assert ig.num_corrupt >= 1 and ig.num_retransmits == 0
+    snap = api.integrity_snapshot()
+    assert snap["total_incidents"] >= 1
+    inc = snap["incidents"][0]
+    assert inc["site"] == "p2p.staged_copy"
+    assert inc["action"] == "surface"
+    assert inc["generation"] == snap["generation"]
+    # the breaker carries the failure CLASS
+    hs = health.snapshot()
+    assert any(b["last_reason"] == "corruption" for b in hs["breakers"])
+
+
+@pytest.mark.faults
+def test_verify_mode_surfaces_through_round_retry_loop(world, monkeypatch):
+    """verify's contract is detect-and-surface: the per-round retry loop
+    must NOT swallow an IntegrityError even with retries armed (only
+    retransmit mode rides that loop)."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "8")
+    envmod.read_environment()
+    integrity.configure("verify")
+    faults.configure("integrity.wire:corrupt:1.0:13")
+    counts, sd, rc, rd, sbuf, rb, want = make_case(world, seed=4)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rb, rc, rd,
+                            method=AlltoallvMethod.STAGED)
+    with pytest.raises(integrity.IntegrityError):
+        pc.start()
+    assert ctr.counters.integrity.num_retransmits == 0
+    faults.reset()  # chaos clears; the handle must still deliver
+    pc.start()
+    pc.wait()
+    _check(world, rb, want)
+
+
+@pytest.mark.faults
+def test_corruption_narrated_causally_in_explain(world, monkeypatch):
+    """The explain() join: a detected corruption records an
+    integrity.corruption timeline event, and the breaker it fed opens
+    with reason=corruption at the same generation — the causal story
+    corruption -> breaker.open reads from one ledger."""
+    monkeypatch.setenv("TEMPI_BREAKER_THRESHOLD", "1")
+    envmod.read_environment()
+    integrity.configure("verify")
+    faults.configure("integrity.wire:corrupt:1.0:17")
+    reqs, _, _, _ = _post_pair(world, it=3, tag=6)
+    with pytest.raises(integrity.IntegrityError):
+        p2p.waitall(reqs, strategy="staged")
+    story = api.explain()
+    kinds = [ev["kind"] for ev in story["events"]]
+    assert "integrity.corruption" in kinds
+    corr = next(ev for ev in story["events"]
+                if ev["kind"] == "integrity.corruption")
+    opens = [ev for ev in story["events"] if ev["kind"] == "breaker.open"
+             and ev.get("reason") == "corruption"]
+    assert opens and opens[0]["seq"] > corr["seq"]
+    assert opens[0]["generation"] == corr["generation"]
+
+
+@pytest.mark.faults
+def test_integrity_error_auto_snapshot_is_pid_stamped(world, tmp_path):
+    """IntegrityError takes a WaitTimeout-style flight-recorder
+    auto-snapshot; the on-disk stem carries rank AND pid (the ISSUE 17
+    satellite: co-located processes must not clobber each other's
+    evidence)."""
+    obstrace.configure("flight", capacity=64, path=str(tmp_path))
+    integrity.configure("verify")
+    faults.configure("integrity.wire:corrupt:1.0:19")
+    reqs, _, _, _ = _post_pair(world, it=4, tag=7)
+    with pytest.raises(integrity.IntegrityError) as ei:
+        p2p.waitall(reqs, strategy="staged")
+    snap = ei.value.trace
+    assert snap is not None and snap["path"]
+    base = os.path.basename(snap["path"])
+    assert f"-p{os.getpid()}-integrity-" in base
+    assert os.path.exists(snap["path"])
+
+
+# -- retransmit mode: verified recovery ---------------------------------------
+
+
+@pytest.mark.faults
+def test_retransmit_eager_p2p_byte_exact(world, monkeypatch):
+    """Acceptance: under seeded wire corruption, retransmit mode re-copies
+    the affected staging rows in place (TEMPI_RETRY_ATTEMPTS budget) and
+    the application still receives byte-exact payloads."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "10")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    integrity.configure("retransmit")
+    faults.configure("integrity.wire:corrupt:0.5:23")
+    for it in range(4):
+        reqs, rbuf, row, dst = _post_pair(world, it=it, tag=20 + it)
+        p2p.waitall(reqs, strategy="staged")
+        np.testing.assert_array_equal(rbuf.get_rank(dst), row)
+    ig = ctr.counters.integrity
+    assert ig.num_corrupt >= 1      # chaos actually fired...
+    assert ig.num_retransmits >= 1  # ...and recovery actually ran
+    assert ig.num_verified >= 1
+    snap = api.integrity_snapshot()
+    assert any(i["action"] == "retransmit" for i in snap["incidents"])
+
+
+@pytest.mark.faults
+def test_retransmit_persistent_alltoallv_byte_exact(world, monkeypatch):
+    """The staged collective lowering retransmits per segment in place
+    (one flaky segment never forces the whole round back through
+    verification) and the delivery stays byte-exact across a start and
+    a replay."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "10")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    integrity.configure("retransmit")
+    faults.configure("integrity.wire:corrupt:0.3:29")
+    counts, sd, rc, rd, sbuf, rb, want = make_case(world, seed=5)
+    pc = api.alltoallv_init(world, sbuf, counts, sd, rb, rc, rd,
+                            method=AlltoallvMethod.STAGED)
+    for _ in range(2):  # first start and a replay both recover
+        pc.start()
+        pc.wait()
+        _check(world, rb, want)
+    ig = ctr.counters.integrity
+    assert ig.num_corrupt >= 1 and ig.num_retransmits >= 1
+
+
+@pytest.mark.faults
+def test_retransmit_allreduce_byte_exact(world, monkeypatch):
+    """Reduction-round payloads (the redcoll.apply wire) retransmit from
+    the pristine work buffer before the elementwise op accumulates —
+    the result stays byte-exact vs the dense reference."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "10")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    integrity.configure("retransmit")
+    faults.configure("integrity.wire:corrupt:0.4:31")
+    envmod.env.redcoll = "ring"
+    n = 24
+    vals = [np.arange(n, dtype=np.float32) + r for r in range(world.size)]
+    buf = world.buffer_from_host(
+        [np.ascontiguousarray(v).view(np.uint8).copy() for v in vals])
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        got = buf.get_rank(r)[: n * 4].view(np.float32)
+        np.testing.assert_array_equal(got, want)
+    ig = ctr.counters.integrity
+    assert ig.num_corrupt >= 1 and ig.num_retransmits >= 1
+
+
+@pytest.mark.faults
+def test_retransmit_exhaustion_surfaces_with_incident_trail(world,
+                                                            monkeypatch):
+    """A wire corrupted on EVERY pass exhausts the retransmit budget and
+    surfaces IntegrityError; the ledger shows the retransmit attempts
+    before the surface."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "2")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    integrity.configure("retransmit")
+    faults.configure("integrity.wire:corrupt:1.0:37")
+    reqs, _, _, _ = _post_pair(world, it=5, tag=30)
+    with pytest.raises(integrity.IntegrityError) as ei:
+        p2p.waitall(reqs, strategy="staged")
+    assert "retransmit" in str(ei.value)
+    snap = api.integrity_snapshot()
+    actions = [i["action"] for i in snap["incidents"]]
+    assert "retransmit" in actions and actions[-1] == "surface"
+    assert ctr.counters.integrity.num_retransmits >= 2
