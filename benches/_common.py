@@ -94,6 +94,25 @@ def bench_kwargs(quick: bool, throughput: bool = False) -> dict:
     return {}
 
 
+def percentiles(xs, qs=(50, 99)):
+    """Request-latency percentiles over one record's samples (ISSUE 18
+    satellite — the p50/p99 pattern bench_qos grew privately, shared so
+    every request-shaped bench reports tails the same way). Returns one
+    float per requested percentile; empty input reads as zeros so a
+    scenario that completed nothing still emits a well-formed CSV row."""
+    import numpy as np
+
+    if not xs:
+        return tuple(0.0 for _ in qs)
+    v = np.asarray(xs, dtype=np.float64)
+    return tuple(float(np.percentile(v, q)) for q in qs)
+
+
+def p50_p99(xs):
+    """The common two-tail shorthand: ``(p50, p99)`` of ``xs``."""
+    return percentiles(xs, (50, 99))
+
+
 def report_counters(file=None, reset: bool = False) -> None:
     """Per-run counter report (ISSUE 3 satellite): every nonzero framework
     counter via the public ``api.counters_snapshot()`` — previously these

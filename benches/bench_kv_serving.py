@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Request-level serving latency: TTFT and inter-token p50/p99 under
+bulk contention, rank churn, and a QPS ramp (ISSUE 18; serving/).
+
+No reference analog (TEMPI serves one training job). The scenario set
+is the ROADMAP's request-shaped north star in miniature — a
+prefill/decode-disaggregated engine streaming paged KV caches over
+persistent p2p while the decode ranks route tokens per step on the
+persistent alltoallv — measured three ways:
+
+  flood  — the engine serves on a latency-class communicator while bulk
+           tenants flood large pairs through the background pump; run
+           twice (QoS off, then on), so the CSV shows whether the class
+           scheduler bounds decode p99 under contention.
+  churn  — requests are mid-stream when a decode rank is killed: detect
+           (bounded waits -> verdict) -> shrink -> the SAME engine
+           rebinds and re-streams from the retained producer pages ->
+           rejoin -> grow -> rebind again -> keep serving. Every
+           assembly byte-verifies; `restreams` counts pages re-sent
+           after reassignment (lost pages would fail verify, duplicated
+           ones cannot enter a restarted assembly).
+  ramp   — serving starts on a sub-world; the generator's QPS ramps and
+           the resulting backlog triggers announce_join + grow, the
+           engine rebinds onto the larger world and drains.
+
+Each scenario is its own init/finalize cycle (env-armed modes differ).
+
+    python benches/bench_kv_serving.py --cpu --quick
+"""
+
+import os
+import sys
+import time
+
+from _common import (base_parser, devices_or_die, emit_csv, p50_p99,
+                     setup_platform)
+
+_SERVE_ENV = ("TEMPI_SERVE", "TEMPI_SERVE_QPS", "TEMPI_FT",
+              "TEMPI_ELASTIC", "TEMPI_WAIT_TIMEOUT_S",
+              "TEMPI_FT_SUSPECT_TIMEOUTS", "TEMPI_PROGRESS_THREAD")
+
+
+def _set_env(**kv):
+    for k in _SERVE_ENV:
+        os.environ.pop(k, None)
+    for k, v in kv.items():
+        os.environ[k] = str(v)
+    os.environ["TEMPI_SERVE"] = "on"
+
+
+def _row(scenario, qos, rec, wall, ok=1):
+    tp50, tp99 = p50_p99(rec["ttft_s"])
+    ip50, ip99 = p50_p99(rec["itl_s"])
+    return [scenario, int(qos), rec["requests"], rec["completed"],
+            tp50, tp99, ip50, ip99, rec["pages"], rec["verified"],
+            rec["restreams"], int(ok), wall]
+
+
+def _scoped_record(n_requests):
+    """Scenario-wide record from the serving ledger + counters (the
+    churn/ramp scenarios drive several serve() phases; the per-process
+    ledger covers them all within one init/finalize cycle)."""
+    from tempi_tpu import api
+    from tempi_tpu.serving import engine as engmod
+    recs = engmod.completed_records()
+    c = api.counters_snapshot()["serving"]
+    return dict(requests=n_requests, completed=len(recs),
+                ttft_s=[r["ttft_s"] for r in recs
+                        if r["ttft_s"] is not None],
+                itl_s=[x for r in recs for x in r["itl_s"]],
+                pages=c["pages_streamed"], verified=c["num_verified"],
+                restreams=c["num_restreams"])
+
+
+def run_flood(args, qos: bool):
+    _set_env(TEMPI_SERVE_QPS=args.qps, TEMPI_PROGRESS_THREAD=1)
+    from tempi_tpu import api
+    from tempi_tpu.models import kv_serving
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.parallel.communicator import Communicator
+    from tempi_tpu.serving.engine import ServingEngine
+
+    world = api.init()
+    latency_comm = Communicator(world.devices)
+    bulk_comms = [Communicator(world.devices)
+                  for _ in range(args.bulk_tenants)]
+    if qos:
+        api.comm_set_qos(latency_comm, "latency")
+        for bc in bulk_comms:
+            api.comm_set_qos(bc, "bulk")
+    engine = ServingEngine(latency_comm)
+
+    ty = dt.contiguous(args.bulk_bytes, dt.BYTE)
+    flood = []
+    t0 = time.monotonic()
+    for it in range(args.flood_waves):
+        for bc in bulk_comms:
+            sb, rb = bc.alloc(args.bulk_bytes), bc.alloc(args.bulk_bytes)
+            flood += [p2p.isend(bc, 0, sb, 1, ty, tag=it),
+                      p2p.irecv(bc, 1, rb, 0, ty, tag=it)]
+    rec = kv_serving.serve(latency_comm, args.requests, engine=engine)
+    p2p.waitall(flood)
+    wall = time.monotonic() - t0
+    row = _row("flood", qos, rec, wall)
+    api.finalize()
+    return row
+
+
+def run_churn(args):
+    _set_env(TEMPI_FT="shrink", TEMPI_ELASTIC="grow",
+             TEMPI_WAIT_TIMEOUT_S=args.wait_timeout,
+             TEMPI_FT_SUSPECT_TIMEOUTS=2)
+    from tempi_tpu import api
+    from tempi_tpu.models import kv_serving
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    from tempi_tpu.serving.engine import ServingEngine
+    from tempi_tpu.serving.requests import RequestGenerator
+
+    comm = api.init()
+    size = comm.size
+    victim = size - 1  # a decode rank under the default half split
+    engine = ServingEngine(comm)
+    gen = RequestGenerator(qps=args.qps)
+    t_run = time.monotonic()
+
+    # phase 1: healthy serving, then leave a batch mid-stream
+    kv_serving.serve(comm, args.requests // 3, engine=engine, gen=gen)
+    for r in gen.generate(args.requests // 3):
+        engine.submit(r)
+    engine.step()  # two steps: every request admits and delivers pages
+    engine.step()  # (some toward the victim) before the kill
+
+    # kill + detect: ops to the victim only time out, never complete
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf = comm.alloc(64)
+    trigger = p2p.isend(comm, 0, sbuf, victim, ty)
+    t_post = time.monotonic()
+    while True:
+        try:
+            p2p.waitall([trigger])
+            print("victim completed?! detection never fired",
+                  file=sys.stderr)
+            return None
+        except api.RankFailure:
+            break
+        except api.WaitTimeout:
+            continue
+    detect_s = time.monotonic() - t_post
+
+    # shrink -> rebind -> the mid-stream batch re-streams and completes
+    surv = api.shrink(comm)
+    engine.rebind(surv)
+    engine.drain(30.0)
+    serve_ok = engine.outstanding() == 0
+
+    # rejoin -> grow -> rebind -> keep serving on the full-size world
+    victim_dev = comm.devices[comm.library_rank(victim)]
+    out = api.announce_join(surv, [victim_dev])
+    grown = api.grow(surv) if out["outcome"] == "announced" else None
+    grow_ok = grown is not None and grown.size == size
+    if grow_ok:
+        engine.rebind(grown)
+        kv_serving.serve(grown, args.requests // 3, engine=engine,
+                         gen=gen)
+    wall = time.monotonic() - t_run
+    rec = _scoped_record(3 * (args.requests // 3))
+    row = _row("churn", 0, rec, wall, ok=serve_ok and grow_ok)
+    print(f"churn: detect_s={detect_s:.3f} shrink_served={serve_ok} "
+          f"regrown={grow_ok} restreams={rec['restreams']}",
+          file=sys.stderr)
+    api.finalize()
+    return row
+
+
+def run_ramp(args):
+    _set_env(TEMPI_ELASTIC="grow", TEMPI_SERVE_QPS=args.qps)
+    from tempi_tpu import api
+    from tempi_tpu.models import kv_serving
+    from tempi_tpu.parallel.communicator import Communicator
+    from tempi_tpu.serving.engine import ServingEngine
+    from tempi_tpu.serving.requests import RequestGenerator
+
+    world = api.init()
+    sub = Communicator(world.devices[: world.size - 1])
+    engine = ServingEngine(sub)
+    gen = RequestGenerator(qps=args.qps)
+    t_run = time.monotonic()
+    kv_serving.serve(sub, args.requests // 2, engine=engine, gen=gen)
+
+    # the ramp: arrivals outpace the step loop, backlog triggers grow
+    gen.set_qps(args.qps * args.ramp_factor)
+    grown = None
+    for r in gen.generate(args.requests // 2):
+        engine.submit(r)
+        if grown is None and engine.outstanding() > args.grow_backlog:
+            api.announce_join(sub, [world.devices[world.size - 1]])
+            grown = api.grow(sub)
+            engine.rebind(grown)
+        engine.step()
+    engine.drain(30.0)
+    wall = time.monotonic() - t_run
+    rec = _scoped_record(2 * (args.requests // 2))
+    row = _row("ramp", 0, rec, wall, ok=grown is not None)
+    print(f"ramp: grew={'yes' if grown is not None else 'NO'} "
+          f"({sub.size}->{grown.size if grown is not None else sub.size} "
+          f"ranks)", file=sys.stderr)
+    api.finalize()
+    return row
+
+
+def main() -> int:
+    p = base_parser("prefill/decode serving: TTFT + inter-token tails "
+                    "under flood, churn, and a QPS ramp", multirank=True)
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--qps", type=float, default=64.0)
+    p.add_argument("--bulk-tenants", type=int, default=4)
+    p.add_argument("--bulk-bytes", type=int, default=1 << 18)
+    p.add_argument("--flood-waves", type=int, default=8)
+    p.add_argument("--wait-timeout", type=float, default=0.3)
+    p.add_argument("--ramp-factor", type=float, default=8.0)
+    p.add_argument("--grow-backlog", type=int, default=4)
+    args = p.parse_args()
+    if args.quick:
+        args.requests, args.flood_waves = 9, 3
+        args.bulk_tenants, args.wait_timeout = 2, 0.15
+        args.grow_backlog = 2  # the ramp phase only submits
+        # requests//2 — the backlog trigger must be reachable
+    setup_platform(args)
+    devices_or_die(min_devices=4)
+
+    rows = [run_flood(args, qos=False), run_flood(args, qos=True),
+            run_churn(args), run_ramp(args)]
+    ok = all(r is not None and r[11] for r in rows if r is not None)
+    emit_csv(
+        ("scenario", "qos", "requests", "completed", "ttft_p50_s",
+         "ttft_p99_s", "itl_p50_s", "itl_p99_s", "pages", "verified",
+         "restreams", "ok", "wall_s"),
+        [r for r in rows if r is not None])
+    return 0 if ok and all(r is not None for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

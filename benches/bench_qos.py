@@ -25,16 +25,8 @@ within the weight ratio.
 import sys
 import time
 
-import numpy as np
-
-from _common import base_parser, emit_csv, devices_or_die, setup_platform
-
-
-def _percentiles(xs):
-    if not xs:
-        return 0.0, 0.0
-    v = np.sort(np.asarray(xs))
-    return float(np.percentile(v, 50)), float(np.percentile(v, 99))
+from _common import (base_parser, emit_csv, devices_or_die, p50_p99,
+                     setup_platform)
 
 
 def main() -> int:
@@ -118,8 +110,8 @@ def main() -> int:
     wall = time.monotonic() - t_run0
 
     qc = api.counters_snapshot()["qos"]
-    sp50, sp99 = _percentiles(small_times)
-    bp50, bp99 = _percentiles(bulk_times)
+    sp50, sp99 = p50_p99(small_times)
+    bp50, bp99 = p50_p99(bulk_times)
     emit_csv(
         ("qos", "class", "completions", "p50_s", "p99_s",
          "served", "deferred", "backpressure", "wall_s"),

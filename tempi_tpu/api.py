@@ -73,6 +73,9 @@ def init(devices=None) -> Communicator:
     from .runtime import integrity
     integrity.configure()  # arm TEMPI_INTEGRITY (knobs loud-parsed
     # above; this clears any prior session's corruption-incident ledger)
+    from .serving import engine as serving_engine
+    serving_engine.configure()  # arm TEMPI_SERVE (knobs loud-parsed
+    # above; this clears any prior session's completed-request ledger)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -247,6 +250,10 @@ def finalize() -> None:
         integrity.configure()  # the corruption-incident ledger is
         # per-session evidence too (env-armed integrity survives:
         # configure re-reads the parsed mode)
+        from .serving import engine as serving_engine
+        serving_engine.configure()  # the completed-request ledger is
+        # per-session evidence too (env-armed serving survives:
+        # configure re-reads the parsed mode)
         _world = None
 
 
@@ -297,6 +304,19 @@ def integrity_snapshot() -> dict:
     after finalize (reads empty)."""
     from .runtime import integrity
     return integrity.snapshot()
+
+
+def serving_snapshot() -> dict:
+    """Diagnostic snapshot of the inference-serving subsystem (ISSUE 18;
+    serving/engine.py): mode and knob config plus request-level latency
+    evidence — TTFT and inter-token p50/p99 over the bounded
+    completed-request ledger, and submitted/completed totals. This is
+    the REQUEST-latency view; the per-span histograms behind it live in
+    :func:`metrics_snapshot` (``serving.request`` keyed by
+    strategy=ttft/itl). Pure data — safe to serialize. Callable before
+    init and after finalize (reads inert)."""
+    from .serving import engine as serving_engine
+    return serving_engine.snapshot()
 
 
 def comm_set_qos(comm: Communicator, qos_class: Optional[str]) -> None:

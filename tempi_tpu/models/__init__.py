@@ -1,2 +1,3 @@
 from . import halo3d  # noqa: F401
+from . import kv_serving  # noqa: F401
 from . import ring_attention  # noqa: F401

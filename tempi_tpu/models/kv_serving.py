@@ -1,0 +1,78 @@
+"""Request-shaped serving workload (ISSUE 18): the open-loop driver
+that feeds a :class:`~tempi_tpu.serving.engine.ServingEngine` a seeded
+Poisson trace and steps the scheduler in arrival order.
+
+Unlike the training-shaped workloads in this package (halo3d,
+ring_attention — fixed exchange per step, forever), serving load is a
+trace: requests ARRIVE on an open-loop clock whether or not the system
+keeps up, so the driver submits by arrival offset and keeps stepping
+between arrivals — queueing delay lands in TTFT instead of being hidden
+by back-pressure. The returned record carries the raw per-request
+latency arrays so benches compute percentiles with the shared
+``benches/_common.py`` helpers instead of each reinventing the numpy
+call.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from ..parallel.communicator import Communicator
+from ..serving.engine import ServingEngine
+from ..serving.requests import RequestGenerator
+from ..utils import counters as ctr
+
+
+def serve(comm: Communicator, num_requests: int,
+          qps: Optional[float] = None, seed: Optional[int] = None,
+          prefill_ranks: Optional[Sequence[int]] = None,
+          decode_ranks: Optional[Sequence[int]] = None,
+          page_bytes: Optional[int] = None,
+          bytes_per_token: int = 64,
+          pace: bool = False,
+          drain_deadline_s: float = 30.0,
+          engine: Optional[ServingEngine] = None,
+          gen: Optional[RequestGenerator] = None) -> dict:
+    """Drive ``num_requests`` through an engine; returns the workload
+    record (per-request TTFT / inter-token arrays + counters evidence).
+
+    ``pace=False`` (the default for tests and quick benches) submits by
+    trace order without sleeping — arrival offsets still order the
+    submissions, wall time measures the transport. ``pace=True`` sleeps
+    to the trace's arrival clock (true open-loop; slow, bench-only).
+    Passing a pre-built ``engine`` lets churn benches keep ONE engine
+    across shrink/grow rebinds while driving traffic in phases; passing
+    a ``gen`` continues an existing trace (rids and the arrival clock
+    carry over, so phases never collide on request ids)."""
+    if gen is None:
+        gen = RequestGenerator(qps=qps, seed=seed,
+                               bytes_per_token=bytes_per_token)
+    eng = engine if engine is not None else ServingEngine(
+        comm, prefill_ranks=prefill_ranks, decode_ranks=decode_ranks,
+        page_bytes=page_bytes)
+    trace = gen.generate(num_requests)
+    t0 = time.monotonic()
+    for req in trace:
+        if pace:
+            lag = req.arrival_s - (time.monotonic() - t0)
+            if lag > 0:
+                time.sleep(lag)
+        eng.submit(req)
+        eng.step()
+    eng.drain(drain_deadline_s)
+    wall = time.monotonic() - t0
+    # latency arrays come from the module's completed ledger — it is
+    # global (bounded), so scope to this trace's rids
+    from ..serving import engine as engmod
+    rids = {r.rid for r in trace}
+    records = [r for r in engmod.completed_records() if r["rid"] in rids]
+    ttft_s: List[float] = [r["ttft_s"] for r in records
+                           if r["ttft_s"] is not None]
+    itl_s: List[float] = [x for r in records for x in r["itl_s"]]
+    c = ctr.counters.serving
+    return dict(requests=num_requests, completed=eng.completed,
+                wall_s=wall, ttft_s=ttft_s, itl_s=itl_s,
+                pages=c.pages_streamed, page_bytes=c.page_bytes,
+                verified=c.num_verified, restreams=c.num_restreams,
+                page_faults=c.num_page_faults)

@@ -93,4 +93,11 @@ EVENTS = (
     "integrity.retransmit",  # a mismatch triggered a re-delivery (site,
                              # link, strategy, attempt; attempt=0 marks a
                              # round re-dispatch)
+    # serving/engine.py + serving/kv_stream.py — inference serving (ISSUE 18)
+    "serving.request",   # span: one request-latency sample — strategy=ttft
+                         # (submit -> first token) or strategy=itl
+                         # (token -> token); feeds the metrics histograms
+                         # and the autopilot SLO gate via WATCH_SPANS
+    "serving.stream",    # span: one KV page pushed prefill -> decode
+                         # (rid, page, nbytes, replay)
 )

@@ -28,3 +28,11 @@ COLL_HIER = RESERVED_BASE + 4
 # from FT_AGREE, so a death vote and a join vote on the same communicator
 # can never read each other's bitmaps
 ELASTIC_JOIN = RESERVED_BASE + 5
+# KV-cache page streaming (serving/kv_stream.py): every prefill->decode
+# page push rides its own reserved id, distinct from COLL_SCHEDULE and
+# COLL_HIER for the same FIFO-isolation reason — a replayed page batch
+# must never FIFO-match application p2p ops (or a persistent-collective
+# round) interleaved on the serving communicator: a decode rank matching
+# a foreign payload into a KV page would assemble a byte-wrong cache and
+# the request-level verify would blame the transport for an isolation bug
+KV_STREAM = RESERVED_BASE + 6

@@ -99,7 +99,11 @@ _LEDGER_KEEP = 100  # bounded decision ledger (diagnostics, not logs)
 #: These are the replay/dispatch spans the observatory already records;
 #: the autopilot reads per-interval bucket DELTAS so one bad epoch in a
 #: long run cannot hide inside (or contaminate) the cumulative counts.
-WATCH_SPANS = ("step.replay", "coll.round", "redcoll.round")
+#: ``serving.request`` (ISSUE 18) folds request-level TTFT/inter-token
+#: latencies into the same gate, so a serving-tail breach can trip the
+#: SLO loop even when the transport spans alone look healthy.
+WATCH_SPANS = ("step.replay", "coll.round", "redcoll.round",
+               "serving.request")
 
 _lock = locks.named_lock("autopilot")
 

@@ -196,6 +196,15 @@ SITES = (
                           # never worse than a half-applied one; delay
                           # slows the epoch-boundary caller; wedge
                           # refused like every non-engine site)
+    "serving.page",       # one KV page push prefill -> decode
+                          # (serving/kv_stream.py — fires BEFORE the page
+                          # batch dispatches, so a raise never leaves a
+                          # page half-streamed: the page stays undelivered
+                          # on the prefill side and the engine re-streams
+                          # it on the next step; delay slows the streaming
+                          # producer; wedge refused like every non-engine
+                          # site — the dispatch runs under the progress
+                          # lock)
 )
 
 KINDS = ("raise", "delay", "wedge", "corrupt")

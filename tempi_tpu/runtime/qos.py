@@ -73,14 +73,24 @@ _quarantine_ledger: List[dict] = []
 _LEDGER_KEEP = 100
 _verdict_lock = locks.named_lock("qos.verdicts")
 
+# configured-vs-live weight audit (ISSUE 18 satellite): the env-parsed
+# weights as of the last configure(), and the reason string of the last
+# set_weights() call — snapshot() joins them so an autopilot
+# flood-profile flip is auditable from qos_snapshot() alone, without
+# replaying the timeline
+_configured_weights: Dict[str, int] = {}
+_weights_reason: Optional[str] = None
+
 
 def configure() -> None:
     """(Re)arm from the parsed env (call after ``read_environment``): QoS
     is on iff ``TEMPI_QOS_DEFAULT`` names a class. Clears the session's
     api-armed state and lane-quarantine verdicts — QoS arming is
     per-session, like counters."""
-    global ENABLED
+    global ENABLED, _configured_weights, _weights_reason
     ENABLED = bool(getattr(envmod.env, "qos_default", ""))
+    _configured_weights = dict(getattr(envmod.env, "qos_weights", {}))
+    _weights_reason = None
     with _verdict_lock:
         _quarantine_verdicts.clear()
         del _quarantine_ledger[:]
@@ -181,8 +191,10 @@ def set_weights(weights: Dict[str, int], reason: str = "") -> Dict[str, int]:
             raise ValueError(
                 f"bad QoS weight {cls}={w!r}: want a positive integer")
         clean[cls] = w
+    global _weights_reason
     old = dict(envmod.env.qos_weights)
     envmod.env.qos_weights = clean
+    _weights_reason = reason[:200] or None
     timeline.record("qos.weights", old=old, new=dict(clean),
                     reason=reason[:200] or None)
     log.debug(f"qos weights {old} -> {clean}"
@@ -340,11 +352,19 @@ def snapshot() -> dict:
         for cls in CLASSES:
             classes[cls]["queued"] = depths[cls]
             classes[cls]["credits"] = credits[cls]
+    live = dict(envmod.env.qos_weights)
     return dict(
         enabled=ENABLED,
         default_class=envmod.env.qos_default or "default",
         queue_depth=envmod.env.qos_queue_depth,
         classes=classes,
+        # configured-vs-live audit (ISSUE 18 satellite): `configured` is
+        # the env parse configure() armed; a set_weights() swap (operator
+        # or autopilot flood actuator) shows up as overridden=True with
+        # the swap's reason — auditable without replaying the timeline
+        weights=dict(configured=dict(_configured_weights), live=live,
+                     overridden=live != _configured_weights,
+                     reason=_weights_reason),
         quarantine_verdicts=verdicts,
         quarantine_ledger=verdict_ledger,
         quarantined_comms=[

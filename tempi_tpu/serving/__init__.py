@@ -1,0 +1,24 @@
+"""Prefill/decode-disaggregated inference serving (ISSUE 18).
+
+Request-shaped traffic is the ROADMAP north star this package finally
+exercises: many small latency-critical exchanges (decode-step token
+routing, paged KV streams) interleaved with bulk background transfers.
+Three modules compose five prior subsystems:
+
+  * :mod:`requests`  — seeded open-loop Poisson request generation;
+  * :mod:`kv_stream` — paged KV-cache store + streamer: prefill ranks
+    push fixed-size pages to decode ranks over persistent p2p batches
+    at the reserved ``tags.KV_STREAM`` id, with page-table bookkeeping
+    for byte-exact assembly verification per request;
+  * :mod:`engine`    — the prefill -> stream -> decode scheduler loop,
+    decode-step expert routing on the persistent alltoallv, and the
+    request-level TTFT / inter-token latency evidence
+    (``serving.request`` spans -> obs/metrics histograms -> autopilot
+    SLO gate; ``serving.*`` counters; ``api.serving_snapshot()``).
+
+``TEMPI_SERVE=off`` (the default) is inert: :class:`engine.ServingEngine`
+refuses to construct, every counter stays pinned at zero, and no
+existing path changes byte-for-byte (``TEMPI_DISABLE`` forces off).
+"""
+
+from . import engine, kv_stream, requests  # noqa: F401

@@ -1,0 +1,393 @@
+"""The prefill -> stream -> decode scheduler loop.
+
+``TEMPI_SERVE`` modes (loud-parsed in utils/env.py):
+
+  off — inert (the default): :class:`ServingEngine` refuses to
+        construct, every ``serving.*`` counter stays pinned at zero,
+        and no existing path changes byte-for-byte (the established
+        faults/tune/integrity zero-cost contract; ``TEMPI_DISABLE``
+        forces off).
+  on  — the engine drives, per :meth:`ServingEngine.step`:
+
+    1. ADMIT: up to ``max_prefill_per_step`` queued requests run
+       prefill (a seeded deterministic KV payload — (seed, rid) names
+       the bytes, so a churn re-stream reproduces the SAME payload);
+    2. STREAM: each in-flight request pushes up to ``pages_per_step``
+       KV pages through :class:`~.kv_stream.KVStreamer`; a
+       ``serving.page`` chaos raise is absorbed here (the page stays
+       undelivered and retries next step); a fully-delivered cache is
+       byte-exact VERIFIED before the request may decode;
+    3. DECODE: one token per request per step. The decode ranks first
+       run an MoE-style expert-routing exchange on the persistent
+       alltoallv (compiled once, replayed per step — recompiling
+       through the shared invalidation generation like every
+       persistent handle), then each request's token is stamped:
+       the first token closes a ``strategy="ttft"`` span, every later
+       one a ``strategy="itl"`` span, both on the ``serving.request``
+       event — the histograms ``api.metrics_snapshot()`` reports and
+       the autopilot SLO gate watches (autopilot.WATCH_SPANS).
+
+Request-level latency evidence also lands in a bounded module ledger so
+:func:`snapshot` (-> ``api.serving_snapshot()``) reports TTFT and
+inter-token p50/p99 even with the obs subsystem disarmed.
+
+Churn: :meth:`ServingEngine.rebind` adopts a post-shrink/grow
+communicator — in-flight requests on vanished ranks reassign, their
+assemblies restart empty, and their pages re-stream from the retained
+producer copies (no page lost, none duplicated; see kv_stream.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import trace as obstrace
+from ..parallel.communicator import Communicator
+from ..runtime import faults
+from ..utils import counters as ctr
+from ..utils import env as envmod
+from ..utils import locks
+from . import kv_stream as kvmod
+from .requests import Request
+
+#: Module-level fast-path flag (the established zero-cost pattern):
+#: TEMPI_SERVE=off costs one attribute truth test at engine construction
+#: and nothing anywhere else.
+ENABLED = False
+MODE = "off"
+
+#: Completed-request ledger bound (the obs/trace failure-ring precedent):
+#: enough tail evidence for p99 over a bench phase without growing in a
+#: long soak.
+_KEEP = 256
+
+_completed: List[dict] = []
+_submitted = 0
+_ncompleted = 0
+_lock = locks.named_lock("serving")
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm from the parsed env (``mode=None`` reads
+    ``env.serve_mode`` — call after ``read_environment``); an explicit
+    argument overrides (test convenience). Clears the completed-request
+    ledger: request latencies are session evidence, not
+    cross-configuration state."""
+    global ENABLED, MODE, _completed, _submitted, _ncompleted
+    m = mode if mode is not None else \
+        getattr(envmod.env, "serve_mode", "off")
+    if m not in ("off", "on"):
+        raise ValueError(f"bad serve mode {m!r}: want off | on")
+    with _lock:
+        MODE = m
+        ENABLED = m == "on"
+        _completed = []
+        _submitted = 0
+        _ncompleted = 0
+
+
+def disarm() -> None:
+    """Back to inert (conftest teardown symmetry with configure())."""
+    configure("off")
+
+
+def _note_submitted() -> None:
+    global _submitted
+    with _lock:
+        _submitted += 1
+
+
+def _note_completed(rid: int, ttft_s: Optional[float],
+                    itls: Sequence[float]) -> None:
+    global _ncompleted
+    with _lock:
+        _ncompleted += 1
+        _completed.append(dict(rid=rid, ttft_s=ttft_s,
+                               itl_s=list(itls)))
+        if len(_completed) > _KEEP:
+            del _completed[: len(_completed) - _KEEP]
+
+
+def completed_records() -> List[dict]:
+    """Copies of the bounded completed-request ledger (bench/test
+    surface — each record: rid, ttft_s, itl_s list)."""
+    with _lock:
+        return [dict(r) for r in _completed]
+
+
+def _pctl(xs: List[float]) -> dict:
+    if not xs:
+        return dict(count=0, p50_s=None, p99_s=None)
+    a = np.asarray(xs, dtype=np.float64)
+    return dict(count=len(xs), p50_s=float(np.percentile(a, 50)),
+                p99_s=float(np.percentile(a, 99)))
+
+
+def snapshot() -> dict:
+    """Mode/config plus request-level latency percentiles over the
+    bounded completed ledger. Pure data — safe to serialize. Callable
+    before init and after finalize (reads inert)."""
+    with _lock:
+        ttfts = [r["ttft_s"] for r in _completed
+                 if r["ttft_s"] is not None]
+        itls = [x for r in _completed for x in r["itl_s"]]
+        return dict(mode=MODE, enabled=ENABLED,
+                    page_bytes=getattr(envmod.env, "serve_page_bytes",
+                                       4096),
+                    qps=getattr(envmod.env, "serve_qps", 32.0),
+                    seed=getattr(envmod.env, "serve_seed", 0),
+                    submitted=_submitted, completed=_ncompleted,
+                    ttft=_pctl(ttfts), itl=_pctl(itls))
+
+
+@dataclass
+class _InFlight:
+    """Scheduler state for one admitted request."""
+
+    req: Request
+    submit_t: float
+    prefill_rank: int
+    decode_rank: int
+    state: str = "queued"      # queued | streaming | decoding | done
+    tokens_done: int = 0
+    ttft_s: Optional[float] = None
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+    itls: List[float] = field(default_factory=list)
+
+
+class ServingEngine:
+    """One prefill/decode-disaggregated serving instance on ``comm``.
+
+    ``prefill_ranks``/``decode_ranks`` default to a first-half/second-
+    half split of the communicator; they must be disjoint and non-empty.
+    Construction REFUSES when the subsystem is off — the one truth test
+    the off path pays."""
+
+    def __init__(self, comm: Communicator,
+                 prefill_ranks: Optional[Sequence[int]] = None,
+                 decode_ranks: Optional[Sequence[int]] = None,
+                 page_bytes: Optional[int] = None,
+                 route_bytes: int = 64, pages_per_step: int = 4,
+                 max_prefill_per_step: int = 2):
+        if not ENABLED:
+            raise RuntimeError(
+                "serving is disabled: set TEMPI_SERVE=on (and note "
+                "TEMPI_DISABLE forces it off) before building a "
+                "ServingEngine")
+        if route_bytes <= 0 or pages_per_step <= 0 or \
+                max_prefill_per_step <= 0:
+            raise ValueError("route_bytes, pages_per_step and "
+                             "max_prefill_per_step must be positive")
+        self.comm = comm
+        self.prefill_ranks, self.decode_ranks = \
+            self._rank_split(comm, prefill_ranks, decode_ranks)
+        pb = page_bytes if page_bytes is not None else \
+            getattr(envmod.env, "serve_page_bytes", 4096)
+        self.streamer = kvmod.KVStreamer(comm, pb)
+        self.route_bytes = int(route_bytes)
+        self.pages_per_step = int(pages_per_step)
+        self.max_prefill_per_step = int(max_prefill_per_step)
+        self.seed = getattr(envmod.env, "serve_seed", 0)
+        self._inflight: Dict[int, _InFlight] = {}
+        self._route = None  # lazy persistent alltoallv (expert routing)
+        self._done = 0
+
+    @staticmethod
+    def _rank_split(comm, prefill, decode):
+        size = comm.size
+        if prefill is None and decode is None:
+            if size < 2:
+                raise ValueError(
+                    "serving needs >= 2 ranks for the default "
+                    "prefill/decode split; pass explicit rank sets")
+            half = max(1, size // 2)
+            prefill, decode = range(half), range(half, size)
+        pf, dc = list(prefill or ()), list(decode or ())
+        if not pf or not dc:
+            raise ValueError("prefill_ranks and decode_ranks must both "
+                             "be non-empty")
+        if set(pf) & set(dc):
+            raise ValueError(
+                f"prefill/decode rank sets overlap: {sorted(set(pf) & set(dc))}"
+                " — disaggregation requires disjoint pools")
+        for r in pf + dc:
+            if not 0 <= r < size:
+                raise ValueError(f"rank {r} out of range for a "
+                                 f"{size}-rank communicator")
+        return pf, dc
+
+    @staticmethod
+    def _pick(ranks: List[int], rid: int) -> int:
+        return ranks[rid % len(ranks)]
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if req.rid in self._inflight:
+            raise ValueError(f"request {req.rid} already submitted")
+        self._inflight[req.rid] = _InFlight(
+            req=req, submit_t=time.monotonic(),
+            prefill_rank=self._pick(self.prefill_ranks, req.rid),
+            decode_rank=self._pick(self.decode_ranks, req.rid))
+        ctr.counters.serving.num_requests += 1
+        _note_submitted()
+
+    def outstanding(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def completed(self) -> int:
+        return self._done
+
+    # -- the step loop --------------------------------------------------------
+
+    def _kv_payload(self, req: Request) -> np.ndarray:
+        # (seed, rid) names the bytes: a churn re-stream reproduces the
+        # SAME payload the original prefill produced, so verification
+        # stays byte-exact across reassignment
+        rng = np.random.default_rng((self.seed, req.rid))
+        return rng.integers(0, 256, size=req.kv_bytes, dtype=np.uint8)
+
+    def step(self) -> dict:
+        """One scheduler step (admit -> stream -> decode); returns the
+        step's work tally."""
+        c = ctr.counters.serving
+        admitted = streamed = tokens = finished = 0
+        order = sorted(self._inflight)
+        # 1. ADMIT: prefill produces the KV payload and opens the stream
+        for rid in order:
+            if admitted >= self.max_prefill_per_step:
+                break
+            fl = self._inflight[rid]
+            if fl.state != "queued":
+                continue
+            self.streamer.open_request(rid, fl.prefill_rank,
+                                       fl.decode_rank,
+                                       self._kv_payload(fl.req))
+            fl.state = "streaming"
+            c.num_prefills += 1
+            admitted += 1
+        # 2. STREAM: page pushes; a chaos raise leaves the page
+        # undelivered for the next step (raise-before-dispatch)
+        for rid in order:
+            fl = self._inflight[rid]
+            if fl.state != "streaming":
+                continue
+            try:
+                streamed += self.streamer.push(rid, self.pages_per_step)
+            except faults.InjectedFault:
+                c.num_page_faults += 1
+            if self.streamer.complete(rid):
+                self.streamer.verify(rid)
+                fl.state = "decoding"
+        # 3. DECODE: one routing exchange per step, one token per request
+        decoding = [self._inflight[r] for r in order
+                    if self._inflight[r].state == "decoding"]
+        if decoding:
+            self._route_exchange()
+            c.num_decode_steps += 1
+            rec = obstrace.ENABLED
+            now = time.monotonic()
+            for fl in decoding:
+                if fl.first_token_t is None:
+                    fl.first_token_t = now
+                    fl.ttft_s = now - fl.submit_t
+                    if rec:
+                        obstrace.emit_span("serving.request", fl.submit_t,
+                                           strategy="ttft", rid=fl.req.rid)
+                else:
+                    fl.itls.append(now - fl.last_token_t)
+                    if rec:
+                        obstrace.emit_span("serving.request",
+                                           fl.last_token_t,
+                                           strategy="itl", rid=fl.req.rid)
+                fl.last_token_t = now
+                fl.tokens_done += 1
+                tokens += 1
+                if fl.tokens_done >= fl.req.output_tokens:
+                    fl.state = "done"
+                    finished += 1
+        for fl in [f for f in decoding if f.state == "done"]:
+            c.num_completed += 1
+            self._done += 1
+            _note_completed(fl.req.rid, fl.ttft_s, fl.itls)
+            self.streamer.close_request(fl.req.rid)
+            del self._inflight[fl.req.rid]
+        return dict(admitted=admitted, streamed=streamed, tokens=tokens,
+                    finished=finished)
+
+    def drain(self, deadline_s: float = 30.0) -> int:
+        """Step until every in-flight request completes (or the deadline
+        passes — a bounded drain can never hang a bench); returns the
+        engine's completed-request total."""
+        deadline = time.monotonic() + deadline_s
+        while self._inflight and time.monotonic() < deadline:
+            self.step()
+        return self._done
+
+    # -- decode-step expert routing -------------------------------------------
+
+    def _route_exchange(self) -> None:
+        """The MoE-style expert-routing exchange between decode ranks on
+        the persistent alltoallv: compiled once, replayed per decode
+        step (the small-message/latency regime the persistent schedule
+        exists for). Skipped with a single decode rank — there is no
+        peer to route to."""
+        if len(self.decode_ranks) < 2:
+            return
+        if self._route is None:
+            from ..coll.persistent import alltoallv_init
+            comm, rb = self.comm, self.route_bytes
+            size = comm.size
+            sc = np.zeros((size, size), dtype=np.int64)
+            for i in self.decode_ranks:
+                for j in self.decode_ranks:
+                    sc[i, j] = rb
+            disp = np.tile(np.arange(size, dtype=np.int64) * rb,
+                           (size, 1))
+            sendbuf = comm.alloc(size * rb)
+            recvbuf = comm.alloc(size * rb)
+            self._route = alltoallv_init(comm, sendbuf, sc, disp,
+                                         recvbuf, sc.T, disp)
+        self._route.start()
+        self._route.wait()
+        ctr.counters.serving.num_route_exchanges += 1
+
+    # -- churn ----------------------------------------------------------------
+
+    def rebind(self, comm: Communicator,
+               prefill_ranks: Optional[Sequence[int]] = None,
+               decode_ranks: Optional[Sequence[int]] = None) -> int:
+        """Adopt a post-shrink/grow communicator. Rank sets re-derive
+        (or are given explicitly); in-flight requests whose ranks
+        vanished reassign and their caches re-stream from the retained
+        producer pages — a decoding request drops back to streaming
+        until its new assembly re-verifies. Returns how many requests
+        were reassigned."""
+        self.prefill_ranks, self.decode_ranks = \
+            self._rank_split(comm, prefill_ranks, decode_ranks)
+        self.comm = comm
+        self.streamer.rebind(comm)
+        self._route = None  # recompiles lazily on the new comm
+        moved = 0
+        for rid in sorted(self._inflight):
+            fl = self._inflight[rid]
+            new_d = fl.decode_rank if fl.decode_rank in self.decode_ranks \
+                else self._pick(self.decode_ranks, rid)
+            new_p = fl.prefill_rank \
+                if fl.prefill_rank in self.prefill_ranks \
+                else self._pick(self.prefill_ranks, rid)
+            if new_d == fl.decode_rank and new_p == fl.prefill_rank:
+                continue
+            moved += 1
+            if fl.state in ("streaming", "decoding"):
+                self.streamer.reassign(rid, new_d, new_p)
+                if fl.state == "decoding":
+                    fl.state = "streaming"
+            fl.decode_rank, fl.prefill_rank = new_d, new_p
+        return moved

@@ -1,0 +1,267 @@
+"""Paged KV-cache store + streamer over persistent p2p.
+
+Prefill ranks push a request's KV cache to its decode rank as FIXED-SIZE
+pages (``TEMPI_SERVE_PAGE_BYTES``; the final page of a request is ragged
+— only its leading bytes are payload). Every (prefill, decode) pair owns
+one persistent p2p channel: a send/recv request pair built ONCE at the
+reserved ``tags.KV_STREAM`` id (``internal=True`` — application tags can
+never FIFO-match a page) and replayed per page through the compiled
+``startall`` batch, so the per-page cost after the first push is a plan
+replay, not a fresh match -> strategy -> plan pipeline. The channel
+tracks its own copy of the shared invalidation token purely as EVIDENCE
+(``serving.num_stream_compiles`` vs ``num_stream_replays``): the p2p
+batch itself re-validates the generation on every start and rebuilds
+transparently, so a breaker open / FT verdict / grow between pages
+recompiles the channel instead of replaying into a dead peer.
+
+Page-table bookkeeping is the delivery contract: the prefill side keeps
+every page (and its crc32) until the request closes, the decode side
+assembles pages by sequence number, and :meth:`KVStreamer.verify`
+compares the assembly byte-for-byte against the producer copy. A decode
+rank reassignment (churn) clears the assembly and re-streams from the
+retained producer pages — no page is ever lost (the store outlives the
+stream) and none duplicated (the assembly restarts empty, and a page
+sequence number can hold only one payload).
+
+Chaos: the ``serving.page`` site fires BEFORE a page batch dispatches,
+so a raise never leaves a page half-streamed — the page stays
+undelivered and the engine re-streams it on a later step.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..obs import trace as obstrace
+from ..ops import dtypes
+from ..parallel import p2p, tags
+from ..parallel.communicator import Communicator
+from ..runtime import faults, invalidation
+from ..utils import counters as ctr
+
+
+class KVStreamError(RuntimeError):
+    """A decode-side KV assembly failed byte-exact verification against
+    the producer pages — the transport delivered wrong bytes (or the
+    bookkeeping interleaved two requests' pages). Diagnostics name the
+    request and the first mismatching page."""
+
+    def __init__(self, rid: int, detail: str):
+        super().__init__(f"KV assembly verification failed for request "
+                         f"{rid}: {detail}")
+        self.rid = rid
+
+
+class _Channel:
+    """One (prefill, decode) persistent page channel: a send/recv pair
+    replayed per page. ``token`` mirrors the invalidation generation the
+    batch was last started under — compile-vs-replay evidence only."""
+
+    __slots__ = ("sbuf", "rbuf", "sreq", "rreq", "token")
+
+    def __init__(self, comm: Communicator, prefill: int, decode: int,
+                 page_bytes: int):
+        self.sbuf = comm.alloc(page_bytes)
+        self.rbuf = comm.alloc(page_bytes)
+        self.sreq = p2p.PersistentRequest(
+            "send", comm, prefill, self.sbuf, decode, dtypes.BYTE,
+            page_bytes, tags.KV_STREAM, 0, internal=True)
+        self.rreq = p2p.PersistentRequest(
+            "recv", comm, decode, self.rbuf, prefill, dtypes.BYTE,
+            page_bytes, tags.KV_STREAM, 0, internal=True)
+        self.token: Optional[int] = None
+
+
+class _RequestPages:
+    """Page table for one request: the producer-side pages (kept until
+    close — the re-stream source under churn), their crc32s, and the
+    decode-side delivery/assembly state."""
+
+    __slots__ = ("rid", "prefill_rank", "decode_rank", "pages", "crcs",
+                 "nbytes", "delivered", "assembly", "prior")
+
+    def __init__(self, rid: int, prefill_rank: int, decode_rank: int,
+                 pages: List[np.ndarray]):
+        self.rid = rid
+        self.prefill_rank = prefill_rank
+        self.decode_rank = decode_rank
+        self.pages = pages
+        self.crcs = [zlib.crc32(p.tobytes()) for p in pages]
+        self.nbytes = int(sum(p.size for p in pages))
+        self.delivered: Set[int] = set()
+        self.assembly: Dict[int, np.ndarray] = {}
+        # sequence numbers delivered to a PREVIOUS decode rank before a
+        # reassignment — re-sending one counts as a restream, not a loss
+        self.prior: Set[int] = set()
+
+
+class KVStreamer:
+    """The paged KV block store + streamer for one communicator."""
+
+    def __init__(self, comm: Communicator, page_bytes: int):
+        if page_bytes <= 0:
+            raise ValueError(f"bad page_bytes {page_bytes}: want positive")
+        self.comm = comm
+        self.page_bytes = int(page_bytes)
+        self._channels: Dict[Tuple[int, int], _Channel] = {}
+        self._requests: Dict[int, _RequestPages] = {}
+
+    # -- request lifecycle ----------------------------------------------------
+
+    def open_request(self, rid: int, prefill_rank: int, decode_rank: int,
+                     kv: np.ndarray) -> int:
+        """Paginate ``kv`` (uint8 bytes) into the store; returns the page
+        count. The producer pages persist until :meth:`close_request` —
+        the invariant churn re-streaming relies on."""
+        if rid in self._requests:
+            raise ValueError(f"request {rid} already open")
+        flat = np.ascontiguousarray(kv, dtype=np.uint8).reshape(-1)
+        if flat.size == 0:
+            raise ValueError(f"request {rid}: empty KV payload")
+        pb = self.page_bytes
+        pages = [flat[i:i + pb].copy() for i in range(0, flat.size, pb)]
+        self._requests[rid] = _RequestPages(rid, prefill_rank, decode_rank,
+                                            pages)
+        return len(pages)
+
+    def pending(self, rid: int) -> int:
+        st = self._req(rid)
+        return len(st.pages) - len(st.delivered)
+
+    def complete(self, rid: int) -> bool:
+        st = self._req(rid)
+        return len(st.delivered) == len(st.pages)
+
+    def close_request(self, rid: int) -> None:
+        """Drop the page table (producer pages included) — only after
+        the request fully decoded; verification is impossible past it."""
+        self._requests.pop(rid, None)
+
+    def _req(self, rid: int) -> _RequestPages:
+        st = self._requests.get(rid)
+        if st is None:
+            raise KeyError(f"unknown serving request {rid}")
+        return st
+
+    # -- streaming ------------------------------------------------------------
+
+    def push(self, rid: int, max_pages: int = 1) -> int:
+        """Stream up to ``max_pages`` undelivered pages of ``rid`` in
+        sequence order; returns how many were delivered. An
+        :class:`~tempi_tpu.runtime.faults.InjectedFault` from the
+        ``serving.page`` site propagates BEFORE the affected page
+        dispatches — already-delivered pages stay delivered, the faulted
+        page stays undelivered and re-streams on a later call."""
+        st = self._req(rid)
+        n = 0
+        for seq in range(len(st.pages)):
+            if n >= max_pages:
+                break
+            if seq in st.delivered:
+                continue
+            self._push_one(st, seq)
+            n += 1
+        return n
+
+    def _push_one(self, st: _RequestPages, seq: int) -> None:
+        # raise-before-dispatch: the chaos raise must fire while the page
+        # is still whole on the producer side (never half-streamed)
+        if faults.ENABLED:
+            faults.check("serving.page")
+        ch = self._channel(st.prefill_rank, st.decode_rank)
+        page = st.pages[seq]
+        padded = page
+        if page.size < self.page_bytes:
+            padded = np.zeros(self.page_bytes, dtype=np.uint8)
+            padded[: page.size] = page
+        rec = obstrace.ENABLED
+        t0 = time.monotonic() if rec else 0.0
+        tok = invalidation.current()
+        replay = ch.token == tok
+        ch.sbuf.set_rank(st.prefill_rank, padded)
+        p2p.startall([ch.sreq, ch.rreq])
+        p2p.waitall_persistent([ch.sreq, ch.rreq])
+        ch.token = tok
+        got = np.asarray(ch.rbuf.get_rank(st.decode_rank))[: page.size]
+        st.assembly[seq] = got.copy()
+        st.delivered.add(seq)
+        c = ctr.counters.serving
+        c.pages_streamed += 1
+        c.page_bytes += int(page.size)
+        if replay:
+            c.num_stream_replays += 1
+        else:
+            c.num_stream_compiles += 1
+        if seq in st.prior:
+            c.num_restreams += 1
+        if rec:
+            obstrace.emit_span("serving.stream", t0, rid=st.rid, page=seq,
+                               nbytes=int(page.size), replay=replay)
+
+    def _channel(self, prefill: int, decode: int) -> _Channel:
+        ch = self._channels.get((prefill, decode))
+        if ch is None:
+            ch = _Channel(self.comm, prefill, decode, self.page_bytes)
+            self._channels[(prefill, decode)] = ch
+        return ch
+
+    # -- verification ---------------------------------------------------------
+
+    def verify(self, rid: int) -> bool:
+        """Byte-exact assembly check: every page present, every page's
+        crc32 matching the producer's, and the concatenated assembly
+        equal to the producer payload. Raises :class:`KVStreamError` on
+        any mismatch (a transport-isolation bug, never expected)."""
+        st = self._req(rid)
+        if not self.complete(rid):
+            raise KVStreamError(
+                rid, f"incomplete: {self.pending(rid)} of "
+                     f"{len(st.pages)} pages undelivered")
+        for seq, page in enumerate(st.pages):
+            got = st.assembly.get(seq)
+            if got is None:
+                raise KVStreamError(rid, f"page {seq} delivered but "
+                                         "missing from assembly")
+            if zlib.crc32(got.tobytes()) != st.crcs[seq] or \
+                    not np.array_equal(got, page):
+                raise KVStreamError(
+                    rid, f"page {seq} bytes differ from producer copy "
+                         f"({page.size}B)")
+        ctr.counters.serving.num_verified += 1
+        return True
+
+    def assembled(self, rid: int) -> np.ndarray:
+        """The decode-side bytes in sequence order (test convenience)."""
+        st = self._req(rid)
+        return np.concatenate([st.assembly[s]
+                               for s in range(len(st.pages))]) \
+            if st.assembly else np.zeros(0, dtype=np.uint8)
+
+    # -- churn ----------------------------------------------------------------
+
+    def reassign(self, rid: int, decode_rank: int,
+                 prefill_rank: Optional[int] = None) -> int:
+        """Move a request to a new decode rank (rank failure / shrink):
+        the assembly restarts EMPTY (no page duplicated into it) and
+        every page re-streams from the retained producer copy (none
+        lost). Returns the page count to re-stream."""
+        st = self._req(rid)
+        st.prior |= st.delivered
+        st.delivered = set()
+        st.assembly = {}
+        st.decode_rank = decode_rank
+        if prefill_rank is not None:
+            st.prefill_rank = prefill_rank
+        return len(st.pages)
+
+    def rebind(self, comm: Communicator) -> None:
+        """Adopt a post-shrink/grow communicator: every channel drops
+        (their persistent requests belong to the old comm) and rebuilds
+        lazily on the next push. Page tables survive — delivery state is
+        per-request, not per-channel."""
+        self.comm = comm
+        self._channels = {}

@@ -1,0 +1,96 @@
+"""Seeded open-loop request generation (Poisson arrivals).
+
+Serving benchmarks need OPEN-loop load: arrivals keep coming at the
+configured rate whether or not the system keeps up, so queueing delay is
+measured instead of hidden (a closed loop self-throttles and flatters
+the tail). Arrivals are exponential inter-arrival draws at
+``TEMPI_SERVE_QPS``; per-request prompt/output lengths draw uniformly
+from caller-supplied bounds. Everything derives from one
+``random.Random(seed)`` stream, so a (seed, qps, bounds) tuple names a
+reproducible trace — the property tests and the bench replay identical
+request sequences across QoS-on/QoS-off phases.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..utils import env as envmod
+
+
+@dataclass(frozen=True)
+class Request:
+    """One inference request: ``kv_bytes`` is the KV-cache payload the
+    prefill rank produces and streams (prompt_tokens * bytes_per_token
+    at generation time — fixed at generation so reassignment after a
+    rank failure re-streams the SAME payload)."""
+
+    rid: int
+    arrival_s: float       # offset from trace start (open-loop clock)
+    prompt_tokens: int
+    output_tokens: int
+    kv_bytes: int
+
+
+class RequestGenerator:
+    """Open-loop Poisson trace generator. ``qps``/``seed`` default to the
+    parsed env knobs (TEMPI_SERVE_QPS / TEMPI_SERVE_SEED); explicit
+    arguments override (test convenience, same contract as subsystem
+    ``configure()`` overrides)."""
+
+    def __init__(self, qps: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 prompt_tokens: Tuple[int, int] = (16, 128),
+                 output_tokens: Tuple[int, int] = (4, 32),
+                 bytes_per_token: int = 64):
+        q = qps if qps is not None else \
+            getattr(envmod.env, "serve_qps", 32.0)
+        s = seed if seed is not None else \
+            getattr(envmod.env, "serve_seed", 0)
+        if not q > 0:
+            raise ValueError(f"bad qps {q!r}: want a positive rate "
+                             "(requests/second)")
+        for name, lo, hi in (("prompt_tokens", *prompt_tokens),
+                             ("output_tokens", *output_tokens)):
+            if not (0 < lo <= hi):
+                raise ValueError(
+                    f"bad {name} bounds ({lo}, {hi}): want 0 < lo <= hi")
+        if bytes_per_token <= 0:
+            raise ValueError(
+                f"bad bytes_per_token {bytes_per_token}: want positive")
+        self.qps = float(q)
+        self.seed = int(s)
+        self.prompt_tokens = prompt_tokens
+        self.output_tokens = output_tokens
+        self.bytes_per_token = int(bytes_per_token)
+        self._rng = random.Random(self.seed)
+        self._clock = 0.0
+        self._next_rid = 0
+
+    def set_qps(self, qps: float) -> None:
+        """Ramp the arrival rate mid-trace (takes effect from the next
+        draw; rids and the arrival clock continue — the QPS-ramp bench's
+        lever)."""
+        if not qps > 0:
+            raise ValueError(f"bad qps {qps!r}: want a positive rate "
+                             "(requests/second)")
+        self.qps = float(qps)
+
+    def generate(self, n: int) -> List[Request]:
+        """The next ``n`` requests of the trace (cumulative arrival
+        clock: calling twice continues where the first call stopped, so
+        a bench can ramp QPS by swapping generators mid-trace without
+        reusing rids)."""
+        out: List[Request] = []
+        rng = self._rng
+        for _ in range(int(n)):
+            self._clock += rng.expovariate(self.qps)
+            pt = rng.randint(*self.prompt_tokens)
+            ot = rng.randint(*self.output_tokens)
+            out.append(Request(rid=self._next_rid, arrival_s=self._clock,
+                               prompt_tokens=pt, output_tokens=ot,
+                               kv_bytes=pt * self.bytes_per_token))
+            self._next_rid += 1
+        return out

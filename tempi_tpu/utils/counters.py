@@ -224,6 +224,30 @@ class IntegrityCounters:
 
 
 @dataclass
+class ServingCounters:
+    # inference serving (ISSUE 18; serving/engine.py + kv_stream.py):
+    # pinned at zero with TEMPI_SERVE unset — the counter-based
+    # byte-for-byte guard that the off path admits, streams, and
+    # decodes nothing
+    num_requests: int = 0        # requests admitted to an engine
+    num_completed: int = 0       # requests fully decoded
+    num_prefills: int = 0        # prefill passes run (KV produced)
+    num_decode_steps: int = 0    # decode scheduler steps run
+    num_route_exchanges: int = 0  # expert-routing alltoallv replays
+    pages_streamed: int = 0      # KV pages delivered prefill -> decode
+    page_bytes: int = 0          # payload bytes those pages carried
+    num_stream_compiles: int = 0  # page-channel batches (re)compiled
+    num_stream_replays: int = 0   # page pushes that replayed a batch
+    num_page_faults: int = 0     # serving.page chaos raises absorbed
+                                 # (the page re-streams, never half-sent)
+    num_verified: int = 0        # requests whose KV assembly
+                                 # byte-verified against the prefill copy
+    num_restreams: int = 0       # pages re-sent after a decode-rank
+                                 # reassignment (churn, never duplicated
+                                 # into an assembly)
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -255,6 +279,7 @@ class Counters:
     autopilot: AutopilotCounters = field(default_factory=AutopilotCounters)
     lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
     integrity: IntegrityCounters = field(default_factory=IntegrityCounters)
+    serving: ServingCounters = field(default_factory=ServingCounters)
 
     def as_dict(self) -> dict:
         out = {}

@@ -394,6 +394,32 @@ the README "Data integrity" section):
                          zero/negative rejected loudly — a zero chunk
                          would loop forever carving empty slices)
 
+Inference-serving knobs (ISSUE 18; serving/engine.py, serving/kv_stream.py):
+  TEMPI_SERVE          off (default) | on. ``on`` arms the
+                         prefill/decode-disaggregated serving subsystem:
+                         ServingEngine construction is permitted, KV
+                         pages stream over persistent p2p at the
+                         reserved KV_STREAM tag, and request-level
+                         TTFT/inter-token spans feed obs/metrics. Off
+                         is inert: construction refuses with a pointer
+                         and the serving.* counter group stays pinned
+                         at zero (the counter-based byte-for-byte
+                         guard). TEMPI_DISABLE forces off.
+  TEMPI_SERVE_PAGE_BYTES  fixed KV page size in bytes (default 4096).
+                         Zero/negative rejected loudly — a zero page
+                         would stream a request's cache as infinitely
+                         many empty pages.
+  TEMPI_SERVE_QPS      default open-loop arrival rate for the request
+                         generator, requests/second (default 32).
+                         Zero/negative/non-finite rejected loudly — a
+                         zero rate means the generator never emits and
+                         the serving run silently measures nothing.
+  TEMPI_SERVE_SEED     request-generator seed (default 0): arrivals and
+                         per-request prompt/output lengths are a pure
+                         function of (seed, request index), so a latency
+                         anomaly observed at request N reproduces from
+                         the same knobs.
+
 Per-call boolean/integer escape hatches read OUTSIDE read_environment
 (consulted at call time so tests and benches can flip them mid-session;
 loud-parsed via bool_env/int_env below):
@@ -525,6 +551,11 @@ KNOWN_KNOBS = (
     # end-to-end data integrity (ISSUE 17)
     "TEMPI_INTEGRITY",
     "TEMPI_INTEGRITY_CHUNK_BYTES",
+    # inference serving (ISSUE 18)
+    "TEMPI_SERVE",
+    "TEMPI_SERVE_PAGE_BYTES",
+    "TEMPI_SERVE_QPS",
+    "TEMPI_SERVE_SEED",
     # multi-host world coordinates (parallel/multihost.py)
     "TEMPI_COORDINATOR",
     "TEMPI_NUM_PROCESSES",
@@ -704,6 +735,11 @@ class Environment:
     # end-to-end payload integrity (ISSUE 17) — see runtime/integrity.py
     integrity_mode: str = "off"    # off | verify | retransmit
     integrity_chunk_bytes: int = 1 << 20  # checksum chunk granularity
+    # inference serving (ISSUE 18) — see serving/engine.py
+    serve_mode: str = "off"        # off | on
+    serve_page_bytes: int = 4096   # fixed KV page size in bytes
+    serve_qps: float = 32.0        # default open-loop arrival rate
+    serve_seed: int = 0            # request-generator seed
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -1152,6 +1188,41 @@ class Environment:
                 "integer (bytes)")
         e.integrity_chunk_bytes = cb
 
+        # serving knobs parse loudly too: a typo'd TEMPI_SERVE silently
+        # staying off would refuse every ServingEngine in the one
+        # deployment that asked to serve — and a typo'd page size or
+        # arrival rate would quietly change what the serving bench
+        # measured
+        sv = (getenv("TEMPI_SERVE") or "off").lower()
+        if sv not in ("off", "on"):
+            raise ValueError(f"bad TEMPI_SERVE={sv!r}: want off | on")
+        e.serve_mode = sv
+        v = getenv("TEMPI_SERVE_PAGE_BYTES")
+        try:
+            pb = int(v) if v else 4096
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_SERVE_PAGE_BYTES={v!r}: want a positive "
+                "integer (bytes)") from exc
+        if pb <= 0:
+            # no silent clamp: a zero page would carve a request's cache
+            # into infinitely many empty pages — loud refusal, like
+            # TEMPI_INTEGRITY_CHUNK_BYTES
+            raise ValueError(
+                f"bad TEMPI_SERVE_PAGE_BYTES={v!r}: want a positive "
+                "integer (bytes)")
+        e.serve_page_bytes = pb
+        e.serve_qps = _float_env("TEMPI_SERVE_QPS", 32.0,
+                                 unit="requests/second")
+        if e.serve_qps == 0.0:
+            # _float_env admits zero (a zero timeout is meaningful); a
+            # zero arrival rate is not — the generator would never emit
+            # and the serving run would silently measure nothing
+            raise ValueError(
+                "bad TEMPI_SERVE_QPS=0: want a positive arrival rate "
+                "(requests/second)")
+        e.serve_seed = _pos_int_env("TEMPI_SERVE_SEED", 0)
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -1208,6 +1279,9 @@ class Environment:
             # the library's own lowerings — there is no framework-
             # performed copy boundary left to checksum
             e.integrity_mode = "off"
+            # ...and the serving subsystem: its KV streams and routing
+            # ride the persistent machinery the bail-out turns off
+            e.serve_mode = "off"
             # TEMPI_LOCKCHECK deliberately survives the bail-out: the
             # lock-order checker observes the framework's own locks (which
             # exist regardless of interposition) and is developer tooling,

@@ -79,6 +79,11 @@ def pytest_configure(config):
         "integrity: end-to-end payload integrity tests — checksum "
         "properties, seeded corruption chaos, verified retransmit (the "
         "<30s smoke is `pytest -m integrity`)")
+    config.addinivalue_line(
+        "markers",
+        "serving: inference-serving tests — byte-exact KV streaming, "
+        "request-latency metrics, page-fault chaos, churn rebinds (the "
+        "<30s smoke is `pytest -m serving`)")
 
 
 @pytest.fixture(autouse=True)
@@ -91,6 +96,7 @@ def _reset_globals():
     from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import (autopilot, elastic, faults, health,
                                    integrity, liveness, qos)
+    from tempi_tpu.serving import engine as serving_engine
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env, locks
 
@@ -107,6 +113,7 @@ def _reset_globals():
     elastic.configure()
     autopilot.configure()
     integrity.configure()
+    serving_engine.configure()
     counters.init()
     health.reset()
     yield
@@ -125,4 +132,5 @@ def _reset_globals():
     elastic.configure("off")
     autopilot.disarm()
     integrity.configure("off")
+    serving_engine.disarm()
     locks.configure("off")

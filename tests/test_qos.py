@@ -509,3 +509,31 @@ def test_qos_snapshot_pure_data_before_init():
     assert set(snap["classes"]) == set(qos.CLASSES)
     import json
     json.dumps(snap)  # pure data, serializable
+
+
+def test_snapshot_audits_configured_vs_live_weights(monkeypatch):
+    """ISSUE 18 satellite: a runtime set_weights swap (operator or the
+    autopilot's flood actuator) must be auditable from the snapshot
+    alone — configured vs live weights, overridden flag, and the swap's
+    reason string; a restore clears the flag but keeps the last
+    reason."""
+    monkeypatch.setenv("TEMPI_QOS_DEFAULT", "bulk")
+    envmod.read_environment()
+    qos.configure()
+    w0 = api.qos_snapshot()["weights"]
+    assert w0["configured"] == w0["live"]
+    assert w0["overridden"] is False and w0["reason"] is None
+    flood = {"latency": 8, "default": 2, "bulk": 1}
+    old = qos.set_weights(flood, reason="autopilot: bulk flood")
+    w1 = api.qos_snapshot()["weights"]
+    assert w1["configured"] == w0["configured"] == old
+    assert w1["live"] == flood
+    assert w1["overridden"] is True
+    assert w1["reason"] == "autopilot: bulk flood"
+    qos.set_weights(old, reason="autopilot: restore")
+    w2 = api.qos_snapshot()["weights"]
+    assert w2["overridden"] is False
+    assert w2["reason"] == "autopilot: restore"
+    # re-configure re-bases the audit (per-session, like counters)
+    qos.configure()
+    assert api.qos_snapshot()["weights"]["reason"] is None

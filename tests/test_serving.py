@@ -1,0 +1,379 @@
+"""Inference serving (ISSUE 18): knob loud-parsing and the off-mode
+byte-for-byte pins, the seeded request generator, byte-exact KV page
+streaming (ragged final pages, interleaved requests, multiple decode
+ranks), the prefill -> stream -> decode engine with its request-level
+metrics feed, and the churn story — a decode rank dies mid-stream, the
+engine rebinds across shrink and grow with no page lost or duplicated.
+
+Marker ``serving`` is the tier-1-compatible <30s smoke (`pytest -m
+serving`); the chaos variants are dual-marked ``faults`` so the chaos
+smoke exercises the ``serving.page`` site's raise-before-dispatch
+contract."""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.models import kv_serving
+from tempi_tpu.runtime import faults, invalidation
+from tempi_tpu.serving import engine as serving
+from tempi_tpu.serving.kv_stream import KVStreamer, KVStreamError
+from tempi_tpu.serving.requests import Request, RequestGenerator
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+def _arm(monkeypatch, **extra):
+    """Arm serving mid-test (the integrity.configure idiom: the world
+    fixture init ran with the default env; re-read + re-configure)."""
+    monkeypatch.setenv("TEMPI_SERVE", "on")
+    for k, v in extra.items():
+        monkeypatch.setenv(k, str(v))
+    envmod.read_environment()
+    serving.configure()
+
+
+def _req(rid, output_tokens=3, kv_bytes=200):
+    return Request(rid=rid, arrival_s=0.0, prompt_tokens=4,
+                   output_tokens=output_tokens, kv_bytes=kv_bytes)
+
+
+def _payload(seed, rid, nbytes):
+    return np.random.default_rng((seed, rid)).integers(
+        0, 256, size=nbytes, dtype=np.uint8)
+
+
+# -- knob loud-parsing ---------------------------------------------------------
+
+
+def test_serve_knob_loud_parse(monkeypatch):
+    monkeypatch.setenv("TEMPI_SERVE", "maybe")
+    with pytest.raises(ValueError, match="TEMPI_SERVE"):
+        envmod.read_environment()
+    monkeypatch.setenv("TEMPI_SERVE", "ON")  # case-insensitive
+    assert envmod.read_environment().serve_mode == "on"
+
+
+@pytest.mark.parametrize("bad", ["0", "-4", "x"])
+def test_page_bytes_knob_loud_parse(monkeypatch, bad):
+    monkeypatch.setenv("TEMPI_SERVE_PAGE_BYTES", bad)
+    with pytest.raises(ValueError, match="TEMPI_SERVE_PAGE_BYTES"):
+        envmod.read_environment()
+
+
+@pytest.mark.parametrize("bad", ["0", "-1", "nan", "x"])
+def test_qps_knob_loud_parse(monkeypatch, bad):
+    monkeypatch.setenv("TEMPI_SERVE_QPS", bad)
+    with pytest.raises(ValueError, match="TEMPI_SERVE_QPS"):
+        envmod.read_environment()
+
+
+def test_seed_knob_loud_parse(monkeypatch):
+    monkeypatch.setenv("TEMPI_SERVE_SEED", "-1")
+    with pytest.raises(ValueError, match="TEMPI_SERVE_SEED"):
+        envmod.read_environment()
+
+
+def test_disable_forces_serving_off(monkeypatch):
+    monkeypatch.setenv("TEMPI_SERVE", "on")
+    monkeypatch.setenv("TEMPI_DISABLE", "1")
+    assert envmod.read_environment().serve_mode == "off"
+    serving.configure()
+    assert not serving.ENABLED
+
+
+def test_configure_rejects_bad_mode():
+    with pytest.raises(ValueError, match="bad serve mode"):
+        serving.configure("sideways")
+    assert not serving.ENABLED
+
+
+# -- off-path inertness (the counter-pinned byte-for-byte guard) ---------------
+
+
+def test_off_path_is_inert_and_counter_pinned(world):
+    """With TEMPI_SERVE unset: construction refuses with a pointer,
+    persistent p2p traffic moves ZERO serving counters, and the snapshot
+    reads inert — the off path touches nothing."""
+    assert not serving.ENABLED
+    with pytest.raises(RuntimeError, match="TEMPI_SERVE=on"):
+        serving.ServingEngine(world)
+    from tempi_tpu.ops import dtypes as dt
+    from tempi_tpu.parallel import p2p
+    ty = dt.contiguous(64, dt.BYTE)
+    sbuf, rbuf = world.alloc(64), world.alloc(64)
+    sreq = p2p.send_init(world, 0, sbuf, 1, ty)
+    rreq = p2p.recv_init(world, 1, rbuf, 0, ty)
+    for _ in range(3):
+        p2p.startall([sreq, rreq])
+        p2p.waitall_persistent([sreq, rreq])
+    assert all(v == 0
+               for v in api.counters_snapshot()["serving"].values())
+    snap = api.serving_snapshot()
+    assert snap["mode"] == "off" and not snap["enabled"]
+    assert snap["submitted"] == 0 and snap["completed"] == 0
+
+
+# -- request generator ---------------------------------------------------------
+
+
+def test_generator_is_deterministic_and_open_loop():
+    a = RequestGenerator(qps=100.0, seed=7).generate(32)
+    b = RequestGenerator(qps=100.0, seed=7).generate(32)
+    assert a == b
+    assert a != RequestGenerator(qps=100.0, seed=8).generate(32)
+    # arrivals strictly increase (open-loop cumulative clock) and the
+    # mean inter-arrival tracks 1/qps
+    gaps = np.diff([0.0] + [r.arrival_s for r in a])
+    assert (gaps > 0).all()
+    many = RequestGenerator(qps=50.0, seed=3).generate(600)
+    assert many[-1].arrival_s / 600 == pytest.approx(1 / 50.0, rel=0.25)
+    # kv_bytes is fixed at generation: prompt_tokens * bytes_per_token
+    assert all(r.kv_bytes == r.prompt_tokens * 64 for r in a)
+
+
+def test_generator_continues_and_ramps():
+    g = RequestGenerator(qps=10.0, seed=1)
+    first = g.generate(4)
+    more = g.generate(4)
+    assert [r.rid for r in first + more] == list(range(8))
+    assert more[0].arrival_s > first[-1].arrival_s
+    with pytest.raises(ValueError, match="positive rate"):
+        g.set_qps(0.0)
+    g.set_qps(1000.0)
+    assert g.generate(1)[0].rid == 8
+
+
+def test_generator_validates_bounds(monkeypatch):
+    with pytest.raises(ValueError, match="positive rate"):
+        RequestGenerator(qps=-1.0)
+    with pytest.raises(ValueError, match="prompt_tokens"):
+        RequestGenerator(qps=1.0, prompt_tokens=(0, 4))
+    with pytest.raises(ValueError, match="bytes_per_token"):
+        RequestGenerator(qps=1.0, bytes_per_token=0)
+
+
+# -- byte-exact KV streaming ---------------------------------------------------
+
+
+def test_ragged_final_page_streams_byte_exact(world, monkeypatch):
+    """Property: a payload that is NOT a page multiple assembles exactly
+    — the ragged final page carries only its leading bytes."""
+    _arm(monkeypatch)
+    ks = KVStreamer(world, page_bytes=64)
+    for rid, nbytes in enumerate((1, 63, 64, 65, 200, 64 * 3)):
+        kv = _payload(0, rid, nbytes)
+        pages = ks.open_request(rid, 0, world.size - 1, kv)
+        assert pages == -(-nbytes // 64)
+        while not ks.complete(rid):
+            ks.push(rid, max_pages=2)
+        assert ks.verify(rid)
+        np.testing.assert_array_equal(ks.assembled(rid), kv)
+    c = api.counters_snapshot()["serving"]
+    assert c["num_verified"] == 6
+    assert c["page_bytes"] == sum((1, 63, 64, 65, 200, 64 * 3))
+    # one channel pair: first page compiled the batch, the rest replayed
+    assert c["num_stream_compiles"] >= 1
+    assert c["num_stream_replays"] > 0
+
+
+def test_interleaved_requests_do_not_cross_pages(world, monkeypatch):
+    """Pages of several requests interleave arbitrarily across multiple
+    decode ranks and still assemble byte-exact — the page-table keys by
+    (request, sequence), never by arrival order."""
+    _arm(monkeypatch)
+    ks = KVStreamer(world, page_bytes=32)
+    rng = np.random.default_rng(11)
+    payloads = {rid: _payload(1, rid, int(rng.integers(40, 300)))
+                for rid in range(6)}
+    for rid, kv in payloads.items():
+        ks.open_request(rid, rid % 2, 2 + rid % (world.size - 2), kv)
+    live = set(payloads)
+    while live:
+        rid = int(rng.choice(sorted(live)))
+        ks.push(rid, max_pages=1)
+        if ks.complete(rid):
+            assert ks.verify(rid)
+            np.testing.assert_array_equal(ks.assembled(rid),
+                                          payloads[rid])
+            live.discard(rid)
+    assert api.counters_snapshot()["serving"]["num_verified"] == 6
+
+
+def test_verify_names_a_corrupted_page(world, monkeypatch):
+    _arm(monkeypatch)
+    ks = KVStreamer(world, page_bytes=16)
+    kv = _payload(2, 0, 40)
+    ks.open_request(0, 0, 1, kv)
+    while not ks.complete(0):
+        ks.push(0)
+    ks._req(0).assembly[1][0] ^= 0xFF  # simulate a byte-wrong delivery
+    with pytest.raises(KVStreamError, match="page 1"):
+        ks.verify(0)
+
+
+def test_invalidation_recompiles_the_page_channel(world, monkeypatch):
+    """A generation bump between pages (breaker/FT/grow trigger) must
+    recompile the channel batch, not replay into stale state — visible
+    as a second num_stream_compiles increment."""
+    _arm(monkeypatch)
+    ks = KVStreamer(world, page_bytes=32)
+    ks.open_request(0, 0, 1, _payload(3, 0, 96))
+    ks.push(0)
+    before = api.counters_snapshot()["serving"]
+    assert before["num_stream_compiles"] == 1
+    invalidation.bump("test", "serving channel recompile")
+    ks.push(0)
+    after = api.counters_snapshot()["serving"]
+    assert after["num_stream_compiles"] == 2
+    while not ks.complete(0):
+        ks.push(0)
+    assert ks.verify(0)
+
+
+# -- the engine ----------------------------------------------------------------
+
+
+def test_engine_validates_rank_sets(world, monkeypatch):
+    _arm(monkeypatch)
+    with pytest.raises(ValueError, match="overlap"):
+        serving.ServingEngine(world, prefill_ranks=[0, 1],
+                              decode_ranks=[1, 2])
+    with pytest.raises(ValueError, match="non-empty"):
+        serving.ServingEngine(world, prefill_ranks=[0], decode_ranks=[])
+    with pytest.raises(ValueError, match="out of range"):
+        serving.ServingEngine(world, prefill_ranks=[0],
+                              decode_ranks=[world.size])
+
+
+def test_engine_serves_end_to_end(world, monkeypatch):
+    """The acceptance loop: open-loop trace in, every request admitted,
+    streamed, byte-verified, and decoded to completion; counters and the
+    snapshot carry the request-latency evidence."""
+    _arm(monkeypatch, TEMPI_SERVE_PAGE_BYTES=1024)
+    rec = kv_serving.serve(world, num_requests=6, qps=500.0, seed=5)
+    assert rec["completed"] == 6
+    assert rec["verified"] >= 6 and rec["page_faults"] == 0
+    assert len(rec["ttft_s"]) == 6 and all(t > 0 for t in rec["ttft_s"])
+    assert rec["itl_s"] and all(t >= 0 for t in rec["itl_s"])
+    c = api.counters_snapshot()["serving"]
+    assert c["num_requests"] == 6 and c["num_completed"] == 6
+    assert c["num_prefills"] == 6 and c["num_decode_steps"] > 0
+    assert c["pages_streamed"] > 0
+    # >= 2 decode ranks under the default split: routing replayed
+    assert c["num_route_exchanges"] == c["num_decode_steps"]
+    snap = api.serving_snapshot()
+    assert snap["completed"] == 6 and snap["ttft"]["count"] == 6
+    assert snap["ttft"]["p99_s"] >= snap["ttft"]["p50_s"] > 0
+
+
+def test_request_spans_feed_metrics_histograms(monkeypatch):
+    """With TEMPI_METRICS=on the ttft/itl spans land as
+    ``serving.request`` histograms keyed by strategy — the signal
+    api.metrics_snapshot() reports and the autopilot SLO gate watches
+    (serving.request is in autopilot.WATCH_SPANS)."""
+    from tempi_tpu.runtime import autopilot
+    assert "serving.request" in autopilot.WATCH_SPANS
+    monkeypatch.setenv("TEMPI_METRICS", "on")
+    monkeypatch.setenv("TEMPI_SERVE", "on")
+    comm = api.init()
+    try:
+        rec = kv_serving.serve(comm, num_requests=4, qps=500.0, seed=9)
+        assert rec["completed"] == 4
+        hists = {(h["span"], h["strategy"]): h["count"]
+                 for h in api.metrics_snapshot()["histograms"]}
+        assert hists[("serving.request", "ttft")] == 4
+        assert hists[("serving.request", "itl")] == sum(
+            len(r["itl_s"]) for r in serving.completed_records())
+    finally:
+        api.finalize()
+
+
+# -- serving.page chaos (dual-marked: the faults smoke drives it too) ----------
+
+
+@pytest.mark.faults
+def test_page_fault_raise_retries_and_stays_byte_exact(world, monkeypatch):
+    """raise-before-dispatch: an injected page fault leaves the page
+    undelivered (never half-streamed); the engine absorbs it, retries on
+    later steps, and every assembly still byte-verifies."""
+    _arm(monkeypatch, TEMPI_SERVE_PAGE_BYTES=512)
+    faults.configure("serving.page:raise:0.4:17")
+    rec = kv_serving.serve(world, num_requests=5, qps=500.0, seed=6)
+    assert rec["completed"] == 5
+    c = api.counters_snapshot()["serving"]
+    assert c["num_page_faults"] > 0  # the chaos actually fired
+    assert c["num_verified"] >= 5   # ...and every cache verified anyway
+    st = faults.stats()["serving.page"][0]
+    assert st["fired"] == c["num_page_faults"]
+
+
+@pytest.mark.faults
+def test_page_fault_wedge_is_refused():
+    with pytest.raises(faults.FaultSpecError, match="not supported"):
+        faults.configure("serving.page:wedge:1.0:1")
+    faults.configure("serving.page:raise:1.0:1")  # raise/delay stay fine
+    faults.reset()
+
+
+# -- churn: kill -> shrink -> rebind -> regrow, no lost/duplicated pages -------
+
+
+def test_serving_survives_shrink_and_grow(monkeypatch):
+    """The churn acceptance story on one engine: requests are mid-stream
+    when their decode rank is declared dead; shrink + rebind re-streams
+    from the retained producer pages (restreams counted, nothing lost),
+    the assembly restarts empty (nothing duplicated), every request
+    completes byte-verified; then the rank rejoins, the world grows, and
+    the SAME engine serves the re-expanded world."""
+    monkeypatch.setenv("TEMPI_SERVE", "on")
+    monkeypatch.setenv("TEMPI_FT", "shrink")
+    monkeypatch.setenv("TEMPI_ELASTIC", "grow")
+    comm = api.init()
+    try:
+        size = comm.size
+        victim = size - 1  # a decode rank under the default half split
+        eng = serving.ServingEngine(comm, page_bytes=512)
+        gen = RequestGenerator(qps=500.0, seed=4)
+        for r in gen.generate(4):
+            eng.submit(r)
+        # two steps: all four requests admit (max_prefill_per_step=2)
+        # and each delivers pages — including toward the victim — so the
+        # post-shrink reassignment has something to re-stream
+        eng.step()
+        eng.step()
+        assert eng.outstanding() == 4
+        api.mark_failed(comm, victim)
+        surv = api.shrink(comm)
+        assert surv.size == size - 1
+        moved = eng.rebind(surv)
+        assert moved > 0  # the victim's requests were reassigned
+        assert eng.drain(20.0) == 4 and eng.outstanding() == 0
+        c1 = api.counters_snapshot()["serving"]
+        assert c1["num_restreams"] > 0   # re-sent, not lost
+        assert c1["num_verified"] >= 4   # byte-exact after reassignment
+        # rejoin + grow: the SAME engine keeps serving the bigger world
+        victim_dev = comm.devices[comm.library_rank(victim)]
+        assert api.announce_join(surv, [victim_dev])["outcome"] == \
+            "announced"
+        grown = api.grow(surv)
+        assert grown is not None and grown.size == size
+        eng.rebind(grown)
+        for r in gen.generate(3):
+            eng.submit(r)
+        assert eng.drain(20.0) == 7
+        assert api.counters_snapshot()["serving"]["num_completed"] == 7
+    finally:
+        api.finalize()
+
+
+# -- qos satellite lives in test_qos.py (configured-vs-live weights) -----------
