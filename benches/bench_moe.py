@@ -23,8 +23,18 @@ handle, replayed per step), per routing pattern — and with
 print to stderr like bench_persistent_alltoallv's, and the nonzero
 counters (coll.* including coll.reduce_*) via _common.report_counters.
 
+With ``--compress`` the persistent step re-measures under each
+requested TEMPI_REDCOLL_COMPRESS mode on the grads allreduce leg — the
+expert-gradient accumulator is exactly the traffic the compressed wire
+formats target (ISSUE 19). The dispatch/combine alltoallv legs are
+routed-token bytes and never compress. Per-replay grad wire bytes
+(from the byte-accurate per-dtype counters) land in grad_wire_bytes /
+grad_raw_bytes, and a per-pattern "moe grads compress" stderr line
+reports the step-time and wire-byte A/B vs the f32 arm.
+
 CSV columns: pattern, mode (oneshot|persistent), hier (flat|hier|-),
-step_s, dispatch_bytes, dropped_tokens.
+compress (off|bf16|fp8|int8|auto|-), step_s, dispatch_bytes,
+dropped_tokens, grad_wire_bytes, grad_raw_bytes.
 """
 
 import os
@@ -80,6 +90,10 @@ def main() -> int:
     p.add_argument("--ranks-per-node", type=int, default=0,
                    help="synthetic TEMPI_RANKS_PER_NODE topology enabling "
                         "the flat-vs-hier A/B on a CPU mesh")
+    p.add_argument("--compress", default="off",
+                   help="comma list over off|bf16|fp8|int8|auto: the "
+                        "grads allreduce leg re-measures under each "
+                        "TEMPI_REDCOLL_COMPRESS mode (ISSUE 19)")
     args = p.parse_args()
     if args.ranks_per_node:
         os.environ["TEMPI_RANKS_PER_NODE"] = str(args.ranks_per_node)
@@ -91,7 +105,15 @@ def main() -> int:
 
     from tempi_tpu import api
     from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import counters as ctr
     from tempi_tpu.utils import env as envmod
+
+    cmodes = [c.strip() for c in args.compress.split(",") if c.strip()]
+    for c in cmodes:
+        if c not in ("off", "bf16", "fp8", "int8", "auto"):
+            print(f"bad --compress entry {c!r}: want "
+                  "off|bf16|fp8|int8|auto", file=sys.stderr)
+            return 2
 
     devices_or_die(2)
     comm = api.init()
@@ -124,50 +146,85 @@ def main() -> int:
 
         oneshot_step()  # compile/caches hot
         r1 = benchmark(oneshot_step, **kw)
-        rows.append((pattern, "oneshot", "-", r1.trimean,
-                     int(counts.sum()), dropped))
+        rows.append((pattern, "oneshot", "-", "-", r1.trimean,
+                     int(counts.sum()), dropped, 0, 0))
         best.setdefault(pattern, {})["oneshot"] = r1.trimean
 
         for hmode in hier_modes:
-            envmod.env.coll_hier = hmode
-            pc_d = api.alltoallv_init(comm, tok_out, counts, sdispls,
-                                      tok_in, counts.T, rdispls)
-            pc_c = api.alltoallv_init(comm, tok_in, counts.T, rdispls,
-                                      tok_back, counts, sdispls)
-            pr_g = api.allreduce_init(comm, grads, dtype=np.float32,
-                                      op="sum")
+            for cmode in cmodes:
+                envmod.env.coll_hier = hmode
+                envmod.env.redcoll_compress = cmode
+                pc_d = api.alltoallv_init(comm, tok_out, counts, sdispls,
+                                          tok_in, counts.T, rdispls)
+                pc_c = api.alltoallv_init(comm, tok_in, counts.T, rdispls,
+                                          tok_back, counts, sdispls)
+                pr_g = api.allreduce_init(comm, grads, dtype=np.float32,
+                                          op="sum")
 
-            def persistent_step():
-                pc_d.start(); pc_d.wait()
-                pc_c.start(); pc_c.wait()
-                pr_g.start(); pr_g.wait()
-                tok_back.data.block_until_ready()
-                grads.data.block_until_ready()
+                def persistent_step():
+                    pc_d.start(); pc_d.wait()
+                    pc_c.start(); pc_c.wait()
+                    pr_g.start(); pr_g.wait()
+                    tok_back.data.block_until_ready()
+                    grads.data.block_until_ready()
 
-            persistent_step()  # first start pays any lazy compile
-            r2 = benchmark(persistent_step, **kw)
-            rows.append((pattern, "persistent", hmode, r2.trimean,
-                         int(counts.sum()), dropped))
-            best[pattern][hmode] = r2.trimean
-            for h in (pc_d, pc_c, pr_g):
-                h.free()
+                persistent_step()  # first start pays any lazy compile
+                # one counted replay: the grads leg's wire bytes (the
+                # alltoallv legs never touch the reduce wire counters)
+                w0 = ctr.counters.coll.reduce_wire_bytes
+                f0 = ctr.counters.coll.reduce_wire_bytes_f32
+                raw0 = ctr.counters.compress.raw_bytes
+                persistent_step()
+                gwire = ctr.counters.coll.reduce_wire_bytes - w0
+                graw = (ctr.counters.coll.reduce_wire_bytes_f32 - f0) \
+                    + (ctr.counters.compress.raw_bytes - raw0)
+                r2 = benchmark(persistent_step, **kw)
+                rows.append((pattern, "persistent", hmode, cmode,
+                             r2.trimean, int(counts.sum()), dropped,
+                             gwire, graw))
+                best[pattern][f"{hmode}:{cmode}"] = (r2.trimean, gwire,
+                                                     graw)
+                for h in (pc_d, pc_c, pr_g):
+                    h.free()
         envmod.env.coll_hier = "auto"
+        envmod.env.redcoll_compress = "off"
 
-    emit_csv(("pattern", "mode", "hier", "step_s", "dispatch_bytes",
-              "dropped_tokens"), rows)
-    # the per-pattern speedup report: persistent vs one-shot, hier vs flat
+    emit_csv(("pattern", "mode", "hier", "compress", "step_s",
+              "dispatch_bytes", "dropped_tokens", "grad_wire_bytes",
+              "grad_raw_bytes"), rows)
+    # the per-pattern speedup report: persistent vs one-shot, hier vs
+    # flat, and the grads-leg compress A/B vs the f32 arm
     for pattern, arms in best.items():
         one = arms.get("oneshot")
-        for hmode in hier_modes:
-            t = arms.get(hmode)
-            if one and t and t > 0:
-                print(f"moe speedup [{pattern}/{hmode}]: {one / t:.2f}x "
+        for lbl, v in sorted(arms.items()):
+            if lbl == "oneshot":
+                continue
+            t = v[0]
+            if one and t > 0:
+                print(f"moe speedup [{pattern}/{lbl}]: {one / t:.2f}x "
                       f"persistent vs one-shot", file=sys.stderr)
-        if "flat" in arms and "hier" in arms and arms["hier"] > 0:
-            print(f"moe hier speedup [{pattern}]: "
-                  f"{arms['flat'] / arms['hier']:.2f}x "
-                  f"(flat {arms['flat']:.3e}s vs hier "
-                  f"{arms['hier']:.3e}s)", file=sys.stderr)
+        for cmode in cmodes:
+            fl = arms.get(f"flat:{cmode}")
+            hi = arms.get(f"hier:{cmode}")
+            if fl and hi and hi[0] > 0:
+                print(f"moe hier speedup [{pattern}/{cmode}]: "
+                      f"{fl[0] / hi[0]:.2f}x "
+                      f"(flat {fl[0]:.3e}s vs hier {hi[0]:.3e}s)",
+                      file=sys.stderr)
+        for hmode in hier_modes:
+            base = arms.get(f"{hmode}:off")
+            if not base:
+                continue
+            for cmode in cmodes:
+                if cmode == "off":
+                    continue
+                v = arms.get(f"{hmode}:{cmode}")
+                if v and v[0] > 0 and v[1]:
+                    wr = f", {base[1] / v[1]:.2f}x fewer grad wire " \
+                         f"bytes ({base[1]} -> {v[1]})" if base[1] else ""
+                    print(f"moe grads compress [{pattern}/{hmode}/"
+                          f"{cmode}]: {base[0] / v[0]:.2f}x step time "
+                          f"vs f32{wr}", file=sys.stderr)
     api.finalize()
     return 0
 

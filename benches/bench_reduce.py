@@ -13,19 +13,36 @@ is the judged shape:
 
     python bench_reduce.py --cpu --cpu-devices 32 --ranks-per-node 4 --quick
 
+With ``--compress`` the compressed-wire A/B rides along (ISSUE 19):
+each round-plan arm re-measures under every requested
+TEMPI_REDCOLL_COMPRESS mode, the CSV grows compress/wire_bytes/raw_bytes
+columns (per-replay, from the byte-accurate per-dtype counters), and the
+headline stderr line compares hier-with-compressed-DCN against hier-f32
+— the shape where narrowing the wire is priced to pay. On a cpu mesh
+the TIME columns are honest about host staging (a compressed flat round
+pays the transform at host-wire speed and loses); the wire-bytes
+reduction column is the accelerator-portable evidence, and the modeled
+DCN comparison rides the hier arms.
+
 CSV columns: kind, alg (fused|ring|halving|hier_*), mode
-(oneshot|persistent), bytes, setup_s, time_s. Per-algorithm and
-hier-vs-flat speedup lines print to stderr; nonzero counters — including
-the coll.reduce_* evidence that the round plans actually ran — print via
-benches/_common.report_counters.
+(oneshot|persistent), compress (off|bf16|fp8|int8|auto), bytes, setup_s,
+time_s, wire_bytes, raw_bytes. Per-algorithm and hier-vs-flat speedup
+lines print to stderr; nonzero counters — including the coll.reduce_*
+per-dtype wire evidence that the round plans actually ran — print via
+benches/_common.report_counters. ``--json PATH`` additionally writes the
+rows plus the final counter snapshot as one numeric-flattenable JSON
+document for ``perf_report.py --compare`` (the BENCH trajectory diff).
 """
 
+import json
 import os
 import sys
 import time
 
 from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
     setup_platform
+
+COMPRESS_MODES = ("off", "bf16", "fp8", "int8", "auto")
 
 
 def main() -> int:
@@ -41,6 +58,15 @@ def main() -> int:
                         "mesh exercises the two-level reduction (0 = "
                         "discover from the platform; also enables the "
                         "hier-vs-flat A/B)")
+    p.add_argument("--compress", default="off",
+                   help="comma list over off|bf16|fp8|int8|auto: each "
+                        "round-plan arm re-measures under every "
+                        "requested TEMPI_REDCOLL_COMPRESS mode (the "
+                        "compressed-wire A/B, ISSUE 19); default off "
+                        "keeps the bench byte-for-byte the f32 one")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write rows + counters as one JSON doc for "
+                        "perf_report.py --compare")
     args = p.parse_args()
     if args.ranks_per_node:
         # before api.init(): topology discovery reads the knob there
@@ -52,6 +78,7 @@ def main() -> int:
     from tempi_tpu import api
     from tempi_tpu.coll import reduce as redsched
     from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.utils import counters as ctr
     from tempi_tpu.utils import env as envmod
 
     algs = [a.strip() for a in args.algs.split(",") if a.strip()]
@@ -59,6 +86,12 @@ def main() -> int:
         if a not in ("ring", "halving"):
             print(f"bad --algs entry {a!r}: want ring|halving",
                   file=sys.stderr)
+            return 2
+    cmodes = [c.strip() for c in args.compress.split(",") if c.strip()]
+    for c in cmodes:
+        if c not in COMPRESS_MODES:
+            print(f"bad --compress entry {c!r}: want "
+                  f"{'|'.join(COMPRESS_MODES)}", file=sys.stderr)
             return 2
 
     devices_or_die(2)
@@ -71,7 +104,8 @@ def main() -> int:
               file=sys.stderr)
 
     rows = []
-    best = {}  # nbytes -> {label: trimean} for the speedup footer
+    best = {}   # nbytes -> {label: trimean} for the speedup footer
+    wires = {}  # nbytes -> {label: (wire_bytes, raw_bytes)} per replay
     for nbytes in args.sizes:
         buf = comm.alloc(nbytes)
 
@@ -81,35 +115,82 @@ def main() -> int:
 
         oneshot()  # compile/caches hot
         r1 = benchmark(oneshot, **kw)
-        rows.append(("allreduce", "fused", "oneshot", nbytes, 0.0,
-                     r1.trimean))
+        rows.append(("allreduce", "fused", "oneshot", "off", nbytes, 0.0,
+                     r1.trimean, 0, 0))
         best.setdefault(nbytes, {})["oneshot"] = r1.trimean
 
         arms = [("fused", "flat")] \
             + [(a, "flat") for a in algs] \
             + ([(a, "hier") for a in algs] if comm.num_nodes > 1 else [])
         for alg, plan in arms:
-            envmod.env.redcoll = "auto" if alg == "fused" else alg
-            envmod.env.coll_hier = "hier" if plan == "hier" else "flat"
-            t0 = time.perf_counter()
-            pr = api.allreduce_init(comm, buf, dtype=np.float32, op="sum")
+            # the fused library arm has no host round plan, hence no
+            # wire to narrow: measured once, always at compress off
+            arm_cmodes = ["off"] if alg == "fused" else cmodes
+            for cmode in arm_cmodes:
+                envmod.env.redcoll = "auto" if alg == "fused" else alg
+                envmod.env.coll_hier = "hier" if plan == "hier" else "flat"
+                envmod.env.redcoll_compress = "off" if alg == "fused" \
+                    else cmode
+                t0 = time.perf_counter()
+                pr = api.allreduce_init(comm, buf, dtype=np.float32,
+                                        op="sum")
 
-            def persistent():
-                pr.start()
-                pr.wait()
-                buf.data.block_until_ready()
+                def persistent():
+                    pr.start()
+                    pr.wait()
+                    buf.data.block_until_ready()
 
-            persistent()  # first start pays any lazy compile
-            setup = time.perf_counter() - t0
-            r2 = benchmark(persistent, **kw)
-            rows.append(("allreduce", pr.method, "persistent", nbytes,
-                         setup, r2.trimean))
-            best[nbytes][f"{plan}:{pr.method}"] = r2.trimean
-            pr.free()
+                persistent()  # first start pays any lazy compile
+                setup = time.perf_counter() - t0
+                # one counted replay for the byte-accurate wire columns:
+                # wire = what the round plan actually moved, raw = the
+                # f32-equivalent (uncompressed rounds count as both)
+                w0 = ctr.counters.coll.reduce_wire_bytes
+                f0 = ctr.counters.coll.reduce_wire_bytes_f32
+                raw0 = ctr.counters.compress.raw_bytes
+                persistent()
+                wire_b = ctr.counters.coll.reduce_wire_bytes - w0
+                raw_b = (ctr.counters.coll.reduce_wire_bytes_f32 - f0) \
+                    + (ctr.counters.compress.raw_bytes - raw0)
+                r2 = benchmark(persistent, **kw)
+                label = f"{plan}:{pr.method}:{cmode}"
+                rows.append(("allreduce", pr.method, "persistent", cmode,
+                             nbytes, setup, r2.trimean, wire_b, raw_b))
+                best[nbytes][label] = r2.trimean
+                wires.setdefault(nbytes, {})[label] = (wire_b, raw_b)
+                if plan == "hier" and cmode != "off":
+                    # the modeled DCN leg: what the swept sheet prices
+                    # for hier-f32 vs hier+this codec (finite only on a
+                    # measured system; the cpu mesh records wall time
+                    # and wire bytes above instead)
+                    try:
+                        from tempi_tpu.coll import persistent as pcoll
+                        from tempi_tpu.compress import arms as carms
+                        scheds = {pr.method: pr._schedule_for(pr.method)}
+                        ef32 = pcoll._reduce_estimates(
+                            comm, [pr.method], scheds,
+                            nbytes)[pr.method]
+                        names = None if cmode == "auto" else (cmode,)
+                        ec = {k: v for k, v in carms.estimates(
+                            scheds, nbytes, names=names).items()
+                            if v < float("inf")}
+                        if ec and ef32 < float("inf"):
+                            k = min(ec, key=ec.get)
+                            print(f"modeled DCN [{nbytes}B "
+                                  f"{k[0]}+{k[1]}]: "
+                                  f"{ef32 / ec[k]:.2f}x vs hier f32 "
+                                  f"({ef32:.3e}s -> {ec[k]:.3e}s)",
+                                  file=sys.stderr)
+                    except Exception as e:  # modeled line is advisory
+                        print(f"modeled DCN [{nbytes}B]: "
+                              f"unavailable ({e})", file=sys.stderr)
+                pr.free()
         envmod.env.redcoll = "auto"
         envmod.env.coll_hier = "auto"
+        envmod.env.redcoll_compress = "off"
 
-    emit_csv(("kind", "alg", "mode", "bytes", "setup_s", "time_s"), rows)
+    emit_csv(("kind", "alg", "mode", "compress", "bytes", "setup_s",
+              "time_s", "wire_bytes", "raw_bytes"), rows)
     # the acceptance ratios: per-algorithm persistent vs one-shot, and
     # hierarchical vs the best flat round plan — >1 means faster
     for nbytes, arms in best.items():
@@ -119,13 +200,50 @@ def main() -> int:
                 print(f"persistent speedup [{nbytes}B {label}]: "
                       f"{one / t:.2f}x vs one-shot", file=sys.stderr)
         flat = [t for lbl, t in arms.items()
-                if lbl.startswith("flat:") and not lbl.endswith("fused")]
+                if lbl.startswith("flat:") and ":fused:" not in lbl]
         hier = [t for lbl, t in arms.items() if lbl.startswith("hier:")]
         if flat and hier and min(hier) > 0:
             print(f"hier speedup [{nbytes}B]: "
                   f"{min(flat) / min(hier):.2f}x "
                   f"(flat {min(flat):.3e}s vs hier {min(hier):.3e}s)",
                   file=sys.stderr)
+        # ISSUE 19: per-arm wire-bytes reduction, and the headline —
+        # hier with a compressed DCN phase vs the same hier at f32
+        for lbl, (w, raw) in sorted(wires.get(nbytes, {}).items()):
+            if 0 < w < raw:
+                print(f"wire reduction [{nbytes}B {lbl}]: "
+                      f"{raw / w:.2f}x fewer wire bytes "
+                      f"({raw} -> {w})", file=sys.stderr)
+        hoff = {lbl: t for lbl, t in arms.items()
+                if lbl.startswith("hier:") and lbl.endswith(":off")}
+        hcmp = {lbl: t for lbl, t in arms.items()
+                if lbl.startswith("hier:") and not lbl.endswith(":off")}
+        # prefer arms whose wire actually narrowed (auto may have
+        # stayed f32 on an unmeasured sheet — comparing that would
+        # claim a 1.00x non-reduction)
+        hnarrow = {lbl: t for lbl, t in hcmp.items()
+                   if wires[nbytes].get(lbl, (0, 0))[0]
+                   < wires[nbytes].get(lbl, (0, 1))[1]}
+        hcmp = hnarrow or hcmp
+        if hoff and hcmp:
+            bo = min(hoff, key=hoff.get)
+            bc = min(hcmp, key=hcmp.get)
+            wo = wires[nbytes].get(bo, (0, 0))[0]
+            wc = wires[nbytes].get(bc, (0, 0))[0]
+            wr = f", {wo / wc:.2f}x fewer wire bytes" if wc else ""
+            print(f"compress hier headline [{nbytes}B]: {bc} vs {bo}: "
+                  f"{hoff[bo] / hcmp[bc]:.2f}x time{wr}",
+                  file=sys.stderr)
+    if args.json:
+        doc = {"rows": [dict(zip(("kind", "alg", "mode", "compress",
+                                  "bytes", "setup_s", "time_s",
+                                  "wire_bytes", "raw_bytes"), r))
+                        for r in rows],
+               "counters": api.counters_snapshot(),
+               "compress": api.compress_snapshot()}
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        print(f"json doc -> {args.json}", file=sys.stderr)
     api.finalize()
     return 0
 

@@ -306,6 +306,22 @@ def integrity_snapshot() -> dict:
     return integrity.snapshot()
 
 
+def compress_snapshot() -> dict:
+    """Diagnostic snapshot of the compressed-collective subsystem (ISSUE
+    19; tempi_tpu/compress/): the parsed mode (``TEMPI_REDCOLL_COMPRESS``)
+    and error-feedback flag, per-codec arm tallies — compressed rounds,
+    raw vs encoded wire bytes and the saved-bytes delta, the latest
+    committed error-feedback residual norm — plus the bounded adoption
+    ledger (every chooser decision that narrowed a wire: method, codec,
+    forced or modeled, and the competing estimates), all stamped with the
+    shared invalidation generation (adoptions also land on the decision
+    timeline, so :func:`explain` narrates WHY a wire narrowed alongside
+    breaker/tune/invalidation records). Pure data — safe to serialize.
+    Callable before init and after finalize (reads empty)."""
+    from .compress import arms as compress_arms
+    return compress_arms.snapshot()
+
+
 def serving_snapshot() -> dict:
     """Diagnostic snapshot of the inference-serving subsystem (ISSUE 18;
     serving/engine.py): mode and knob config plus request-level latency

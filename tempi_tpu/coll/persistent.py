@@ -56,6 +56,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..compress import arms as compress_arms
+from ..compress import codecs as compress_codecs
+from ..compress.feedback import ErrorFeedback
 from ..measure import system as msys
 from ..obs import metrics as obsmetrics
 from ..obs import timeline
@@ -1192,7 +1195,19 @@ class _RoundsReduceLowering:
     Rounds are safe to re-dispatch after a pre-dispatch fault (the
     ``redcoll.round`` site fires BEFORE ``run_round``), and a restart
     after any failure rebuilds the host staging from the still-unmodified
-    device input, so the handle is always restartable."""
+    device input, so the handle is always restartable.
+
+    A compressed plan (``sched.wire_dtype != "f32"``, ISSUE 19) narrows
+    each wire round's payloads through the codec — every round of a flat
+    plan, the DCN leader exchange ONLY of a hierarchical one (ICI phases
+    always move raw f32) — with f32 accumulation on the decoded values
+    and an optional per-handle error-feedback store carrying the
+    quantization residual across rounds and replays. Residual updates
+    stage pending and commit only after ``apply_round`` returns, so the
+    per-round retry loop re-adjusts from committed state (never
+    double-counts a payload that never left); the round stats report the
+    bytes AS ENCODED, which is what the per-dtype wire counters and the
+    ``redcoll.round`` spans carry."""
 
     def __init__(self, comm, inbuf, outbuf, sched, dtype, op, kind):
         from ..parallel.alltoallv import _lib_perm
@@ -1204,6 +1219,12 @@ class _RoundsReduceLowering:
         self._lib = _lib_perm(comm)
         self._work: Optional[List[np.ndarray]] = None
         self._hier = isinstance(sched, redsched.HierReduceSchedule)
+        self.wire_dtype = getattr(sched, "wire_dtype", "f32")
+        self._codec = compress_codecs.get(self.wire_dtype) \
+            if self.wire_dtype != "f32" else None
+        self._ef = ErrorFeedback() \
+            if self._codec is not None and compress_arms.ef_enabled() \
+            else None
         if self._hier:
             self._rounds = sched.all_rounds()
             self.total_elems = sched.total_elems
@@ -1217,11 +1238,21 @@ class _RoundsReduceLowering:
             .astype(np.int64)
         self.num_rounds = len(self._rounds) + 2
         self._round_stats = [(comm.size, self.total_elems * self._dt.itemsize)]
-        for _tier, rnd in self._rounds:
-            self._round_stats.append(
-                (len(rnd), sum(m.nelems for m in rnd) * self._dt.itemsize))
+        self._round_dtypes = ["f32"]  # per-ri wire dtype (stage passes f32)
+        for tier, rnd in self._rounds:
+            codec = self._codec \
+                if self._codec is not None and (not self._hier
+                                                or tier == "dcn") else None
+            if codec is None:
+                nbytes = sum(m.nelems for m in rnd) * self._dt.itemsize
+                self._round_dtypes.append("f32")
+            else:
+                nbytes = sum(codec.wire_nbytes(m.nelems) for m in rnd)
+                self._round_dtypes.append(codec.name)
+            self._round_stats.append((len(rnd), nbytes))
         self._round_stats.append(
             (comm.size, self.total_elems * self._dt.itemsize))
+        self._round_dtypes.append("f32")
 
     def run_round(self, ri: int) -> None:
         if ri == 0:
@@ -1235,6 +1266,12 @@ class _RoundsReduceLowering:
         if not self._hier or not 0 < ri <= len(self._rounds):
             return None
         return self._rounds[ri - 1][0]
+
+    def round_wire_dtype(self, ri: int) -> str:
+        """The wire dtype round ``ri`` ships — the per-dtype counter
+        attribution key (stage passes and uncompressed rounds read
+        ``"f32"``)."""
+        return self._round_dtypes[ri]
 
     def _stage_in(self) -> None:
         comm = self.comm
@@ -1258,8 +1295,57 @@ class _RoundsReduceLowering:
         self._work = work
 
     def _apply(self, rnd, ri: int) -> None:
+        codec = self._codec if self._round_dtypes[ri] != "f32" else None
+        if codec is not None and faults.ENABLED:
+            # BEFORE the round's first message encodes: a raise leaves
+            # the residual store on its last committed state and the
+            # work buffers untouched, so the retry re-encodes cleanly
+            faults.check("compress.encode")
+        t0 = time.monotonic() \
+            if codec is not None and obstrace.ENABLED else 0.0
         wire = None
-        if integrity.ENABLED:
+        if codec is not None:
+            # compressed wire (ISSUE 19): adjust with the committed
+            # error-feedback residual, encode, verify the ENCODED bytes
+            # (the image that actually crossed — a retransmit re-encodes
+            # from the pristine f32 producer staging, never re-copies a
+            # stale wire image), decode, stage the new residual pending.
+            # f32 accumulation: apply_round's op consumes the decoded
+            # float32 payload.
+            ef = self._ef
+            cc = ctr.counters.compress
+
+            def wire(payload, m, _ri=ri):
+                key = (_ri, m.src, m.dst, m.offset)
+                src = ef.adjust(key, payload) if ef is not None \
+                    else np.asarray(payload, np.float32).copy()
+                cc.num_encodes += 1
+                wb = codec.wire_nbytes(src.size)
+                cc.raw_bytes += src.nbytes
+                cc.wire_bytes += wb
+                cc.saved_bytes += src.nbytes - wb
+                if integrity.ENABLED:
+                    encoded = codec.encode(src)
+                    staged = encoded.copy()
+
+                    def redo():
+                        np.copyto(staged, codec.encode(src))
+
+                    integrity.verify_delivery(
+                        staged, integrity.checksums(encoded),
+                        site="redcoll.apply",
+                        link=health.link(int(self._lib[m.src]),
+                                         int(self._lib[m.dst])),
+                        strategy="staged", round_=_ri,
+                        wire_dtype=codec.name, redo=redo)
+                    delivered = codec.decode(staged, src.size)
+                else:
+                    delivered = codec.roundtrip(src)
+                cc.num_decodes += 1
+                if ef is not None:
+                    ef.stage(key, src, delivered)
+                return delivered
+        elif integrity.ENABLED:
             # verified delivery (ISSUE 17): every round payload — phase-B
             # leader aggregates included, since hier plans lower through
             # this same apply — is copied into a staging buffer, passed
@@ -1281,7 +1367,26 @@ class _RoundsReduceLowering:
                                      int(self._lib[m.dst])),
                     strategy="staged", round_=_ri, redo=redo)
                 return staged
-        redsched.apply_round(self._work, rnd, self._np_op, wire=wire)
+        try:
+            redsched.apply_round(self._work, rnd, self._np_op, wire=wire)
+        except BaseException:
+            if self._ef is not None:
+                self._ef.discard()
+            raise
+        if codec is not None:
+            if self._ef is not None:
+                before = self._ef.updates
+                self._ef.commit()
+                ctr.counters.compress.ef_updates += self._ef.updates - before
+                compress_arms.note_residual(codec.name,
+                                            self._ef.residual_norm())
+            raw = sum(m.nelems for m in rnd) * 4
+            wireb = sum(codec.wire_nbytes(m.nelems) for m in rnd)
+            compress_arms.note_round(codec.name, raw, wireb)
+            if obstrace.ENABLED:
+                obstrace.emit_span("compress.encode", t0, codec=codec.name,
+                                   round=ri, msgs=len(rnd), raw=raw,
+                                   wire=wireb)
 
     def _stage_out(self) -> None:
         import jax
@@ -1410,6 +1515,7 @@ class PersistentReduce:
         self._hier_mode = envmod.env.coll_hier
         self._derive_topology()
         self.method: str = ""
+        self.wire_dtype: str = "f32"
         self._lowering = None
         self._active = False
         self._started = False
@@ -1462,11 +1568,14 @@ class PersistentReduce:
                 cands.append("hier_halving")
         return cands
 
-    def _schedule_for(self, method: str):
+    def _schedule_for(self, method: str, wire_dtype: str = "f32"):
         """Compile (or cache-hit) the round plan of one method — pure
-        (kind, counts, algorithm, chunk, node map) artifacts, cached per
-        communicator like the alltoallv schedules so sibling handles
-        compile each once."""
+        (kind, counts, algorithm, chunk, node map, wire dtype) artifacts,
+        cached per communicator like the alltoallv schedules so sibling
+        handles compile each once. The wire dtype is part of the cache
+        key: a compressed plan and its f32 twin are distinct artifacts
+        (mutating a shared cached schedule's wire would silently narrow
+        a sibling handle's bytes)."""
         if method == "fused":
             return None
         comm = self.comm
@@ -1474,18 +1583,19 @@ class PersistentReduce:
             alg = method[len("hier_"):]
             key = ("redcoll", "hier", alg, self.total_elems,
                    self._chunk_elems, tuple(self._node_of),
-                   tuple(self._leaders))
+                   tuple(self._leaders), wire_dtype)
         else:
             alg = method
             key = ("redcoll", self.kind, alg, tuple(self.counts),
-                   self._chunk_elems)
+                   self._chunk_elems, wire_dtype)
         with comm._progress_lock:
             sched = planmod.cache_get(comm, key)
             if sched is None:
                 if method.startswith("hier_"):
                     sched = redsched.compile_hier_reduce(
                         self.total_elems, self._node_of, self._leaders,
-                        algorithm=alg, chunk_elems=self._chunk_elems)
+                        algorithm=alg, chunk_elems=self._chunk_elems,
+                        wire_dtype=wire_dtype)
                 else:
                     compiler = {
                         "allreduce": redsched.compile_allreduce,
@@ -1493,17 +1603,63 @@ class PersistentReduce:
                         "allgather": redsched.compile_allgather,
                     }[self.kind]
                     sched = compiler(comm.size, self.counts, algorithm=alg,
-                                     chunk_elems=self._chunk_elems)
+                                     chunk_elems=self._chunk_elems,
+                                     wire_dtype=wire_dtype)
                 planmod.cache_put(comm, key, sched)
         return sched
 
-    def _choose(self) -> str:
-        """One method with the established precedence. Env-forced arms:
-        ``TEMPI_REDCOLL=ring|halving`` pins the algorithm family and
-        ``TEMPI_COLL_HIER=hier`` pins the two-level plan wherever one is
-        eligible; both compose (forced hier rides the forced algorithm
-        on its DCN leg). Otherwise every eligible candidate competes in
-        the model-driven AUTO choice."""
+    def _compressible(self) -> bool:
+        """Codec arms exist only for float32 reductions — the codecs
+        quantize f32 payloads (accumulation is f32 always)."""
+        return self.dtype == np.dtype(np.float32)
+
+    def _wire_for(self, method: str, nb_total: int):
+        """The wire dtype riding a FORCED method (env-pinned algorithm
+        or hier plan): a forced codec rides it outright; ``auto`` prices
+        this one method's codec arms against its own f32 wire (the
+        method is pinned, the representation still competes). Returns
+        ``(wire, est_f32, est_codec)`` — the estimates feed the adoption
+        ledger when a codec wins."""
+        cmode = compress_arms.mode()
+        if cmode == "off" or not self._compressible() or method == "fused":
+            return "f32", None, None
+        if cmode in compress_codecs.NAMES:
+            return cmode, None, None
+        sched = self._schedule_for(method)
+        est = _reduce_estimates(self.comm, [method], {method: sched},
+                                nb_total)
+        cest = compress_arms.estimates({method: sched}, nb_total)
+        finite = {c: t for (_m, c), t in cest.items() if t < math.inf}
+        if not finite:
+            return "f32", None, None
+        c = min(finite, key=finite.get)
+        f32t = est.get(method, math.inf)
+        if finite[c] < f32t:
+            return c, (f32t if f32t < math.inf else None), finite[c]
+        return "f32", None, None
+
+    def _choose(self) -> Tuple[str, str]:
+        """One (method, wire dtype) with the established precedence.
+        Env-forced arms: ``TEMPI_REDCOLL=ring|halving`` pins the
+        algorithm family, ``TEMPI_COLL_HIER=hier`` pins the two-level
+        plan wherever one is eligible, and
+        ``TEMPI_REDCOLL_COMPRESS=bf16|fp8|int8`` pins the wire codec
+        (excluding the un-compressible ``fused`` arm from AUTO — a
+        forced codec silently riding a fused f32 lowering would be the
+        quiet-knob failure). Otherwise every eligible (method, codec)
+        arm competes with the f32 arms in the one model-driven AUTO
+        pool; a forced codec on a non-f32 reduction is refused loudly.
+        Every codec adoption lands in the compress ledger and on the
+        decision timeline."""
+        cmode = compress_arms.mode()
+        codec_forced = cmode in compress_codecs.NAMES
+        if codec_forced and not self._compressible():
+            raise RuntimeError(
+                f"TEMPI_REDCOLL_COMPRESS={cmode} forces a compressed "
+                f"wire but this reduction's element dtype is "
+                f"{self.dtype.name} (codecs quantize float32 payloads "
+                "only; accumulation is f32 always)")
+        nb_total = self.total_elems * self.dtype.itemsize
         forced_alg = self._forced_alg
         if forced_alg == "halving" and not redsched.is_pow2(self.comm.size):
             log.debug("forced halving on a non-power-of-two world: "
@@ -1519,22 +1675,52 @@ class PersistentReduce:
                     and not redsched.is_pow2(len(self._leaders)):
                 alg = "ring"
             method = f"hier_{alg}"
+            wire, ef32, ecod = self._wire_for(method, nb_total)
+            if wire != "f32":
+                compress_arms.record_adoption(
+                    kind=self.kind, method=method, codec=wire,
+                    forced=codec_forced, est_f32=ef32, est_codec=ecod)
             if obstrace.ENABLED:
                 obstrace.emit("redcoll.choice", kind=self.kind,
-                              method=method, forced=True)
-            return method
+                              method=method, forced=True, wire=wire)
+            return method, wire
         if forced_alg is not None:
+            wire, ef32, ecod = self._wire_for(forced_alg, nb_total)
+            if wire != "f32":
+                compress_arms.record_adoption(
+                    kind=self.kind, method=forced_alg, codec=wire,
+                    forced=codec_forced, est_f32=ef32, est_codec=ecod)
             if obstrace.ENABLED:
                 obstrace.emit("redcoll.choice", kind=self.kind,
-                              method=forced_alg, forced=True)
-            return forced_alg
+                              method=forced_alg, forced=True, wire=wire)
+            return forced_alg, wire
         cands = self._candidates()
+        if codec_forced:
+            cands = [m for m in cands if m != "fused"]
         schedules = {m: self._schedule_for(m) for m in cands
                      if m != "fused"}
-        nb_total = self.total_elems * self.dtype.itemsize
         est = _reduce_estimates(self.comm, cands, schedules, nb_total)
+        base = dict(est)
         tuned = _reduce_tune_overlay(self.comm, est, nb_total) \
             if tune_online.ADAPTING else []
+        # the (method, codec) arms join the pool: codec pricing derives
+        # from the same swept curves, and the tune overlay's drift
+        # scaling of a method carries onto its codec arms (same
+        # transport, narrower bytes)
+        pool = {(m, "f32"): t for m, t in est.items()}
+        cnames = compress_arms.candidates() if self._compressible() else ()
+        if cnames:
+            cest = compress_arms.estimates(schedules, nb_total,
+                                           names=cnames)
+            for (m, c), t in cest.items():
+                if m in est and 0.0 < base.get(m, 0.0) < math.inf \
+                        and est[m] < math.inf:
+                    t *= est[m] / base[m]
+                pool[(m, c)] = t
+        if codec_forced:
+            # no f32 arm survives a forced codec: the chosen method
+            # carries the codec, whatever the model says about f32
+            pool = {mc: t for mc, t in pool.items() if mc[1] != "f32"}
         quarantined = []
         if health.TRIPPED:
             for m in list(est):
@@ -1542,42 +1728,73 @@ class PersistentReduce:
                 if any(health.state(lk, us) == health.OPEN
                        for lk in self.links):
                     quarantined.append(m)
-        eligible = {m: t for m, t in est.items() if m not in quarantined}
-        finite = {m: t for m, t in eligible.items() if t < math.inf}
+        eligible = {mc: t for mc, t in pool.items()
+                    if mc[0] not in quarantined}
+        finite = {mc: t for mc, t in eligible.items() if t < math.inf}
         if finite:
-            choice = min(finite, key=finite.get)
-        elif self.kind == "allreduce" and "fused" in eligible:
+            choice, wire = min(finite, key=finite.get)
+        elif codec_forced:
+            # unmeasured/quarantined everything: the ring plan is the
+            # conservative host path, and the forced codec rides it
+            choice, wire = "ring", cmode
+        elif self.kind == "allreduce" and "fused" in est \
+                and "fused" not in quarantined:
             # unmeasured system: the TPU-first default, like one-shot AUTO
-            choice = "fused"
-        elif "ring" in eligible:
-            choice = "ring"
+            choice, wire = "fused", "f32"
         else:
             # every transport quarantined: the ring plan is the
             # conservative host path whose next runs feed the probes
-            choice = "ring"
+            choice, wire = "ring", "f32"
+        if wire != "f32":
+            compress_arms.record_adoption(
+                kind=self.kind, method=choice, codec=wire,
+                forced=codec_forced,
+                est_f32=(base.get(choice) if base.get(choice, math.inf)
+                         < math.inf else None),
+                est_codec=finite.get((choice, wire)))
         if obstrace.ENABLED:
+            extra = {}
+            if any(c != "f32" for _m, c in pool):
+                extra["compress_estimates"] = {
+                    f"{m}+{c}": (t if t < math.inf else None)
+                    for (m, c), t in pool.items() if c != "f32"}
             obstrace.emit("redcoll.choice", kind=self.kind, method=choice,
-                          forced=False,
+                          forced=False, wire=wire,
                           estimates={m: (t if t < math.inf else None)
                                      for m, t in est.items()},
-                          tuned=tuned, quarantined=quarantined)
-        return choice
+                          tuned=tuned, quarantined=quarantined, **extra)
+        return choice, wire
+
+    def _note_ef_reset(self) -> None:
+        """A rebuild is about to replace a lowering still carrying live
+        error-feedback residuals: the new store starts empty (compiled
+        against the new generation — residuals of a dead plan never
+        leak), and the coherent reset is counted so the snapshot can
+        surface it."""
+        old = self._lowering
+        ef = getattr(old, "_ef", None)
+        if ef is not None and ef.slots:
+            ctr.counters.compress.ef_resets += 1
 
     def _compile(self, recompile: bool = False) -> None:
-        method = self._choose()
-        if recompile and method == self.method:
+        method, wire = self._choose()
+        if recompile and method == self.method \
+                and wire == self.wire_dtype:
             return  # no healthier alternative: keep the compiled plan
         self.method = method
-        self._lowering = self._build_lowering(method)
+        self.wire_dtype = wire
+        self._note_ef_reset()
+        self._lowering = self._build_lowering(method, wire)
         ctr.counters.coll.reduce_compiles += 1
         if recompile:
             ctr.counters.coll.reduce_recompiles += 1
             timeline.record("redcoll.recompile", comm=self.comm.uid,
-                            method=self.method, coll_kind=self.kind)
+                            method=self.method, coll_kind=self.kind,
+                            wire=self.wire_dtype)
             log.info(f"persistent reduction recompiled onto "
                      f"{self.method!r} (plan invalidated)")
 
-    def _build_lowering(self, method: str):
+    def _build_lowering(self, method: str, wire_dtype: str = "f32"):
         addressable = all(
             getattr(b.data, "is_fully_addressable", True)
             for b in (self.inbuf, self.outbuf))
@@ -1588,16 +1805,20 @@ class PersistentReduce:
             # the staged host passes need every local shard; a
             # multi-controller allreduce takes the fused device path
             # (same rationale as _StagedLowering's degrade); the other
-            # kinds have no device lowering to degrade to — refuse
-            if self.kind == "allreduce":
+            # kinds have no device lowering to degrade to — refuse. A
+            # chosen codec cannot ride the fused f32 lowering — refusing
+            # beats silently widening the wire (the loud-knob rule).
+            if self.kind == "allreduce" and wire_dtype == "f32":
                 log.debug("reduction round plan on a partially-"
                           "addressable buffer: lowering to fused")
                 return _FusedReduceLowering(self.comm, self.outbuf,
                                             self.dtype, self.op)
             raise RuntimeError(
                 f"persistent {self.kind} needs fully-addressable buffers "
-                "(multi-controller worlds are unsupported here)")
-        sched = self._schedule_for(method)
+                + ("for a compressed wire (the fused degrade path is "
+                   "f32-only)" if wire_dtype != "f32" else
+                   "(multi-controller worlds are unsupported here)"))
+        sched = self._schedule_for(method, wire_dtype)
         if isinstance(sched, redsched.HierReduceSchedule):
             ctr.counters.coll.reduce_hier_compiles += 1
         return _RoundsReduceLowering(self.comm, self.inbuf, self.outbuf,
@@ -1609,8 +1830,9 @@ class PersistentReduce:
         rank translation are stale — rebuild them all (the plan cache
         was dropped by the apply step, so schedules recompile fresh)."""
         self._derive_topology()
-        self.method = self._choose()
-        self._lowering = self._build_lowering(self.method)
+        self.method, self.wire_dtype = self._choose()
+        self._note_ef_reset()
+        self._lowering = self._build_lowering(self.method, self.wire_dtype)
         self._mapping_epoch = self.comm.mapping_epoch
         ctr.counters.coll.reduce_compiles += 1
         ctr.counters.coll.reduce_recompiles += 1
@@ -1714,12 +1936,27 @@ class PersistentReduce:
                 msgs, nbytes = low.round_stats(ri)
                 ctr.counters.coll.reduce_rounds += 1
                 ctr.counters.coll.reduce_wire_bytes += nbytes
+                # byte-accurate per-dtype attribution: compressed rounds
+                # report their ENCODED size (scales included), so the
+                # four buckets always sum to reduce_wire_bytes
+                wdfn = getattr(low, "round_wire_dtype", None)
+                wd = wdfn(ri) if wdfn is not None else "f32"
+                if wd == "bf16":
+                    ctr.counters.coll.reduce_wire_bytes_bf16 += nbytes
+                elif wd == "fp8":
+                    ctr.counters.coll.reduce_wire_bytes_fp8 += nbytes
+                elif wd == "int8":
+                    ctr.counters.coll.reduce_wire_bytes_int8 += nbytes
+                else:
+                    ctr.counters.coll.reduce_wire_bytes_f32 += nbytes
                 if tier == "ici":
                     ctr.counters.coll.reduce_hier_rounds_ici += 1
                 elif tier == "dcn":
                     ctr.counters.coll.reduce_hier_rounds_dcn += 1
                 if obstrace.ENABLED:
                     extra = {"tier": tier} if tier else {}
+                    if wd != "f32":
+                        extra["wire"] = wd
                     obstrace.emit_span("redcoll.round", t0, round=ri,
                                        msgs=msgs, nbytes=nbytes,
                                        method=self.method, kind=self.kind,

@@ -73,6 +73,33 @@ ALGORITHMS = ("ring", "halving")
 #: Reduction-collective kinds this compiler lowers.
 KINDS = ("reduce_scatter", "allgather", "allreduce")
 
+#: Wire dtypes a round plan may ship (ISSUE 19): ``f32`` is the raw
+#: payload; the rest are the registered codecs of
+#: ``tempi_tpu.compress.codecs`` — quantize at the producer, reduce in
+#: f32 at the consumer, dequantize on delivery. Plans carry the wire
+#: dtype as a compile-time dimension so ``simulate`` proves the exact
+#: quantize→reduce→dequantize delivery the runtime lowering executes.
+WIRE_DTYPES = ("f32", "bf16", "fp8", "int8")
+
+
+def wire_fn(wire_dtype: str):
+    """The pure simulate-side wire hook of one wire dtype: payloads pass
+    through the codec's fused quantize→dequantize (bitwise the
+    encode→decode wire image — property-tested in the codec suite), in
+    float32, exactly what the runtime's compressed wire delivers when no
+    residual is carried. ``f32`` (and an unset codec) is no hook at
+    all — the schedule stays pure numpy with zero compressed machinery
+    touched."""
+    if wire_dtype == "f32":
+        return None
+    from ..compress import codecs
+    codec = codecs.get(wire_dtype)
+
+    def wire(payload, m):
+        return codec.roundtrip(np.asarray(payload, np.float32))
+
+    return wire
+
 
 def is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
@@ -157,6 +184,7 @@ class ReduceSchedule:
     counts: Tuple[int, ...]
     rounds: List[List[RMsg]] = field(default_factory=list)
     chunk_elems: int = 0
+    wire_dtype: str = "f32"      # WIRE_DTYPES member; codec for every round
 
     @property
     def total_elems(self) -> int:
@@ -203,10 +231,15 @@ class ReduceSchedule:
         ``total_elems`` buffer; ``np_op`` the elementwise ufunc (e.g.
         ``np.add``) applied by ``reduce`` actions.  Rounds apply through
         the shared :func:`apply_round` — the same code the runtime
-        lowering executes, so the spec and the executor cannot drift."""
+        lowering executes, so the spec and the executor cannot drift.
+        A compressed ``wire_dtype`` quantizes every payload through the
+        codec's fused roundtrip (:func:`wire_fn`) — exactly the wire
+        image the runtime delivers — so the compressed-delivery property
+        tests run against the same spec."""
         bufs = [np.array(r, copy=True) for r in rows]
+        wire = wire_fn(self.wire_dtype)
         for rnd in self.rounds:
-            apply_round(bufs, rnd, np_op)
+            apply_round(bufs, rnd, np_op, wire=wire)
         return bufs
 
 
@@ -316,17 +349,19 @@ def _doubling_ag_rounds(size: int, seg_counts: np.ndarray,
 
 
 def _compile(kind: str, size: int, counts: Sequence[int], algorithm: str,
-             chunk_elems: int) -> ReduceSchedule:
+             chunk_elems: int, wire_dtype: str = "f32") -> ReduceSchedule:
     counts = [int(c) for c in counts]
     assert len(counts) == size, "one block count per rank"
     assert all(c >= 0 for c in counts), "negative block count"
     assert kind in KINDS and algorithm in ALGORITHMS
+    assert wire_dtype in WIRE_DTYPES, f"unknown wire dtype {wire_dtype!r}"
     if algorithm == "halving" and not is_pow2(size):
         raise ValueError(
             f"halving plans need a power-of-two world, got size={size} "
             "(the persistent layer degrades forced halving to ring)")
     sched = ReduceSchedule(size=size, kind=kind, algorithm=algorithm,
-                           counts=tuple(counts), chunk_elems=int(chunk_elems))
+                           counts=tuple(counts), chunk_elems=int(chunk_elems),
+                           wire_dtype=wire_dtype)
     if size == 1 or sched.total_elems == 0:
         return sched  # nothing moves: an empty plan delivers trivially
     for seg_counts, seg_base in _segments(counts, chunk_elems):
@@ -348,31 +383,37 @@ def _compile(kind: str, size: int, counts: Sequence[int], algorithm: str,
 
 def compile_reduce_scatter(size: int, counts: Sequence[int],
                            algorithm: str = "ring",
-                           chunk_elems: int = 0) -> ReduceSchedule:
+                           chunk_elems: int = 0,
+                           wire_dtype: str = "f32") -> ReduceSchedule:
     """Compile a reduce_scatter round plan: every rank contributes a full
     ``sum(counts)``-element buffer; after the plan rank ``r``'s block
     ``r`` range holds the full reduction (other ranges hold partials —
     undefined output, like MPI)."""
-    return _compile("reduce_scatter", size, counts, algorithm, chunk_elems)
+    return _compile("reduce_scatter", size, counts, algorithm, chunk_elems,
+                    wire_dtype)
 
 
 def compile_allgather(size: int, counts: Sequence[int],
                       algorithm: str = "ring",
-                      chunk_elems: int = 0) -> ReduceSchedule:
+                      chunk_elems: int = 0,
+                      wire_dtype: str = "f32") -> ReduceSchedule:
     """Compile an allgather round plan: rank ``r`` starts with valid data
     in its block ``r`` range; after the plan every rank holds every
     block."""
-    return _compile("allgather", size, counts, algorithm, chunk_elems)
+    return _compile("allgather", size, counts, algorithm, chunk_elems,
+                    wire_dtype)
 
 
 def compile_allreduce(size: int, counts: Sequence[int],
                       algorithm: str = "ring",
-                      chunk_elems: int = 0) -> ReduceSchedule:
+                      chunk_elems: int = 0,
+                      wire_dtype: str = "f32") -> ReduceSchedule:
     """Compile an allreduce as the reduce_scatter + allgather composition
     (the bandwidth-optimal shape of both algorithm families): after the
     plan every rank's full buffer holds the reduction of every rank's
     contribution."""
-    return _compile("allreduce", size, counts, algorithm, chunk_elems)
+    return _compile("allreduce", size, counts, algorithm, chunk_elems,
+                    wire_dtype)
 
 
 def partition_elems(total: int, parts: int) -> List[int]:
@@ -431,6 +472,7 @@ class HierReduceSchedule:
     chunk_elems: int = 0
     dcn_rounds: int = 0
     dcn_elems: int = 0     # total elements crossing DCN
+    wire_dtype: str = "f32"  # DCN (phase B) wire only; ICI stays f32
 
     def phases(self) -> List[Tuple[str, List[List[HRMsg]]]]:
         return [("ici", self.phase_a), ("dcn", self.phase_b),
@@ -477,16 +519,22 @@ class HierReduceSchedule:
     def simulate(self, rows: Sequence[np.ndarray], np_op) -> List[np.ndarray]:
         """Replay the three phases over plain numpy buffers through the
         shared :func:`apply_round` (same contract as
-        :meth:`ReduceSchedule.simulate`)."""
+        :meth:`ReduceSchedule.simulate`).  A compressed ``wire_dtype``
+        quantizes ONLY the ``dcn`` rounds (the leader exchange) — the
+        ICI phases always deliver raw f32, the tier-separation promise of
+        the compressed hier plan."""
         bufs = [np.array(r, copy=True) for r in rows]
-        for _tier, rnd in self.all_rounds():
-            apply_round(bufs, rnd, np_op)
+        wire = wire_fn(self.wire_dtype)
+        for tier, rnd in self.all_rounds():
+            apply_round(bufs, rnd, np_op,
+                        wire=wire if tier == "dcn" else None)
         return bufs
 
 
 def compile_hier_reduce(total_elems: int, node_of: Sequence[int],
                         leaders: Sequence[int], algorithm: str = "ring",
-                        chunk_elems: int = 0) -> HierReduceSchedule:
+                        chunk_elems: int = 0,
+                        wire_dtype: str = "f32") -> HierReduceSchedule:
     """Compile the two-level allreduce plan (the reduction shape of
     ``coll/schedule.compile_hier_schedule``'s three phases).
 
@@ -499,13 +547,15 @@ def compile_hier_reduce(total_elems: int, node_of: Sequence[int],
     size = len(node_of)
     node_of = [int(n) for n in node_of]
     leaders = [int(a) for a in leaders]
+    assert wire_dtype in WIRE_DTYPES, f"unknown wire dtype {wire_dtype!r}"
     for n, lead in enumerate(leaders):
         assert node_of[lead] == n, \
             f"leader {lead} of node {n} lives on node {node_of[lead]}"
     sched = HierReduceSchedule(size=size, node_of=node_of, leaders=leaders,
                                total_elems=int(total_elems),
                                algorithm=algorithm,
-                               chunk_elems=int(chunk_elems))
+                               chunk_elems=int(chunk_elems),
+                               wire_dtype=wire_dtype)
     if size == 1 or total_elems == 0:
         return sched
     members = {n: [r for r in range(size)

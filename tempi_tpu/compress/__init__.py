@@ -1,0 +1,16 @@
+"""Compressed collectives (ISSUE 19): quantized wire formats with error
+feedback, registered as costed strategy arms of the persistent reduction
+engine.
+
+  * :mod:`.codecs`   — bf16 / fp8-e4m3 / int8+per-block-scale wire
+    codecs: pure numpy reference + fused Pallas roundtrip kernel.
+  * :mod:`.feedback` — per-handle error-feedback residual store
+    (transactional, invalidation-generation coherent).
+  * :mod:`.arms`     — swept-sheet pricing of each (method, codec) arm,
+    the adoption ledger behind ``api.compress_snapshot()``.
+
+Armed by ``TEMPI_REDCOLL_COMPRESS`` (off by default: the f32 engine is
+byte-for-byte untouched and every ``compress.*`` counter stays zero).
+"""
+
+from . import arms, codecs, feedback  # noqa: F401

@@ -1,0 +1,201 @@
+"""Costed compression arms for the persistent reduction chooser.
+
+Each codec in :mod:`tempi_tpu.compress.codecs` becomes a STRATEGY ARM of
+``PersistentReduce``: the same round plan, a narrower wire. Arms are
+priced from the swept sheet exactly like the f32 methods — per
+(algorithm, link tier, nbytes), `coll/persistent._reduce_estimates`'s
+shape — with the codec folded in as (a) the wire bytes each round
+actually moves and (b) an explicit encode+decode host pass per
+compressed round (one producer-side encode, one consumer-side decode,
+priced on the host copy curve). The honest consequence on a
+host-staged mesh: a compressed FLAT round pays the transform on top of
+a host-speed wire and never wins, while a hierarchical plan's DCN
+leader exchange — priced on the inter-node curve — is exactly where
+narrowing the wire pays. That asymmetry is the paper's model-driven
+thesis restated at the representation layer, and it is why hier plans
+compress the DCN phase ONLY (ICI phases stay f32 by construction, see
+``coll/persistent._RoundsReduceLowering``).
+
+Selection precedence is the established one and NEVER silent:
+
+  * ``TEMPI_REDCOLL_COMPRESS=off``  — no arm exists; the chooser,
+    counters, and wire bytes are byte-for-byte the f32 engine.
+  * ``=bf16|fp8|int8``              — env-forced: every round-plan
+    method carries that codec, and the un-compressible ``fused`` arm
+    leaves the candidate set (a forced codec that silently rode a
+    fused f32 lowering would be the quiet-knob failure the loud-knob
+    rule exists to prevent).
+  * ``=auto``                       — every (method, codec) pair
+    competes with the f32 arms in the one AUTO pool; breakers
+    quarantine by the method's transport as before (a codec changes
+    bytes, not transports) and the tune overlay's drift scaling applies
+    to the method estimate the codec arm is derived from.
+
+Every adoption (or refusal) lands in a bounded ledger joined to the
+shared invalidation generation and mirrored onto the decision timeline
+(``compress.adopt`` records), so ``api.explain()`` can narrate WHY a
+wire narrowed; ``api.compress_snapshot()`` exposes the ledger plus
+per-codec wire-byte tallies and the live residual norms.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..measure import system as msys
+from ..obs import timeline
+from ..utils import env as envmod
+from ..utils import locks
+from . import codecs
+
+#: Adoption-ledger bound (the incident-ring precedent of
+#: runtime/integrity._incidents).
+_KEEP = 64
+
+_lock = locks.named_lock("compress.arms")
+_adoptions: List[dict] = []
+_total = 0
+# per-codec running tallies: rounds, raw bytes, wire bytes, last
+# residual norm observed at a commit
+_tallies: Dict[str, dict] = {}
+
+
+def configure() -> None:
+    """Reset the adoption ledger and tallies (test/bench hygiene — the
+    ledger is session evidence, like the integrity incidents)."""
+    global _adoptions, _total, _tallies
+    with _lock:
+        _adoptions = []
+        _total = 0
+        _tallies = {}
+
+
+def mode() -> str:
+    return getattr(envmod.env, "redcoll_compress", "off")
+
+
+def ef_enabled() -> bool:
+    return getattr(envmod.env, "redcoll_ef", "on") == "on"
+
+
+def candidates() -> Tuple[str, ...]:
+    """The codec arms the chooser must consider: none when off, exactly
+    the forced one, or every registered codec under auto."""
+    m = mode()
+    if m == "off":
+        return ()
+    if m == "auto":
+        return codecs.NAMES
+    return (m,)
+
+
+def _encdec_cost(sp, raw_nbytes: int) -> float:
+    """One encode pass (producer) + one decode pass (consumer) over the
+    raw f32 payload, priced on the host copy curve — the swept proxy
+    for host memory bandwidth (the transform is a streaming elementwise
+    pass, same access pattern as the host pingpong copy)."""
+    per_pass = msys.interp_time(sp.host_pingpong, max(1, raw_nbytes))
+    return 2.0 * per_pass
+
+
+def estimates(schedules, nbytes_total: int,
+              names: Optional[Tuple[str, ...]] = None
+              ) -> Dict[Tuple[str, str], float]:
+    """Swept-sheet seconds of every (method, codec) arm over the
+    already-compiled round plans (``schedules`` maps method -> schedule;
+    the fused method has no schedule and no host wire to narrow, so it
+    never appears). Mirrors ``_reduce_estimates``'s per-round pricing
+    with the wire bytes narrowed and the transform added; hier plans
+    narrow the DCN leader exchange only."""
+    from ..coll import reduce as redsched
+    names = candidates() if names is None else names
+    out: Dict[Tuple[str, str], float] = {}
+    if not names:
+        return out
+    sp = msys.get()
+    for m, sched in schedules.items():
+        if sched is None or sched.total_elems == 0:
+            continue
+        esize = max(1, nbytes_total // max(1, sched.total_elems))
+        base = msys.interp_time(sp.d2h, max(1, nbytes_total)) \
+            + msys.interp_time(sp.h2d, max(1, nbytes_total))
+        for cname in names:
+            codec = codecs.get(cname)
+            t = base
+            if isinstance(sched, redsched.HierReduceSchedule):
+                for tier, rnd in sched.all_rounds():
+                    maxe = max(mm.nelems for mm in rnd)
+                    if tier == "dcn":
+                        t += _encdec_cost(sp, maxe * esize)
+                        t += msys.model_direct_1d(
+                            max(1, codec.wire_nbytes(maxe)), False)
+                    else:
+                        t += msys.interp_time(sp.host_pingpong,
+                                              maxe * esize)
+            else:
+                for maxe in sched.round_max_elems():
+                    t += _encdec_cost(sp, maxe * esize)
+                    t += msys.interp_time(
+                        sp.host_pingpong, max(1, codec.wire_nbytes(maxe)))
+            out[(m, cname)] = t
+    return out
+
+
+def record_adoption(*, kind: str, method: str, codec: str, forced: bool,
+                    est_f32: Optional[float],
+                    est_codec: Optional[float]) -> None:
+    """One chooser decision that produced a compressed wire — ledgered,
+    generation-stamped, and mirrored onto the decision timeline so
+    ``api.explain()`` narrates it alongside breaker/tune/invalidation
+    records."""
+    from ..runtime import invalidation
+    global _total
+    with _lock:
+        _total += 1
+        _adoptions.append(dict(
+            seq=_total, kind=kind, method=method, codec=codec,
+            forced=forced, est_f32=est_f32, est_codec=est_codec,
+            generation=invalidation.GENERATION, time=time.time()))
+        del _adoptions[:-_KEEP]
+    timeline.record("compress.adopt", coll_kind=kind, method=method,
+                    codec=codec, forced=forced)
+
+
+def note_round(codec: str, raw_nbytes: int, wire_nbytes: int) -> None:
+    """Byte tally of one dispatched compressed round (called by the
+    lowering alongside the counter increments)."""
+    with _lock:
+        t = _tallies.setdefault(codec, dict(rounds=0, raw_bytes=0,
+                                            wire_bytes=0,
+                                            residual_norm=0.0))
+        t["rounds"] += 1
+        t["raw_bytes"] += int(raw_nbytes)
+        t["wire_bytes"] += int(wire_nbytes)
+
+
+def note_residual(codec: str, norm: float) -> None:
+    """Latest error-feedback residual norm observed at a commit — the
+    live numerics evidence the snapshot reports per codec."""
+    with _lock:
+        t = _tallies.setdefault(codec, dict(rounds=0, raw_bytes=0,
+                                            wire_bytes=0,
+                                            residual_norm=0.0))
+        t["residual_norm"] = float(norm)
+
+
+def snapshot() -> dict:
+    """Mode/EF config, per-codec wire-byte tallies (with the saved-bytes
+    delta), the latest residual norms, and the bounded adoption ledger —
+    joined to the shared invalidation generation. Pure data, safe to
+    serialize; callable before init and after finalize (reads empty)."""
+    from ..runtime import invalidation
+    with _lock:
+        arms = {}
+        for cname, t in _tallies.items():
+            arms[cname] = dict(t)
+            arms[cname]["saved_bytes"] = t["raw_bytes"] - t["wire_bytes"]
+        return dict(mode=mode(), ef=ef_enabled(),
+                    generation=invalidation.GENERATION,
+                    arms=arms, total_adoptions=_total,
+                    adoptions=[dict(a) for a in _adoptions])

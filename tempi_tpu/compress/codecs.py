@@ -1,0 +1,326 @@
+"""Quantized wire codecs: bf16, fp8-e4m3, int8 with per-block scales.
+
+The compile-once reduction plans (coll/reduce.py, ISSUE 14) still ship
+raw float32 over every link tier; at the DCN tier they are
+bandwidth-bound, which is exactly the regime where a cheaper wire
+REPRESENTATION — not a different algorithm — is the win the paper's
+model-driven selection thesis calls for. This module is the
+representation layer: each codec maps a float32 payload to a flat uint8
+WIRE image and back, with ACCUMULATION ALWAYS IN FLOAT32 — only the
+bytes on the wire narrow, never the arithmetic (the 1-bit-SGD /
+Deep-Gradient-Compression numerics contract; feedback.py carries the
+quantization residual so the narrowing error cancels across steps).
+
+Every codec is two implementations of the same map:
+
+  * **numpy reference** — ``encode``/``decode``/``roundtrip`` are pure,
+    deterministic numpy (hand-rolled bit manipulation and LUTs, no jax,
+    no device): the executable spec the property tests sweep and the
+    host-staging wire path executes. ``roundtrip(x)`` is the fused
+    quantize→dequantize composition and is REQUIRED to equal
+    ``decode(encode(x))`` bitwise — the runtime uses it when integrity
+    is off (no encoded buffer needs to materialize) without changing a
+    single delivered bit.
+  * **fused Pallas kernel** (:func:`pallas_roundtrip`) — the device-side
+    quantize→dequantize pack kernel (one VMEM pass, no HBM round trip
+    for the narrow intermediate), built lazily and run in interpreter
+    mode on CPU meshes like every kernel in ``ops/pack_pallas.py``. The
+    CPU-mesh tests pin it bitwise against the numpy reference, so the
+    two paths cannot drift.
+
+Wire images (all little-endian, flat uint8):
+
+  * ``bf16`` — the high 16 bits of each float32, round-to-nearest-even
+    (the ``(u + 0x7fff + lsb) >> 16`` carry trick); 2 bytes/elem.
+  * ``fp8``  — OCP float8-e4m3fn (bias 7, max normal 448, subnormals
+    kept, no inf, the single NaN code never produced — inputs saturate
+    to ±448); 1 byte/elem. Encode is an exact round-to-nearest-even via
+    the sorted 127-entry magnitude LUT (ties break to the even code,
+    matching IEEE semantics) — e4m3 has only 256 codes, so the LUT IS
+    the format.
+  * ``int8`` — symmetric per-block linear quantization: blocks of
+    ``INT8_BLOCK`` elements share one float32 scale ``max|x| / 127``
+    (an all-zero block scales 0 and decodes exactly); codes are
+    round-half-even in [-127, 127]. Wire = the per-block scales
+    (4 bytes each) followed by the codes (1 byte/elem).
+
+``wire_nbytes(nelems)`` is the exact encoded size — scales included —
+so the persistent layer's per-dtype wire-bytes counters and the AUTO
+chooser's pricing are byte-accurate, not element-approximate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+#: Elements sharing one int8 scale. 256 keeps the scale overhead at
+#: 4/256 bytes/elem (~1.6%) while bounding the dynamic range one scale
+#: must cover — the usual gradient-compression block shape.
+INT8_BLOCK = 256
+
+#: Registered codec names, narrowest wire last (the AUTO pricing order).
+NAMES = ("bf16", "fp8", "int8")
+
+
+def _f32(x) -> np.ndarray:
+    a = np.ascontiguousarray(x, dtype=np.float32)
+    return a.reshape(-1)
+
+
+class Codec:
+    """One wire representation: float32 payload <-> flat uint8 wire
+    image. Subclasses implement the pure-numpy reference; ``roundtrip``
+    must equal ``decode(encode(x), x.size)`` bitwise (property-tested)."""
+
+    name = ""
+    elem_wire_bytes = 0  # payload bytes per element (excl. block scales)
+
+    def wire_nbytes(self, nelems: int) -> int:
+        """Exact encoded byte count for ``nelems`` elements."""
+        return int(nelems) * self.elem_wire_bytes
+
+    def encode(self, x) -> np.ndarray:
+        raise NotImplementedError
+
+    def decode(self, wire: np.ndarray, nelems: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def roundtrip(self, x) -> np.ndarray:
+        """Fused quantize→dequantize — bitwise ``decode(encode(x))``
+        without materializing the wire image (the integrity-off fast
+        path)."""
+        return self.decode(self.encode(x), np.asarray(x).size)
+
+
+class Bf16Codec(Codec):
+    name = "bf16"
+    elem_wire_bytes = 2
+
+    def encode(self, x) -> np.ndarray:
+        u = _f32(x).view(np.uint32)
+        # round-to-nearest-even: add 0x7fff plus the keep-bit's LSB so
+        # exact halves carry only onto odd results
+        rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
+        return rounded.astype(np.uint16).view(np.uint8).copy()
+
+    def decode(self, wire: np.ndarray, nelems: int) -> np.ndarray:
+        hi = np.ascontiguousarray(wire, dtype=np.uint8).view(np.uint16)
+        assert hi.size == nelems, \
+            f"bf16 wire carries {hi.size} elems, expected {nelems}"
+        return (hi.astype(np.uint32) << 16).view(np.float32)
+
+    def roundtrip(self, x) -> np.ndarray:
+        u = _f32(x).view(np.uint32)
+        rounded = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16) << 16
+        return rounded.view(np.float32)
+
+
+def _e4m3_values() -> np.ndarray:
+    """Decoded float32 value of every non-negative e4m3fn code 0..126
+    (code 127, mantissa 111 at the top exponent, is the NaN this codec
+    never produces). Monotonic — positive e4m3 codes order like their
+    values, which is what the LUT encode relies on."""
+    codes = np.arange(127, dtype=np.int64)
+    e = codes >> 3
+    m = codes & 7
+    sub = (m / 8.0) * 2.0 ** -6                 # e == 0: subnormals
+    nrm = (1.0 + m / 8.0) * 2.0 ** (e - 7.0)    # normals, bias 7
+    return np.where(e == 0, sub, nrm).astype(np.float32)
+
+
+_E4M3 = _e4m3_values()
+_E4M3_MAX = float(_E4M3[-1])  # 448.0
+
+
+class Fp8Codec(Codec):
+    name = "fp8"
+    elem_wire_bytes = 1
+
+    def encode(self, x) -> np.ndarray:
+        v = _f32(x)
+        mag = np.minimum(np.abs(v), np.float32(_E4M3_MAX))
+        # nearest code via the sorted magnitude LUT: candidates bracket
+        # the input; exact midpoints take the EVEN code (codes are
+        # consecutive integers for positive e4m3, so IEEE's
+        # ties-to-even-mantissa is ties-to-even-code)
+        hi = np.searchsorted(_E4M3, mag).clip(0, 126)
+        lo = np.maximum(hi - 1, 0)
+        d_lo = mag - _E4M3[lo]
+        d_hi = _E4M3[hi] - mag
+        code = np.where(d_lo < d_hi, lo,
+                        np.where(d_hi < d_lo, hi,
+                                 np.where(lo % 2 == 0, lo, hi)))
+        out = code.astype(np.uint8)
+        out[np.signbit(v)] |= 0x80
+        return out
+
+    def decode(self, wire: np.ndarray, nelems: int) -> np.ndarray:
+        w = np.ascontiguousarray(wire, dtype=np.uint8)
+        assert w.size == nelems, \
+            f"fp8 wire carries {w.size} elems, expected {nelems}"
+        mag = _E4M3[(w & 0x7F).astype(np.int64)]
+        return np.where(w & 0x80, -mag, mag)
+
+
+class Int8Codec(Codec):
+    name = "int8"
+    elem_wire_bytes = 1
+    block = INT8_BLOCK
+
+    def wire_nbytes(self, nelems: int) -> int:
+        nblocks = (int(nelems) + self.block - 1) // self.block
+        return int(nelems) + 4 * nblocks
+
+    def _scales(self, v: np.ndarray) -> np.ndarray:
+        n = v.size
+        nblocks = (n + self.block - 1) // self.block
+        pad = np.zeros(nblocks * self.block, np.float32)
+        pad[:n] = np.abs(v)
+        return (pad.reshape(nblocks, self.block).max(axis=1)
+                / np.float32(127.0)).astype(np.float32)
+
+    def encode(self, x) -> np.ndarray:
+        v = _f32(x)
+        scales = self._scales(v)
+        s_elem = np.repeat(scales, self.block)[: v.size]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            q = np.where(s_elem > 0, v / s_elem, np.float32(0.0))
+        codes = np.rint(q).clip(-127, 127).astype(np.int8)
+        return np.concatenate([scales.view(np.uint8),
+                               codes.view(np.uint8)])
+
+    def decode(self, wire: np.ndarray, nelems: int) -> np.ndarray:
+        w = np.ascontiguousarray(wire, dtype=np.uint8)
+        nelems = int(nelems)
+        nblocks = (nelems + self.block - 1) // self.block
+        assert w.size == nelems + 4 * nblocks, \
+            f"int8 wire is {w.size}B, expected {nelems + 4 * nblocks}B"
+        scales = w[: 4 * nblocks].view(np.float32)
+        codes = w[4 * nblocks:].view(np.int8)
+        s_elem = np.repeat(scales, self.block)[:nelems]
+        return codes.astype(np.float32) * s_elem
+
+    def roundtrip(self, x) -> np.ndarray:
+        v = _f32(x)
+        scales = self._scales(v)
+        s_elem = np.repeat(scales, self.block)[: v.size]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            q = np.where(s_elem > 0, v / s_elem, np.float32(0.0))
+        codes = np.rint(q).clip(-127, 127).astype(np.int8)
+        return codes.astype(np.float32) * s_elem
+
+
+CODECS: Dict[str, Codec] = {c.name: c for c in
+                            (Bf16Codec(), Fp8Codec(), Int8Codec())}
+
+
+def get(name: str) -> Codec:
+    """The registered codec, loudly (a typo'd wire dtype must never
+    silently deliver f32)."""
+    try:
+        return CODECS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire codec {name!r}; known: {tuple(CODECS)}") from None
+
+
+def wire_nbytes(name: str, nelems: int) -> int:
+    """Exact wire bytes of ``nelems`` elements under codec ``name``;
+    ``"f32"`` reads as the uncompressed 4 bytes/elem (so callers can
+    account every round through one function)."""
+    if name == "f32":
+        return int(nelems) * 4
+    return get(name).wire_nbytes(nelems)
+
+
+# -- fused Pallas pack-kernel path --------------------------------------------
+
+_pallas_cache: Dict[str, object] = {}
+
+
+def _interpret() -> bool:
+    # CPU (tests, virtual meshes) runs the kernel in interpreter mode,
+    # the ops/pack_pallas.py precedent
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def _build_pallas_roundtrip(name: str):
+    """One fused quantize→dequantize VMEM kernel: the narrow intermediate
+    never round-trips through HBM. Operates on a float32 vector padded
+    to a (rows, 128) lane layout (float32's native tile shape); int8
+    reduces its per-block max inside the kernel over INT8_BLOCK-element
+    rows, matching the numpy reference's flat block boundaries."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if name == "bf16":
+        def kern(x_ref, o_ref):
+            o_ref[:] = x_ref[:].astype(jnp.bfloat16).astype(jnp.float32)
+    elif name == "fp8":
+        def kern(x_ref, o_ref):
+            # hand-rolled single-rounding e4m3 (XLA's astype double-
+            # rounds through an intermediate format and drifts off the
+            # reference on near-midpoint inputs): snap |x| to the
+            # power-of-two quantum grid of its exponent — division by a
+            # power of two is exact, so jnp.round's half-to-even tie is
+            # the IEEE tie — then saturate. Bitwise the numpy LUT.
+            x = x_ref[:]
+            ax = jnp.abs(x)
+            u = jax.lax.bitcast_convert_type(ax, jnp.uint32)
+            e = ((u >> 23) & 0xFF).astype(jnp.int32) - 127
+            quantum = jnp.exp2((jnp.maximum(e, -6) - 3)
+                               .astype(jnp.float32))
+            y = jnp.minimum(jnp.round(ax / quantum) * quantum,
+                            np.float32(_E4M3_MAX))
+            o_ref[:] = jnp.where(jnp.signbit(x), -y, y)
+    else:  # int8: rows are exactly one scale block wide
+        def kern(x_ref, s_ref, o_ref):
+            x = x_ref[:]
+            scale = s_ref[:]
+            q = jnp.where(scale > 0, x / scale, 0.0)
+            codes = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+            o_ref[:] = codes.astype(jnp.float32) * scale
+
+    def call(*ops):
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(ops[0].shape, jnp.float32),
+            interpret=_interpret())(*ops)
+
+    width = INT8_BLOCK if name == "int8" else 128
+
+    @jax.jit
+    def fn(x, c127):
+        n = x.size
+        rows = -(-max(n, 1) // width)
+        pad = jnp.zeros(rows * width, jnp.float32).at[:n].set(
+            x.reshape(-1).astype(jnp.float32))
+        x2d = pad.reshape(rows, width)
+        if name == "int8":
+            # the per-block scale divides by the TRACED 127 — XLA
+            # rewrites division by a literal into a reciprocal multiply
+            # (1 ulp off the correctly-rounded quotient the numpy
+            # reference computes), a traced divisor stays IEEE division
+            scale = jnp.max(jnp.abs(x2d), axis=1, keepdims=True) / c127
+            return call(x2d, scale).reshape(-1)[:n]
+        return call(x2d).reshape(-1)[:n]
+
+    return fn
+
+
+def pallas_roundtrip(name: str, x):
+    """Fused device quantize→dequantize under codec ``name`` — the
+    Pallas twin of ``Codec.roundtrip``, bitwise-pinned against the numpy
+    reference by the CPU-mesh parity tests. Accepts any float32 jax or
+    numpy array; returns a flat float32 jax array of the same size."""
+    get(name)  # loud on unknown codecs before any kernel builds
+    fn = _pallas_cache.get(name)
+    if fn is None:
+        fn = _build_pallas_roundtrip(name)
+        _pallas_cache[name] = fn
+    import jax.numpy as jnp
+    return fn(jnp.asarray(x, jnp.float32), jnp.float32(127.0))

@@ -1,0 +1,97 @@
+"""Error-feedback residual state for compressed reduction wires.
+
+The 1-bit SGD / Deep Gradient Compression recipe (Seide et al. 2014;
+Lin et al. 2018): every quantized message sends ``Q(x + r)`` where ``r``
+is the residual the PREVIOUS quantization of this same message slot
+dropped, and the new residual ``(x + r) - decode(Q(x + r))`` is carried
+to the next send — so the narrowing error cancels across training steps
+instead of accumulating, and the multi-step drift against an f32 wire
+stays bounded (the numerics soak in tests/test_compress.py asserts the
+bound).
+
+One :class:`ErrorFeedback` instance belongs to ONE
+``_RoundsReduceLowering`` (per-handle state, like the lowering's host
+work buffers). Slots key on the message's stable plan coordinates
+``(round index, src, dst, offset)`` — the compiled plan is
+deterministic, so a replay visits the same slots in the same order and
+each slot's residual meets the same logical message every step.
+
+Transactionality: ``apply_round`` may raise mid-round (chaos at the
+fault sites, an integrity mismatch) and the per-round retry loop then
+RE-DISPATCHES the round. A residual committed by the failed attempt
+would be double-counted by the retry — the dropped error would be
+re-added on a payload that never left. So adjustments stage into a
+pending map and only :meth:`commit` (called after ``apply_round``
+returns) moves them into the live slots; :meth:`discard` drops the
+failed attempt's staging.
+
+Invalidation coherence: the store stamps the shared invalidation
+generation at construction. A recompile builds a new lowering — and
+with it a fresh store — so residuals compiled against a dead plan can
+never leak into the new one's slots; the replacement is counted
+(``compress.ef_resets``) and surfaced through
+``api.compress_snapshot()``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..runtime import invalidation
+
+
+class ErrorFeedback:
+    """Per-lowering error-feedback residual slots (float32, one per
+    compressed message). Single-threaded by construction: the owning
+    lowering runs its rounds under the handle's start() call."""
+
+    def __init__(self):
+        self.generation = invalidation.current()
+        self._slots: Dict[Tuple, np.ndarray] = {}
+        self._pending: Dict[Tuple, np.ndarray] = {}
+        self.updates = 0  # committed slot writes (lifetime of the store)
+
+    def adjust(self, key: Tuple, payload: np.ndarray) -> np.ndarray:
+        """``payload + residual[key]`` as a fresh float32 array (the
+        f32 producer staging the codec encodes and integrity's redo
+        re-encodes from); a slot not yet seen contributes zero."""
+        out = np.asarray(payload, np.float32).copy()
+        r = self._slots.get(key)
+        if r is not None:
+            out += r
+        return out
+
+    def stage(self, key: Tuple, adjusted: np.ndarray,
+              delivered: np.ndarray) -> None:
+        """Stage the new residual ``adjusted - delivered`` for ``key``
+        (``adjusted`` from :meth:`adjust`, ``delivered`` the decoded
+        wire payload). Not live until :meth:`commit`."""
+        self._pending[key] = adjusted - delivered
+
+    def commit(self) -> None:
+        """The owning round applied cleanly: make staged residuals
+        live."""
+        if self._pending:
+            self.updates += len(self._pending)
+            self._slots.update(self._pending)
+            self._pending = {}
+
+    def discard(self) -> None:
+        """The owning round failed mid-apply: drop the staging so the
+        re-dispatch re-adjusts from the last COMMITTED residuals."""
+        self._pending = {}
+
+    @property
+    def slots(self) -> int:
+        return len(self._slots)
+
+    def residual_norm(self) -> float:
+        """Root-sum-square over every live slot — the one scalar the
+        snapshot reports per handle (how much error the wire is
+        currently carrying forward)."""
+        if not self._slots:
+            return 0.0
+        return float(np.sqrt(sum(float(np.dot(r.ravel(), r.ravel()))
+                                 for r in self._slots.values())))

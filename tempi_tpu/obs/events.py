@@ -93,6 +93,11 @@ EVENTS = (
     "integrity.retransmit",  # a mismatch triggered a re-delivery (site,
                              # link, strategy, attempt; attempt=0 marks a
                              # round re-dispatch)
+    # coll/persistent.py — compressed reduction wires (ISSUE 19)
+    "compress.encode",   # span: one compressed round's encode/verify/
+                         # decode pass (codec, round, msgs, raw and
+                         # wire bytes — the per-round twin of the
+                         # compress.* counters)
     # serving/engine.py + serving/kv_stream.py — inference serving (ISSUE 18)
     "serving.request",   # span: one request-latency sample — strategy=ttft
                          # (submit -> first token) or strategy=itl

@@ -126,6 +126,19 @@ SITES = (
                           # after the pre-dispatch raise is safe; wedge
                           # refused — rounds run under the progress
                           # lock, same rationale as coll.round)
+    "compress.encode",    # each COMPRESSED reduction round's codec pass
+                          # (coll/persistent._RoundsReduceLowering,
+                          # ISSUE 19 — fires BEFORE the round's first
+                          # message encodes, so a raise leaves the host
+                          # work buffers AND the error-feedback
+                          # residual slots untouched (residuals stage
+                          # pending and only commit after the round
+                          # applies cleanly): the per-round retry loop
+                          # re-dispatches and the replay re-encodes
+                          # from the same committed state; delay slows
+                          # the encoding producer; wedge refused — the
+                          # round runs under the progress lock, same
+                          # rationale as redcoll.round)
     "replace.apply",      # each rank re-placement apply step
                           # (parallel/replacement.py — fires BEFORE the
                           # new permutation is installed, so a raise

@@ -108,13 +108,16 @@ class IntegrityError(RuntimeError):
     def __init__(self, site: str, link, strategy: str,
                  round_: Optional[int] = None,
                  segment: Optional[int] = None,
-                 nbytes: int = 0, bad_chunks: Sequence[int] = ()):
+                 nbytes: int = 0, bad_chunks: Sequence[int] = (),
+                 wire_dtype: str = "f32"):
         lk = tuple(int(x) for x in link) if link is not None else None
         where = f"link={lk} strategy={strategy!r}"
         if round_ is not None:
             where += f" round={round_}"
         if segment is not None:
             where += f" segment={segment}"
+        if wire_dtype != "f32":
+            where += f" wire={wire_dtype}"
         super().__init__(
             f"payload corruption detected at {site}: {where} "
             f"({nbytes}B, bad chunk(s) {list(bad_chunks)}, "
@@ -131,6 +134,7 @@ class IntegrityError(RuntimeError):
         self.segment = segment
         self.nbytes = int(nbytes)
         self.bad_chunks = tuple(int(c) for c in bad_chunks)
+        self.wire_dtype = wire_dtype
         self.trace = None
         if obstrace.ENABLED:
             try:
@@ -202,11 +206,16 @@ def _mismatched(raw: np.ndarray, expected) -> List[int]:
 
 
 def _record_incident(site: str, link, strategy: str, round_,
-                     segment, nbytes: int, bad, action: str) -> None:
+                     segment, nbytes: int, bad, action: str,
+                     wire_dtype: str = "f32") -> None:
     """Append one corruption incident to the bounded ledger, stamped with
     the shared invalidation generation (the join key ``api.explain()``
     uses to narrate corruption → breaker.open → demotion causally), and
-    mirror it onto the timeline."""
+    mirror it onto the timeline. ``wire_dtype`` names the encoding of
+    the corrupted bytes (ISSUE 19): a compressed segment's chunk crc32s
+    cover the ENCODED image, and the retransmit seam re-encodes from the
+    pristine f32 producer staging — the incident must say which wire it
+    actually watched."""
     from . import invalidation
     global _total
     lk = [int(x) for x in link] if link is not None else None
@@ -216,15 +225,18 @@ def _record_incident(site: str, link, strategy: str, round_,
             seq=_total, site=site, link=lk, strategy=strategy,
             round=round_, segment=segment, nbytes=int(nbytes),
             bad_chunks=[int(c) for c in bad], action=action,
+            wire_dtype=wire_dtype,
             generation=invalidation.GENERATION, time=time.time()))
         del _incidents[:-_KEEP]
     timeline.record("integrity.corruption", site=site, link=lk,
-                    strategy=strategy, round=round_, action=action)
+                    strategy=strategy, round=round_, action=action,
+                    wire=wire_dtype)
 
 
 def verify_delivery(view, expected, *, site: str, link, strategy: str,
                     round_: Optional[int] = None,
                     segment: Optional[int] = None,
+                    wire_dtype: str = "f32",
                     redo: Optional[Callable[[], None]] = None) -> None:
     """Consumer-side validation of one covered copy: pass the in-flight
     ``view`` through the ``integrity.wire`` chaos site, recompute its
@@ -241,6 +253,14 @@ def verify_delivery(view, expected, *, site: str, link, strategy: str,
     whose enclosing round loop already re-dispatches idempotently (the
     persistent collective/reduction rounds) pass ``redo=None`` and let
     :func:`allow_round_retry` route the raise into that loop instead.
+
+    ``wire_dtype`` (ISSUE 19) names the encoding of the bytes this seam
+    watches — a compressed reduction round verifies the ENCODED payload
+    image (the bytes that actually crossed), and its ``redo`` must
+    RE-ENCODE from the pristine f32 producer staging rather than re-copy
+    a possibly-stale wire image; the dtype rides the incident ledger,
+    the error, and the timeline so a quantized-wire corruption is
+    attributable as such.
 
     Callers guard with ``integrity.ENABLED``."""
     from . import faults
@@ -272,7 +292,7 @@ def verify_delivery(view, expected, *, site: str, link, strategy: str,
         ig.num_corrupt += 1
         _record_incident(site, lk, strategy, round_, segment, raw.size,
                          bad, "retransmit" if attempt < attempts
-                         else "surface")
+                         else "surface", wire_dtype=wire_dtype)
         if lk is not None:
             health.record_failure(lk, strategy, error=f"corruption at "
                                   f"{site} (chunks {bad})",
@@ -284,7 +304,7 @@ def verify_delivery(view, expected, *, site: str, link, strategy: str,
                                    retransmits=attempt)
             raise IntegrityError(site, lk, strategy, round_=round_,
                                  segment=segment, nbytes=raw.size,
-                                 bad_chunks=bad)
+                                 bad_chunks=bad, wire_dtype=wire_dtype)
         attempt += 1
         ig.num_retransmits += 1
         if obstrace.ENABLED:

@@ -102,7 +102,19 @@ class CollCounters:
     reduce_hier_compiles: int = 0   # two-level reduction plans built
     reduce_hier_rounds_ici: int = 0  # intra-node (reduce/broadcast) rounds
     reduce_hier_rounds_dcn: int = 0  # leader-exchange rounds run
-    reduce_wire_bytes: int = 0  # bytes the dispatched rounds moved
+    reduce_wire_bytes: int = 0  # bytes the dispatched rounds moved, AS
+    #                             ENCODED (a compressed round counts its
+    #                             wire image — scales included — not its
+    #                             f32 payload; with compression off this
+    #                             is byte-identical to the pre-ISSUE-19
+    #                             raw total)
+    # byte-accurate per-wire-dtype splits of reduce_wire_bytes
+    # (ISSUE 19): compression savings are the visible f32-vs-narrow
+    # delta, not an element-count approximation
+    reduce_wire_bytes_f32: int = 0
+    reduce_wire_bytes_bf16: int = 0
+    reduce_wire_bytes_fp8: int = 0
+    reduce_wire_bytes_int8: int = 0
 
 
 @dataclass
@@ -248,6 +260,22 @@ class ServingCounters:
 
 
 @dataclass
+class CompressCounters:
+    # compressed collectives (ISSUE 19; tempi_tpu/compress/): pinned at
+    # zero with TEMPI_REDCOLL_COMPRESS=off — the counter-based
+    # byte-for-byte guard that the off path encodes, prices, and
+    # narrows nothing
+    num_encodes: int = 0      # message payloads encoded to a wire image
+    num_decodes: int = 0      # wire images decoded back to f32
+    raw_bytes: int = 0        # f32 payload bytes the encodes consumed
+    wire_bytes: int = 0       # encoded bytes shipped (scales included)
+    saved_bytes: int = 0      # raw_bytes - wire_bytes, running
+    ef_updates: int = 0       # error-feedback residual slots committed
+    ef_resets: int = 0        # residual stores dropped by a recompile
+    #                           (invalidation-coherent reset)
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -280,6 +308,7 @@ class Counters:
     lockcheck: LockCheckCounters = field(default_factory=LockCheckCounters)
     integrity: IntegrityCounters = field(default_factory=IntegrityCounters)
     serving: ServingCounters = field(default_factory=ServingCounters)
+    compress: CompressCounters = field(default_factory=CompressCounters)
 
     def as_dict(self) -> dict:
         out = {}

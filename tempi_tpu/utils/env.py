@@ -185,6 +185,37 @@ coll/persistent.py and the README "Reduction collectives" section):
                          4 MiB; 0 disables splitting; negative rejected
                          loudly).
 
+Compressed-collective knobs (ISSUE 19; see tempi_tpu/compress/ and the
+README "Compressed collectives" section):
+  TEMPI_REDCOLL_COMPRESS = off | bf16 | fp8 | int8 | auto — quantized
+                         wire formats for the persistent reduction
+                         round plans (default off: the engine is
+                         byte-for-byte the f32 engine and every
+                         compress.* counter stays zero). ``bf16`` /
+                         ``fp8`` (e4m3) / ``int8`` (per-block scales)
+                         force that codec onto every round-plan method
+                         — and drop the un-compressible ``fused`` arm
+                         from AUTO's candidates, so the forced knob is
+                         never silently inert. ``auto`` lets every
+                         (method, codec) arm compete in the model-
+                         driven choice, priced per (algorithm, link
+                         tier, wire bytes) from the swept sheet with
+                         the encode/decode transform added.
+                         Accumulation is ALWAYS float32 — only wire
+                         bytes narrow; hierarchical plans compress the
+                         DCN leader exchange only (ICI phases stay
+                         f32); the fused device lowering has no host
+                         wire and never compresses.
+  TEMPI_REDCOLL_EF     = on | off — error-feedback residuals on
+                         compressed wires (default on; meaningless
+                         without TEMPI_REDCOLL_COMPRESS): each message
+                         slot carries the quantization error its last
+                         send dropped and re-adds it before the next
+                         encode (1-bit-SGD / DGC style), so multi-step
+                         drift vs an f32 wire stays bounded. ``off``
+                         quantizes memorylessly (the drift-comparison
+                         arm of the numerics soak).
+
 Multi-tenant QoS knobs (ISSUE 7; see runtime/qos.py, runtime/progress.py
 and the README "Multi-tenant QoS" section):
   TEMPI_QOS_DEFAULT    = latency | bulk — the QoS class of communicators
@@ -519,6 +550,9 @@ KNOWN_KNOBS = (
     # reduction collectives (ISSUE 14)
     "TEMPI_REDCOLL",
     "TEMPI_REDCOLL_CHUNK_BYTES",
+    # compressed collectives (ISSUE 19)
+    "TEMPI_REDCOLL_COMPRESS",
+    "TEMPI_REDCOLL_EF",
     # multi-tenant QoS (ISSUE 7)
     "TEMPI_QOS_DEFAULT",
     "TEMPI_QOS_QUEUE_DEPTH",
@@ -700,6 +734,10 @@ class Environment:
     redcoll: str = "auto"          # off | auto | ring | halving
     redcoll_chunk_bytes: int = 1 << 22  # per-round per-rank byte bound
     #                                     (0 = no splitting)
+    # compressed collectives (ISSUE 19) — see tempi_tpu/compress/
+    redcoll_compress: str = "off"  # off | bf16 | fp8 | int8 | auto
+    redcoll_ef: str = "on"         # on | off (error feedback on
+    #                                compressed wires)
     # multi-tenant QoS (no reference analog; ISSUE 7) — see runtime/qos.py
     # (class scheduler) and runtime/progress.py (pump integration)
     qos_default: str = ""          # "" = QoS off | latency | bulk
@@ -989,6 +1027,23 @@ class Environment:
         e.redcoll_chunk_bytes = _pos_int_env("TEMPI_REDCOLL_CHUNK_BYTES",
                                              1 << 22)
 
+        # compressed-collective knobs parse loudly too (ISSUE 19): a
+        # typo'd codec silently leaving the wire at f32 would quietly
+        # hand back the DCN bandwidth the deployment asked to reclaim —
+        # and a typo'd codec silently PICKING one would change training
+        # numerics; both are the loud-parse rule's target class
+        cz = (getenv("TEMPI_REDCOLL_COMPRESS") or "off").lower()
+        if cz not in ("off", "bf16", "fp8", "int8", "auto"):
+            raise ValueError(
+                f"bad TEMPI_REDCOLL_COMPRESS={cz!r}: want off | bf16 | "
+                "fp8 | int8 | auto")
+        e.redcoll_compress = cz
+        ef = (getenv("TEMPI_REDCOLL_EF") or "on").lower()
+        if ef not in ("on", "off"):
+            raise ValueError(
+                f"bad TEMPI_REDCOLL_EF={ef!r}: want on | off")
+        e.redcoll_ef = ef
+
         # QoS knobs parse loudly too: a typo'd class name silently leaving
         # QoS off would hand the one multi-tenant deployment that asked
         # for isolation the exact head-of-line blocking it configured
@@ -1259,6 +1314,9 @@ class Environment:
             # ...and the reduction round-plan engine: the bail-out's
             # reductions are the library's fused lowering only
             e.redcoll = "off"
+            # ...and with it the compressed wires: the fused lowering
+            # has no host wire to narrow
+            e.redcoll_compress = "off"
             # ...and re-placement: "no placement remap" is the bail-out's
             # explicit contract, one-shot AND online
             e.replace_mode = "off"

@@ -84,6 +84,11 @@ def pytest_configure(config):
         "serving: inference-serving tests — byte-exact KV streaming, "
         "request-latency metrics, page-fault chaos, churn rebinds (the "
         "<30s smoke is `pytest -m serving`)")
+    config.addinivalue_line(
+        "markers",
+        "compress: compressed-collective tests — codec properties, "
+        "error-feedback numerics, costed-arm choice, quantized-wire "
+        "integrity (the <30s smoke is `pytest -m compress`)")
 
 
 @pytest.fixture(autouse=True)
@@ -92,6 +97,7 @@ def _reset_globals():
     disarmed fault table (a chaos test's wedges/specs must never leak
     into the next test — release() also frees any still-blocked
     wedged thread so it can exit)."""
+    from tempi_tpu.compress import arms as compress_arms
     from tempi_tpu.obs import trace as obstrace
     from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import (autopilot, elastic, faults, health,
@@ -114,6 +120,7 @@ def _reset_globals():
     autopilot.configure()
     integrity.configure()
     serving_engine.configure()
+    compress_arms.configure()
     counters.init()
     health.reset()
     yield

@@ -1,0 +1,710 @@
+"""Compressed collectives (ISSUE 19): the quantized wire codecs
+(compress/codecs.py), the per-handle error-feedback residuals
+(compress/feedback.py), the costed compression arms (compress/arms.py),
+and the threading through the reduction engine (coll/reduce.py
+``wire_dtype``, coll/persistent._RoundsReduceLowering).
+
+Marker ``compress`` is the tier-1-compatible <30s smoke (`pytest -m
+compress`); the chaos variants are dual-marked ``faults`` so the chaos
+smoke exercises the ``compress.encode`` site and the compressed
+integrity.wire retransmit seam (satellite 6).
+"""
+
+import numpy as np
+import pytest
+
+from tempi_tpu import api
+from tempi_tpu.coll import reduce as redsched
+from tempi_tpu.compress import arms as carms
+from tempi_tpu.compress import codecs
+from tempi_tpu.compress.feedback import ErrorFeedback
+from tempi_tpu.runtime import faults, integrity
+from tempi_tpu.utils import counters as ctr
+from tempi_tpu.utils import env as envmod
+
+pytestmark = pytest.mark.compress
+
+
+def _rand(n, seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n) * scale).astype(np.float32)
+
+
+def _np_op(op):
+    from tempi_tpu.parallel.reduce import host_op
+    return host_op(op)
+
+
+# -- codec properties (no mesh) -----------------------------------------------
+
+
+@pytest.mark.parametrize("name", codecs.NAMES)
+@pytest.mark.parametrize("n", [1, 5, 127, 255, 256, 257, 1000])
+def test_roundtrip_is_decode_encode_bitwise(name, n):
+    """The executable-spec contract: ``roundtrip`` (the fused path the
+    integrity-off wire runs) equals ``decode(encode(x))`` bitwise, and
+    the encoded image is exactly ``wire_nbytes`` long — scales
+    included."""
+    codec = codecs.get(name)
+    x = _rand(n, seed=n, scale=10.0)
+    x[0] = 0.0
+    if n > 4:
+        x[1] = -0.0
+        x[2] = 3e-40   # f32 subnormal territory
+        x[3] = 448.0   # the fp8 max normal
+        x[4] = -1e9    # saturates fp8
+    wire = codec.encode(x)
+    assert wire.dtype == np.uint8
+    assert wire.size == codec.wire_nbytes(n)
+    via_wire = codec.decode(wire, n)
+    fused = codec.roundtrip(x)
+    np.testing.assert_array_equal(np.asarray(via_wire).view(np.uint8),
+                                  np.asarray(fused).view(np.uint8))
+
+
+def test_bf16_matches_platform_rne():
+    """The bit-trick encode is round-to-nearest-even — bitwise the
+    platform's own f32->bf16->f32 conversion, ties included."""
+    import jax.numpy as jnp
+    x = _rand(4096, seed=3, scale=100.0)
+    # exact ties at the keep-bit boundary: mantissa low half = 0x8000
+    ties = (np.arange(16, dtype=np.uint32) << 16 | 0x8000 |
+            0x3F800000).view(np.float32)
+    x = np.concatenate([x, ties, -ties])
+    want = np.asarray(jnp.asarray(x).astype(jnp.bfloat16)
+                      .astype(jnp.float32))
+    got = codecs.get("bf16").roundtrip(x)
+    np.testing.assert_array_equal(got.view(np.uint8), want.view(np.uint8))
+
+
+def test_fp8_exact_on_e4m3_grid_and_saturates():
+    """Every representable e4m3fn value round-trips exactly (both
+    signs); magnitudes beyond 448 saturate to +-448; the NaN code is
+    never produced."""
+    from tempi_tpu.compress.codecs import _E4M3, _E4M3_MAX
+    codec = codecs.get("fp8")
+    grid = np.concatenate([_E4M3, -_E4M3]).astype(np.float32)
+    np.testing.assert_array_equal(codec.roundtrip(grid).view(np.uint8),
+                                  grid.view(np.uint8))
+    big = np.array([1e9, -1e9, 500.0, -449.0], np.float32)
+    np.testing.assert_array_equal(codec.roundtrip(big),
+                                  np.array([_E4M3_MAX, -_E4M3_MAX,
+                                            _E4M3_MAX, -_E4M3_MAX],
+                                           np.float32))
+    wire = codec.encode(_rand(5000, seed=9, scale=1e4))
+    assert not np.any((wire & 0x7F) == 0x7F)
+
+
+def test_int8_blockwise_scales_and_exactness():
+    """Per-block symmetric quantization: a block whose max is 127 codes
+    integers exactly, an all-zero block decodes to exact zeros, blocks
+    quantize independently, and ragged tails price their scale word."""
+    codec = codecs.get("int8")
+    b = codec.block
+    ints = np.zeros(2 * b, np.float32)
+    ints[:b] = np.random.default_rng(1).integers(-127, 128, b)
+    ints[0] = 127.0  # pins block 0's scale to exactly 1.0
+    # block 1 stays all-zero: scale 0, exact zeros back
+    got = codec.roundtrip(ints)
+    np.testing.assert_array_equal(got, ints)
+    # block independence: perturbing block 1 must not move block 0
+    other = ints.copy()
+    other[b:] = _rand(b, seed=5, scale=1e6)
+    np.testing.assert_array_equal(codec.roundtrip(other)[:b], got[:b])
+    assert codec.wire_nbytes(b + 1) == (b + 1) + 4 * 2  # two scale words
+
+
+def test_unknown_codec_is_loud():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        codecs.get("fp16")
+    assert codecs.wire_nbytes("f32", 10) == 40  # the uncompressed read
+
+
+@pytest.mark.parametrize("name", codecs.NAMES)
+@pytest.mark.parametrize("n", [1, 7, 255, 256, 257, 513])
+def test_pallas_roundtrip_parity(name, n):
+    """The fused Pallas quantize->dequantize kernel is bitwise the
+    numpy reference — the two implementations cannot drift."""
+    x = _rand(n, seed=n + 17, scale=5.0)
+    want = codecs.get(name).roundtrip(x)
+    got = np.asarray(codecs.pallas_roundtrip(name, x))
+    np.testing.assert_array_equal(got.view(np.uint8), want.view(np.uint8))
+
+
+# -- error-feedback store (no mesh) -------------------------------------------
+
+
+def test_error_feedback_transactional():
+    """adjust adds only COMMITTED residuals; stage->discard drops a
+    failed round's residuals (the re-dispatch double-count guard);
+    stage->commit makes them live and counts the updates."""
+    ef = ErrorFeedback()
+    x = np.array([1.0, 2.0], np.float32)
+    d = np.array([0.75, 2.25], np.float32)
+    assert np.array_equal(ef.adjust(("k",), x), x)
+    ef.stage(("k",), x, d)
+    assert np.array_equal(ef.adjust(("k",), x), x)  # pending not live
+    ef.discard()
+    ef.stage(("k",), x, d)
+    ef.commit()
+    assert ef.updates == 1 and ef.slots == 1
+    np.testing.assert_allclose(ef.adjust(("k",), x), x + (x - d))
+    assert ef.residual_norm() > 0
+
+
+# -- schedule-level wire semantics (simulate, no mesh) ------------------------
+
+
+@pytest.mark.parametrize("size", [3, 5, 8])  # non-pow2 included
+@pytest.mark.parametrize("wire", ["bf16", "fp8"])
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_simulate_exact_on_representable_values(size, wire, op):
+    """Exactness on representable values: integer payloads small enough
+    that every partial result stays on the codec's grid make the
+    quantize->reduce->dequantize composition LOSSLESS — compressed
+    simulate equals the dense f32 reference bitwise, across ops,
+    non-power-of-two worlds, and ragged counts."""
+    rng = np.random.default_rng(size * 7 + len(wire))
+    counts = rng.integers(0, 9, size)
+    counts[0] = max(counts[0], 1)
+    rows = [rng.integers(0, 3, counts.sum()).astype(np.float32)
+            for _ in range(size)]
+    dense = _np_op(op).reduce(rows, axis=0).astype(np.float32)
+    for alg in redsched.algorithms_for(size):
+        s = redsched.compile_allreduce(size, counts.tolist(), alg,
+                                       wire_dtype=wire)
+        got = s.simulate(rows, _np_op(op))
+        for r in range(size):
+            np.testing.assert_array_equal(
+                np.asarray(got[r]).view(np.uint8), dense.view(np.uint8))
+
+
+def test_simulate_int8_error_bounded():
+    """int8 is lossy on arbitrary payloads but per-hop bounded: each
+    wire hop moves a value by at most half its block's scale, and hops
+    are bounded by the round count."""
+    size, n = 8, 512
+    rows = [_rand(n, seed=r, scale=2.0) for r in range(size)]
+    dense = np.add.reduce(rows, axis=0)
+    s = redsched.compile_allreduce(size, [n // size] * size, "ring",
+                                   wire_dtype="int8")
+    got = s.simulate(rows, np.add)
+    hops = 2 * size  # <= ring round count, generous
+    bound = hops * (np.abs(dense).max() + size * 2.0) / 127.0
+    for r in range(size):
+        assert np.abs(got[r] - dense).max() <= bound
+
+
+def test_hier_simulate_compresses_dcn_only():
+    """The tier asymmetry at the compiler level, proven by value
+    construction: (a) fully representable payloads are lossless end to
+    end; (b) per-rank values bf16 would MANGLE but whose node sums are
+    representable still come back exact — so the ICI phase cannot be
+    quantizing; (c) node sums off the bf16 grid do get quantized — so
+    the DCN phase really is."""
+    node_of = [0, 0, 1, 1, 2, 2, 3, 3]
+    leaders = [0, 2, 4, 6]
+    n = 16
+
+    def run(rows, wire):
+        s = redsched.compile_hier_reduce(n, node_of, leaders, "ring",
+                                         wire_dtype=wire)
+        return s.simulate(rows, np.add)[0]
+
+    ints = [np.full(n, float(r % 3), np.float32) for r in range(8)]
+    np.testing.assert_array_equal(run(ints, "bf16"),
+                                  np.add.reduce(ints, axis=0))
+    # 1 + 2^-9 needs 9 mantissa bits (not bf16-representable); the two
+    # ranks of each node sum to exactly 2.0
+    a = np.full(n, 1.0 + 2.0 ** -9, np.float32)
+    b = np.full(n, 1.0 - 2.0 ** -9, np.float32)
+    pairs = [a, b, a, b, a, b, a, b]
+    np.testing.assert_array_equal(run(pairs, "bf16"), np.full(n, 8.0))
+    # node sums 2 + 2^-9 are off the bf16 grid -> the DCN exchange
+    # quantizes them; the f32 wire does not
+    c = np.full(n, 1.0 + 2.0 ** -9, np.float32)
+    d = np.full(n, 1.0, np.float32)
+    odd = [c, d, c, d, c, d, c, d]
+    dense = np.add.reduce(odd, axis=0)
+    np.testing.assert_array_equal(run(odd, "f32"), dense)
+    assert np.abs(run(odd, "bf16") - dense).max() > 0
+
+
+def test_compile_rejects_unknown_wire_dtype():
+    with pytest.raises(AssertionError):
+        redsched.compile_allreduce(4, [2, 2, 2, 2], "ring",
+                                   wire_dtype="fp4")
+
+
+# -- runtime on the 8-device CPU mesh -----------------------------------------
+
+
+@pytest.fixture()
+def world():
+    comm = api.init()
+    yield comm
+    api.finalize()
+
+
+@pytest.fixture()
+def make_world():
+    inited = []
+
+    def f():
+        comm = api.init()
+        inited.append(comm)
+        return comm
+
+    yield f
+    if inited:
+        api.finalize()
+
+
+def _fill(comm, vals):
+    return comm.buffer_from_host(
+        [np.ascontiguousarray(v).view(np.uint8).copy() for v in vals])
+
+
+def _elems(buf, rank, dtype, n):
+    return buf.get_rank(rank)[: n * np.dtype(dtype).itemsize].view(dtype)
+
+
+def _refill(comm, buf, vals):
+    """Rewrite every rank's row in place (the soak's per-step gradient
+    reload) without disturbing the handle's compiled plan."""
+    lib_rows = [None] * comm.size
+    for ar, v in enumerate(vals):
+        lib_rows[comm.library_rank(ar)] = \
+            np.ascontiguousarray(v).view(np.uint8)
+    buf.data = comm._put_global(np.stack(lib_rows))
+
+
+def _force_hier(monkeypatch, rpn="2"):
+    monkeypatch.setenv("TEMPI_RANKS_PER_NODE", rpn)
+    monkeypatch.setenv("TEMPI_COLL_HIER", "hier")
+    envmod.read_environment()
+
+
+def test_off_mode_byte_for_byte_and_counters_pinned(world):
+    """TEMPI_REDCOLL_COMPRESS=off is the f32 engine byte-for-byte:
+    exact delivery, every compress.* counter pinned at zero, the whole
+    wire-byte total attributed to the f32 bucket, and an empty
+    snapshot."""
+    envmod.env.redcoll = "ring"
+    n = 24
+    vals = [np.arange(n, dtype=np.float32) + r for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.wire_dtype == "f32"
+    pr.start()
+    pr.wait()
+    want = np.add.reduce(vals, axis=0)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n), want)
+    cc = ctr.counters.compress
+    assert (cc.num_encodes, cc.num_decodes, cc.raw_bytes, cc.wire_bytes,
+            cc.saved_bytes, cc.ef_updates, cc.ef_resets) == (0,) * 7
+    co = ctr.counters.coll
+    assert co.reduce_wire_bytes > 0
+    assert co.reduce_wire_bytes_f32 == co.reduce_wire_bytes
+    assert co.reduce_wire_bytes_bf16 == 0
+    assert co.reduce_wire_bytes_fp8 == 0
+    assert co.reduce_wire_bytes_int8 == 0
+    snap = api.compress_snapshot()
+    assert snap["mode"] == "off" and snap["arms"] == {}
+    assert snap["adoptions"] == []
+    pr.free()
+
+
+@pytest.mark.parametrize("wire", codecs.NAMES)
+def test_forced_codec_runtime_matches_simulate(world, wire):
+    """Exact delivery: the runtime's first start is bitwise the
+    compressed schedule's own simulate (error-feedback residuals start
+    at zero, so the wire transform is identical), on a ragged count,
+    with the wire bytes attributed to the codec's bucket and the
+    adoption ledgered as forced."""
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = wire
+    n = 77  # not a multiple of the world size
+    vals = [_rand(n, seed=r, scale=3.0) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method == "ring" and pr.wire_dtype == wire
+    sched = pr._schedule_for(pr.method, wire)
+    want = sched.simulate(vals, np.add)
+    pr.start()
+    pr.wait()
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, np.float32, n).view(np.uint8),
+            np.asarray(want[r]).view(np.uint8))
+    co = ctr.counters.coll
+    codec_bucket = getattr(co, f"reduce_wire_bytes_{wire}")
+    assert codec_bucket > 0
+    assert co.reduce_wire_bytes_f32 + codec_bucket == co.reduce_wire_bytes
+    cc = ctr.counters.compress
+    assert cc.num_encodes == cc.num_decodes > 0
+    assert cc.saved_bytes == cc.raw_bytes - cc.wire_bytes > 0
+    snap = api.compress_snapshot()
+    assert snap["arms"][wire]["saved_bytes"] > 0
+    assert any(a["codec"] == wire and a["forced"]
+               for a in snap["adoptions"])
+    pr.free()
+
+
+def test_exact_delivery_across_replays_ef_off(world):
+    """With error feedback off the wire transform is stateless, so
+    EVERY replay — not just the first — is bitwise the iterated
+    simulate (reducing the already-reduced buffer again)."""
+    envmod.env.redcoll = "halving"
+    envmod.env.redcoll_compress = "bf16"
+    envmod.env.redcoll_ef = "off"
+    n = 32
+    vals = [_rand(n, seed=r + 50) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr._lowering._ef is None
+    sched = pr._schedule_for(pr.method, "bf16")
+    rows = [v.copy() for v in vals]
+    for _ in range(3):
+        pr.start()
+        pr.wait()
+        rows = [np.asarray(x).copy()
+                for x in sched.simulate(rows, np.add)]
+        for r in range(world.size):
+            np.testing.assert_array_equal(
+                _elems(buf, r, np.float32, n).view(np.uint8),
+                rows[r].view(np.uint8))
+    assert ctr.counters.compress.ef_updates == 0
+    pr.free()
+
+
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_ops_exact_on_bf16_representable_inputs(world, op):
+    """f32 payloads already on the bf16 grid reduce exactly under the
+    compressed wire for every op — the f32/bf16-input leg of the
+    exact-delivery acceptance sweep."""
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "bf16"
+    n = 40
+    rng = np.random.default_rng(11)
+    vals = [rng.integers(-8, 9, n).astype(np.float32)
+            for _ in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op=op)
+    pr.start()
+    pr.wait()
+    want = _np_op(op).reduce(vals, axis=0).astype(np.float32)
+    for r in range(world.size):
+        np.testing.assert_array_equal(_elems(buf, r, np.float32, n), want)
+    pr.free()
+
+
+def test_forced_codec_refuses_non_f32_loudly(world):
+    """A forced codec on a non-float32 collective must refuse, not
+    silently deliver f32 — the loud-knob rule at the dtype seam."""
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "fp8"
+    buf = world.alloc(64)
+    with pytest.raises(RuntimeError, match="float32"):
+        api.allreduce_init(world, buf, dtype=np.int32, op="sum")
+
+
+def test_forced_codec_excludes_fused_arm(world):
+    """Under AUTO method selection a forced codec strips the fused
+    library arm (it has no host wire to narrow): the chooser lands on a
+    round plan carrying the codec even on an unmeasured sheet."""
+    from tempi_tpu.measure import system as msys
+    prior = msys.get()
+    try:
+        msys.set_system(msys.SystemPerformance())  # unmeasured
+        envmod.env.redcoll = "auto"
+        envmod.env.redcoll_compress = "int8"
+        buf = world.alloc(1 << 12)
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        assert pr.method in ("ring", "halving")
+        assert pr.wire_dtype == "int8"
+        pr.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_hier_runtime_compresses_dcn_only(make_world, monkeypatch):
+    """The runtime tier asymmetry: a hierarchical plan under a forced
+    codec quantizes the DCN leader exchange ONLY — the bf16 bucket is
+    exactly the DCN rounds' encoded bytes, ICI and stage traffic stays
+    in the f32 bucket, and delivery is bitwise the schedule's own
+    simulate."""
+    _force_hier(monkeypatch, "2")
+    world = make_world()  # init re-reads the env; set the knob after
+    envmod.env.redcoll_compress = "bf16"
+    n = 777  # ragged
+    vals = [_rand(n, seed=r + 5, scale=2.0) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    assert pr.method.startswith("hier_") and pr.wire_dtype == "bf16"
+    sched = pr._schedule_for(pr.method, "bf16")
+    want = sched.simulate(vals, np.add)
+    pr.start()
+    pr.wait()
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, np.float32, n).view(np.uint8),
+            np.asarray(want[r]).view(np.uint8))
+    codec = codecs.get("bf16")
+    dcn_wire = sum(codec.wire_nbytes(m.nelems)
+                   for tier, rnd in sched.all_rounds()
+                   if tier == "dcn" for m in rnd)
+    co = ctr.counters.coll
+    assert co.reduce_wire_bytes_bf16 == dcn_wire > 0
+    assert co.reduce_wire_bytes_f32 > 0
+    assert co.reduce_wire_bytes_f32 + dcn_wire == co.reduce_wire_bytes
+    pr.free()
+
+
+def test_ef_soak_drift_bounded(world):
+    """The numerics soak (>=100 steps, seeded): per-slot error feedback
+    telescopes — each slot's accumulated delivered error collapses to
+    its final residual — so the ACCUMULATED drift of the compressed
+    allreduce against the f32 reference stays bounded instead of
+    growing with the step count, and beats the same wire with feedback
+    disabled."""
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "fp8"
+    steps, n = 110, 128
+    rng = np.random.default_rng(1234)
+    grads = [[rng.standard_normal(n).astype(np.float32)
+              for _ in range(world.size)] for _ in range(steps)]
+
+    def soak(ef_on):
+        envmod.env.redcoll_ef = "on" if ef_on else "off"
+        buf = _fill(world, grads[0])
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        drift = np.zeros(n, np.float64)
+        for t in range(steps):
+            _refill(world, buf, grads[t])
+            pr.start()
+            pr.wait()
+            got = _elems(buf, 0, np.float32, n).astype(np.float64)
+            drift += got - np.add.reduce(grads[t], axis=0)
+        pr.free()
+        return np.abs(drift).max()
+
+    d_off = soak(False)
+    d_on = soak(True)
+    # one fp8 step on these magnitudes is ~|x|/16 per hop; the EF-on
+    # accumulated drift must stay at the few-steps level while the
+    # feedback-less wire random-walks with sqrt(steps)
+    assert d_on < 1.0, f"EF drift {d_on} unbounded over {steps} steps"
+    assert d_on < 0.5 * d_off, (d_on, d_off)
+    assert ctr.counters.compress.ef_updates > 0
+    assert api.compress_snapshot()["arms"]["fp8"]["residual_norm"] > 0
+
+
+def test_ef_reset_counted_on_recompile(world):
+    """A recompile replaces the lowering and with it the residual store
+    (plan-coordinate slots cannot survive a plan change); the
+    replacement is counted when live residuals are dropped."""
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "bf16"
+    buf = _fill(world, [_rand(16, seed=r) for r in range(world.size)])
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    assert pr._lowering._ef.slots > 0
+    from tempi_tpu.runtime import invalidation
+    world.mapping_epoch += 1
+    world.invalidate_plans()
+    invalidation.bump("mapping", f"test epoch {world.mapping_epoch}")
+    pr.start()
+    pr.wait()
+    assert ctr.counters.compress.ef_resets == 1
+    assert pr._lowering._ef.generation == invalidation.GENERATION
+    pr.free()
+
+
+def test_pricing_asymmetry_and_auto_adoption(make_world, monkeypatch):
+    """The honest cost story, end to end on a crafted sheet with cheap
+    host curves and an expensive byte-proportional inter-node link: a
+    compressed FLAT arm prices WORSE than its f32 twin (the transform
+    rides a host-speed wire), a compressed HIER arm prices BETTER (the
+    DCN leader exchange narrows), and AUTO therefore adopts a codec for
+    the hier plan — ledgered as un-forced."""
+    from tempi_tpu.coll import persistent as pcoll
+    from tempi_tpu.measure import system as msys
+    _force_hier(monkeypatch, "2")
+    world = make_world()  # init re-reads the env; set the knob after
+    envmod.env.redcoll_compress = "auto"
+    prior = msys.get()
+    try:
+        sp = msys.SystemPerformance()
+        cheap = [(1, 1e-9), (1 << 22, 1e-7)]
+        sp.d2h = list(cheap)
+        sp.h2d = list(cheap)
+        sp.host_pingpong = list(cheap)
+        sp.intra_node_pingpong = list(cheap)
+        sp.inter_node_pingpong = [(1, 1e-6), (1 << 22, 4.0)]
+        msys.set_system(sp)
+        nb = 1 << 16
+        counts = [nb // 4 // world.size] * world.size
+        flat = {"ring": redsched.compile_allreduce(
+            world.size, counts, "ring")}
+        f32_flat = pcoll._reduce_estimates(world, ["ring"], flat,
+                                           nb)["ring"]
+        bf16_flat = carms.estimates(flat, nb, names=("bf16",))[
+            ("ring", "bf16")]
+        assert bf16_flat > f32_flat  # flat: the transform never pays
+        node_of = [r // 2 for r in range(world.size)]
+        leaders = [r for r in range(world.size) if r % 2 == 0]
+        hier = {"hier_ring": redsched.compile_hier_reduce(
+            nb // 4, node_of, leaders, "ring")}
+        f32_hier = pcoll._reduce_estimates(world, ["hier_ring"], hier,
+                                           nb)["hier_ring"]
+        bf16_hier = carms.estimates(hier, nb, names=("bf16",))[
+            ("hier_ring", "bf16")]
+        assert bf16_hier < f32_hier  # hier: narrowing the DCN pays
+        buf = world.alloc(nb)
+        pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+        assert pr.method.startswith("hier_")
+        assert pr.wire_dtype in codecs.NAMES
+        snap = api.compress_snapshot()
+        assert any(a["codec"] == pr.wire_dtype and not a["forced"]
+                   for a in snap["adoptions"])
+        pr.free()
+    finally:
+        msys.set_system(prior)
+
+
+def test_choice_event_and_spans_carry_wire(world):
+    """Observability: redcoll.choice carries the wire field, each
+    compressed redcoll.round span is tagged with its wire dtype, and
+    every compressed round emits a compress.encode span with the byte
+    evidence."""
+    from tempi_tpu.obs import trace as obstrace
+    obstrace.configure("flight")
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "bf16"
+    buf = world.alloc(256)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    pr.start()
+    pr.wait()
+    events = obstrace.snapshot()
+    choices = [e for e in events if e["name"] == "redcoll.choice"]
+    assert choices and choices[0]["wire"] == "bf16"
+    spans = [e for e in events if e["name"] == "redcoll.round"]
+    last = max(s["round"] for s in spans)
+    inner = [s for s in spans if 0 < s["round"] < last]
+    assert inner and all(s.get("wire") == "bf16" for s in inner)
+    # the stage-in/out host passes stay f32 and untagged
+    assert all("wire" not in s for s in spans
+               if s["round"] in (0, last))
+    enc = [e for e in events if e["name"] == "compress.encode"]
+    assert len(enc) == len(inner)
+    assert all(e["codec"] == "bf16" and e["wire"] < e["raw"]
+               for e in enc)
+    pr.free()
+    obstrace.configure("off")
+
+
+# -- chaos: the compress.encode site and the compressed integrity seam --------
+
+
+@pytest.mark.faults
+def test_encode_fault_drops_pending_residuals(world, monkeypatch):
+    """compress.encode fires BEFORE the round's first message encodes;
+    a raise leaves the error-feedback store at its committed state (no
+    pending leak) and a later healthy start delivers bitwise."""
+    monkeypatch.setenv("TEMPI_FAULTS", "compress.encode:raise:1:3")
+    envmod.read_environment()
+    faults.configure()
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "bf16"
+    n = 16
+    vals = [_rand(n, seed=r + 2) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    with pytest.raises(faults.InjectedFault):
+        pr.start()
+    ef = pr._lowering._ef
+    assert ef._pending == {} and ef.slots == 0
+    faults.reset()
+    sched = pr._schedule_for("ring", "bf16")
+    want = sched.simulate(vals, np.add)
+    pr.start()
+    pr.wait()
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, np.float32, n).view(np.uint8),
+            np.asarray(want[r]).view(np.uint8))
+    pr.free()
+
+
+@pytest.mark.faults
+def test_encode_chaos_with_retries_delivers(world, monkeypatch):
+    """Probabilistic compress.encode chaos under the per-round retry
+    loop: the transactional residual staging means a re-dispatched
+    round re-encodes from the same committed state — delivery stays
+    bitwise the compressed simulate, with no double-counted feedback."""
+    monkeypatch.setenv("TEMPI_FAULTS", "compress.encode:raise:0.5:7")
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "8")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    faults.configure()
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "int8"
+    n = 24
+    vals = [_rand(n, seed=r + 30, scale=2.0) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    sched = pr._schedule_for("ring", "int8")
+    want = sched.simulate(vals, np.add)
+    pr.start()
+    pr.wait()
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, np.float32, n).view(np.uint8),
+            np.asarray(want[r]).view(np.uint8))
+    pr.free()
+
+
+@pytest.mark.faults
+def test_retransmit_compressed_wire_re_encodes(world, monkeypatch):
+    """Satellite 6: checksums cover the ENCODED image, and a corrupted
+    compressed segment retransmits by RE-ENCODING from the pristine f32
+    producer staging — delivery stays bitwise the compressed simulate
+    and the incident ledger names the wire dtype."""
+    monkeypatch.setenv("TEMPI_RETRY_ATTEMPTS", "10")
+    monkeypatch.setenv("TEMPI_RETRY_BACKOFF_S", "0")
+    envmod.read_environment()
+    integrity.configure("retransmit")
+    faults.configure("integrity.wire:corrupt:0.4:31")
+    envmod.env.redcoll = "ring"
+    envmod.env.redcoll_compress = "int8"
+    n = 48
+    vals = [_rand(n, seed=r + 9, scale=3.0) for r in range(world.size)]
+    buf = _fill(world, vals)
+    pr = api.allreduce_init(world, buf, dtype=np.float32, op="sum")
+    sched = pr._schedule_for("ring", "int8")
+    want = sched.simulate(vals, np.add)
+    pr.start()
+    pr.wait()
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            _elems(buf, r, np.float32, n).view(np.uint8),
+            np.asarray(want[r]).view(np.uint8))
+    ig = ctr.counters.integrity
+    assert ig.num_corrupt >= 1 and ig.num_retransmits >= 1
+    snap = api.integrity_snapshot()
+    assert any(i.get("wire_dtype") == "int8" for i in snap["incidents"])
+    pr.free()
+
+
+@pytest.mark.faults
+def test_wedge_refused_at_encode_site():
+    """compress.encode runs under the progress lock: wedge must refuse
+    at arm time, same rationale as redcoll.round."""
+    with pytest.raises(faults.FaultSpecError, match="not supported"):
+        faults.configure("compress.encode:wedge:1.0:1")
+    faults.configure("compress.encode:raise:1.0:1")  # raise stays fine
+    faults.configure("compress.encode:delay:1.0:1")  # delay too
+    faults.reset()
