@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""ZeRO-sharded data-parallel step time under the training overlap
+engine (ISSUE 20; tempi_tpu/train/).
+
+One ``ZeroDPModel`` (seeded, integer-valued — the same workload the
+byte-exact property tests pin) drives a ``ZeroShardedStep`` — per
+reverse-creation-order bucket: reduce_scatter gradients, rank-local
+sharded SGD, allgather parameters — under each ``TEMPI_OVERLAP`` mode:
+
+  * ``off``     — the serial baseline (every collective at the barrier);
+  * ``observe`` — serial too, plus the would-start decision ledger (its
+    step time is the overhead-of-observation arm);
+  * ``on``      — bucket reduce_scatters dispatch to the overlap worker
+    in ready order while later gradients are still being produced, and
+    each allgather hides behind the remaining buckets' updates.
+
+``--compute-iters`` scales the per-parameter device-compute window
+(``ZeroDPModel.busywork``: 100us units of host-IDLE time modeling the
+accelerator-resident backward between gradient arrivals) — the thing
+communication overlaps WITH; at 0 there is nothing to hide behind and
+``on`` degenerates to a worker handoff tax. The window comes AFTER
+each gradient lands (backward keeps computing the next layer while
+this bucket's reduce_scatter is in flight), so every bucket —
+including the last — has a window to hide in. Idle time, not host-CPU
+busywork, is deliberate: on a single-core container host compute and
+the reduction's own host CPU are zero-sum (total CPU is conserved, the
+wall clock cannot move), while a real training step's compute lives on
+the accelerator and leaves the host genuinely idle — which is exactly
+the window the overlap worker fills. cpu-mesh-8 is the judged shape:
+
+    python bench_zero_dp.py --cpu --cpu-devices 8 --quick
+
+TEMPI_METRICS is forced on: the per-mode straggler-skew columns come
+from the metrics attribution rows (worst (span, strategy) window per
+arm), and the realized ``overlap_fraction`` comes from the aggregate in
+``api.metrics_snapshot()``.
+
+CSV columns: mode, step_s, comm_s, exposed_s, overlap_fraction,
+early_starts, deferred, barrier_starts, skew_span, skew_us, modal_rank.
+The on-vs-off speedup and overlap fraction print to stderr; ``--json
+PATH`` additionally writes the rows plus the counter and overlap
+snapshots as one numeric-flattenable JSON document for
+``perf_report.py --compare`` (the overlap_fraction / counters.overlap.*
+trajectory columns).
+"""
+
+import json
+import os
+import sys
+
+from _common import base_parser, bench_kwargs, devices_or_die, emit_csv, \
+    setup_platform
+
+MODES = ("off", "observe", "on")
+
+
+def main() -> int:
+    p = base_parser("ZeRO-sharded DP step time: overlap on vs off")
+    p.add_argument("--layers", type=int, nargs="*",
+                   default=[1 << 17, 1 << 17, 1 << 16, 1 << 15, 1 << 13])
+    p.add_argument("--compute-iters", type=int, default=100,
+                   help="per-parameter device-compute window in 100us "
+                        "units (the host-idle time communication hides "
+                        "inside; 0 = pure communication, nothing to "
+                        "overlap)")
+    p.add_argument("--bucket-bytes", type=int, default=1 << 19)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write rows + counter/overlap snapshots as "
+                        "one JSON doc for perf_report.py --compare")
+    args = p.parse_args()
+    # before api.init(): the attribution columns and overlap_fraction
+    # below read the metrics layer, which arms from the env at init
+    os.environ.setdefault("TEMPI_METRICS", "on")
+    setup_platform(args)
+
+    from tempi_tpu import api, train
+    from tempi_tpu.measure.benchmark import benchmark
+    from tempi_tpu.models.zero_dp import ZeroDPModel
+    from tempi_tpu.obs import metrics as obsmetrics
+    from tempi_tpu.train.zero import ZeroShardedStep
+    from tempi_tpu.utils import counters as ctr
+
+    devices_or_die(2)
+    comm = api.init()
+    kw = bench_kwargs(args.quick)
+    # quick scales the model AND the bucket cap together — shrinking
+    # only the layers would collapse everything into one bucket and
+    # leave the pipeline nothing to overlap
+    layers = args.layers if not args.quick \
+        else [max(1, n // 8) for n in args.layers]
+    cap = args.bucket_bytes if not args.quick \
+        else max(1, args.bucket_bytes // 8)
+    # quick shrinks the compute windows too (collectives are ~8x
+    # cheaper; a full-size window would just be dead air in both arms)
+    citers = args.compute_iters if not args.quick \
+        else max(1, args.compute_iters // 4)
+    model = ZeroDPModel(layers, seed=args.seed, compute_iters=citers)
+    nelems = sum(layers)
+    print(f"zero_dp: world {comm.size}, {len(layers)} layers, "
+          f"{nelems} params, bucket {cap}B, "
+          f"compute_iters {citers}", file=sys.stderr)
+
+    # pregenerate the gradient streams OUTSIDE the timed step: RNG is
+    # GIL-held host work that is neither the compute being modeled nor
+    # the communication being hidden — regenerating it per step buries
+    # the overlap signal in sampling noise
+    model.compute_iters, ci = 0, model.compute_iters
+    pregrads = [list(model.grad_rows(s, comm.size)) for s in range(4)]
+    model.compute_iters = ci
+
+    rows = []
+    times = {}
+    fractions = {}
+    for mode in MODES:
+        train.configure(mode)
+        obsmetrics.configure()  # fresh windows: per-arm attribution
+        z = ZeroShardedStep(comm, model.params_spec(),
+                            model.init_values(), lr=0.5,
+                            cap_bytes=cap)
+        stepno = [0]
+
+        def one_step():
+            pre = pregrads[stepno[0] % len(pregrads)]
+
+            def produce():
+                # compute window AFTER each gradient lands: the step
+                # stages the parameter (and in ``on`` mode dispatches a
+                # full bucket's reduce_scatter) on the yield, then the
+                # emulated backward keeps going while that collective
+                # is in flight
+                for item in pre:
+                    yield item
+                    model.busywork()
+
+            z.step(produce())
+            stepno[0] += 1
+
+        one_step()  # caches hot (round plans compiled in __init__)
+        ov0 = (ctr.counters.overlap.num_early_starts,
+               ctr.counters.overlap.num_deferred,
+               ctr.counters.overlap.num_barrier_starts)
+        r = benchmark(one_step, **kw)
+        ov = ctr.counters.overlap
+        stats = z.last_stats()
+        snap = api.metrics_snapshot()
+        frac = snap.get("overlap_fraction", 0.0)
+        att = obsmetrics.attribution()
+        worst = att[0] if att else {}
+        rows.append((mode, r.trimean, stats["comm_s"],
+                     stats["exposed_s"], frac,
+                     ov.num_early_starts - ov0[0],
+                     ov.num_deferred - ov0[1],
+                     ov.num_barrier_starts - ov0[2],
+                     worst.get("span", ""),
+                     round(worst.get("last_skew_s", 0.0) * 1e6, 1),
+                     worst.get("modal_rank", "")))
+        times[mode] = r.trimean
+        fractions[mode] = frac
+        z.free()
+    train.configure("off")
+
+    emit_csv(("mode", "step_s", "comm_s", "exposed_s", "overlap_fraction",
+              "early_starts", "deferred", "barrier_starts", "skew_span",
+              "skew_us", "modal_rank"), rows)
+    if times["on"] > 0:
+        print(f"overlap speedup: {times['off'] / times['on']:.2f}x "
+              f"on vs off ({times['off']:.3e}s -> {times['on']:.3e}s), "
+              f"overlap_fraction {fractions['on']:.2f}", file=sys.stderr)
+    if times["observe"] > 0:
+        print(f"observe overhead: "
+              f"{times['observe'] / times['off']:.3f}x vs off",
+              file=sys.stderr)
+    if args.json:
+        doc = {"rows": [dict(zip(("mode", "step_s", "comm_s", "exposed_s",
+                                  "overlap_fraction", "early_starts",
+                                  "deferred", "barrier_starts",
+                                  "skew_span", "skew_us", "modal_rank"),
+                                 r)) for r in rows],
+               "overlap_fraction": fractions["on"],
+               "speedup_on_vs_off": (times["off"] / times["on"]
+                                     if times["on"] > 0 else 0.0),
+               "counters": api.counters_snapshot(),
+               "overlap": {k: v for k, v in api.overlap_snapshot().items()
+                           if k != "decisions"}}
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        print(f"json doc -> {args.json}", file=sys.stderr)
+    api.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,12 @@ Usage: python benches/perf_report.py [path-to-sheet.json]
        whose last dotted segment is N; any violation (or a bound that
        matched no key) prints loudly and exits 1. parse_slo/check_slo
        are importable: the autopilot bench and CI share this one
-       SLO-checking code path)
+       SLO-checking code path. ISSUE 20: bench_zero_dp.py's JSON doc
+       flattens into overlap columns here — ``overlap_fraction``,
+       ``speedup_on_vs_off``, and the ``counters.overlap.*`` group
+       (num_early_starts / num_deferred / num_barrier_starts / ...) —
+       so the training-overlap trajectory diffs run to run like every
+       other numeric key)
 
        python benches/perf_report.py --tune [path-to-tune.json]
        (ISSUE 4: summarize the learned online-tuning state — per-(link,

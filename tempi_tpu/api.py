@@ -76,6 +76,10 @@ def init(devices=None) -> Communicator:
     from .serving import engine as serving_engine
     serving_engine.configure()  # arm TEMPI_SERVE (knobs loud-parsed
     # above; this clears any prior session's completed-request ledger)
+    from . import train
+    train.configure()  # arm TEMPI_OVERLAP (knobs loud-parsed above;
+    # this clears any prior session's overlap decision ledger and swaps
+    # out any prior session's overlap worker thread)
     counters.init()
     if devices is None:
         # multi-host path (SURVEY §5 backend trait (b)): join the
@@ -254,6 +258,11 @@ def finalize() -> None:
         serving_engine.configure()  # the completed-request ledger is
         # per-session evidence too (env-armed serving survives:
         # configure re-reads the parsed mode)
+        from . import train
+        train.configure()  # the overlap decision ledger and the worker
+        # thread are per-session too (env-armed overlap survives:
+        # configure re-reads the parsed mode and starts a fresh worker
+        # lazily on the next early start)
         _world = None
 
 
@@ -333,6 +342,23 @@ def serving_snapshot() -> dict:
     init and after finalize (reads inert)."""
     from .serving import engine as serving_engine
     return serving_engine.snapshot()
+
+
+def overlap_snapshot() -> dict:
+    """Diagnostic snapshot of the training overlap engine (ISSUE 20;
+    tempi_tpu/train/): the parsed mode (``TEMPI_OVERLAP``) and bucket
+    cap, the worker-thread liveness flag, and the bounded decision
+    ledger — one row per scheduling decision (``early`` dispatches to
+    the overlap worker, ``observed`` would-starts in observe mode,
+    ``deferred``/``barrier`` degradations with their chaos or worker-
+    failure reason, ``learned``/``invalidated`` window-plan events),
+    each stamped with a monotone sequence number. The realized overlap
+    itself is in :func:`metrics_snapshot` (``overlap`` /
+    ``overlap_fraction``) and the ``overlap.*`` counter group. Pure
+    data — safe to serialize. Callable before init and after finalize
+    (reads inert)."""
+    from . import train
+    return train.snapshot()
 
 
 def comm_set_qos(comm: Communicator, qos_class: Optional[str]) -> None:
@@ -598,6 +624,11 @@ def metrics_snapshot() -> dict:
       (last edge +Inf).
     * ``steps`` — per-step critical paths; ``open_windows``,
       ``dropped_keys``, ``mode``, ``enabled`` as before.
+    * ``overlap`` — per-communicator realized training-overlap totals
+      (ISSUE 20; tempi_tpu/train/): ``comm_uid → {steps, comm_s,
+      exposed_s, last_fraction}``, plus the top-level
+      ``overlap_fraction`` aggregate (hidden communication seconds over
+      total communication seconds; 0.0 when no overlapped step ran).
 
     The same attribution rows are available sorted by last-round skew
     via ``tempi_tpu.obs.metrics.attribution()``, and histogram

@@ -1528,6 +1528,19 @@ class PersistentReduce:
         self._check_alive()
         self._compile()
 
+    @property
+    def sendbuf(self) -> DistBuffer:
+        """Step-capture protocol alias: ``coll/step.py`` reads the
+        ``sendbuf``/``recvbuf`` pair off every recorded collective (for
+        the wait() drain set and the overlap-window disjointness
+        analysis), and this class names its buffers ``inbuf``/``outbuf``."""
+        return self.inbuf
+
+    @property
+    def recvbuf(self) -> DistBuffer:
+        """Step-capture protocol alias (see :attr:`sendbuf`)."""
+        return self.outbuf
+
     # -- compile / recompile --------------------------------------------------
 
     def _derive_topology(self) -> None:

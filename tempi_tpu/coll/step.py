@@ -145,11 +145,13 @@ class StepRecorder:
 
     # -- compile ---------------------------------------------------------------
 
-    def compile(self) -> "PersistentStep":
+    def compile(self, name: Optional[str] = None) -> "PersistentStep":
         """Lower the recording into a :class:`PersistentStep`. Refused on
         an empty capture (a step that replays nothing is a bug at the
         capture site, not a valid fast path) and while the capture is
-        still active (the recording is not yet complete)."""
+        still active (the recording is not yet complete). ``name`` labels
+        the step in diagnostics (the concurrent-replay refusal names the
+        conflicting step by it); default ``step-<N>``."""
         if self.armed:
             raise RuntimeError(
                 "StepRecorder.compile() inside the capture_step context — "
@@ -163,7 +165,7 @@ class StepRecorder:
                 "capture_step recorded no exchanges on comm uid "
                 f"{self.comm.uid}: nothing to compile (did the iteration "
                 "run on a different communicator?)")
-        step = PersistentStep(self.comm, list(self.entries))
+        step = PersistentStep(self.comm, list(self.entries), name=name)
         # only a SUCCESSFUL lowering consumes the recorder: a failed
         # compile (conflicting pins, unmatched capture, dead-rank comm)
         # must leave it retryable after the caller fixes the cause,
@@ -191,6 +193,17 @@ def end_capture(comm: Communicator, rec: StepRecorder) -> None:
 # -- compiled step ------------------------------------------------------------
 
 
+#: Steps currently between start() and wait(), per communicator uid.
+#: Two INDEPENDENT fused steps may replay concurrently (ISSUE 20 — the
+#: overlap engine pipelines them); start() refuses, naming both steps,
+#: when the new step touches a buffer an in-flight step still owns —
+#: interleaved drains over a shared buffer would complete each other's
+#: exchanges out of order. List mutations are GIL-atomic appends/
+#: rebinds; entries self-prune on wait()/free() and on the inactive
+#: sweep at the next start(), so a leaked handle never wedges the key.
+_inflight: Dict[int, List["PersistentStep"]] = {}
+
+
 class PersistentStep:
     """A compiled, replayable training-step communication schedule.
 
@@ -206,12 +219,22 @@ class PersistentStep:
     already-dispatched plans stay applied, and a restart over unchanged
     input buffers re-delivers identical bytes."""
 
-    def __init__(self, comm: Communicator, entries: List[tuple]):
+    _seq = 0
+
+    def __init__(self, comm: Communicator, entries: List[tuple],
+                 name: Optional[str] = None):
         self.comm = comm
         self._entries = entries
+        PersistentStep._seq += 1
+        self.name = name or f"step-{PersistentStep._seq}"
         self._active = False
         self._started = False
         self._freed = False
+        # learned overlap windows (tempi_tpu/train/windows.py, ISSUE 20):
+        # a duck-typed plan installed via install_overlap(); None replays
+        # every embedded collective inline at its recorded position
+        self._overlap_plan = None
+        self._overlap_tasks: List = []
         # stamped BEFORE the build reads any trigger state (the same
         # conservative ordering as PersistentColl): a trigger firing
         # mid-build is caught by the next start's compare
@@ -245,6 +268,14 @@ class PersistentStep:
         comm = self.comm
         fuse = envmod.env.step_fuse
         self._eager_only = envmod.env.step_mode == "off"
+        oplan = self._overlap_plan
+        if oplan is not None:
+            # a rebuild renumbers program items — a learned overlap plan
+            # keyed by the old indices is stale and must not early-start
+            # the wrong collective; drop it (train/windows.learn()
+            # re-derives against the fresh program)
+            self._overlap_plan = None
+            oplan.invalidated()
         # 1. linearize: global call list + the program skeleton (which
         # calls land in which barrier-delimited segment, colls, drains)
         calls: List[tuple] = []      # [(envs, pin)] in recorded order
@@ -428,6 +459,25 @@ class PersistentStep:
                  f"{self.comm.mapping_epoch})")
         self._inval_token = token
 
+    # -- learned overlap windows (ISSUE 20) -----------------------------------
+
+    def install_overlap(self, plan) -> None:
+        """Install a learned overlap plan (train/windows.py — duck-typed:
+        ``.early`` item indices, ``.dispatch(idx, pcoll)``, ``.join(tasks)``,
+        ``.invalidated()``). Replaces any previous plan; a rebuild drops
+        it (see ``_build``). Refused while the step is in flight — the
+        running replay already committed to its dispatch order."""
+        if self._freed:
+            raise RuntimeError("install_overlap() on a freed persistent "
+                               "step")
+        if self._active:
+            raise RuntimeError("install_overlap() on an active persistent "
+                               "step (wait() it first)")
+        old = self._overlap_plan
+        self._overlap_plan = plan
+        if old is not None and old is not plan:
+            old.invalidated()
+
     # -- MPI persistent-request surface ---------------------------------------
 
     def start(self) -> None:
@@ -446,6 +496,24 @@ class PersistentStep:
         if faults.ENABLED:
             faults.check("step.replay")
         comm = self.comm
+        # concurrent independent steps (ISSUE 20): disjoint-buffer steps
+        # may be in flight together (the overlap engine pipelines them);
+        # a shared buffer refuses LOUDLY, naming both steps — the two
+        # drains would complete each other's exchanges out of order
+        reg = _inflight.setdefault(comm.uid, [])
+        reg[:] = [s for s in reg if s._active]  # prune leaked handles
+        for other in reg:
+            if other is self:
+                continue
+            for b in self._bufs:
+                if any(b is x for x in other._bufs):
+                    raise RuntimeError(
+                        f"start() on persistent step '{self.name}': a "
+                        f"{b.nbytes}-byte buffer is still in flight "
+                        f"under step '{other.name}' — concurrent steps "
+                        f"must touch disjoint buffers; wait() "
+                        f"'{other.name}' first")
+        concurrent = any(s is not self for s in reg)
         t0 = time.monotonic() if obstrace.ENABLED else 0.0
         men = obsmetrics.ENABLED
         prof: List[tuple] = []
@@ -468,8 +536,27 @@ class PersistentStep:
             else:
                 if self._started:
                     ctr.counters.step.num_replays += 1
+                # learned overlap windows (ISSUE 20): eligible embedded
+                # collectives dispatch to the overlap worker UP FRONT —
+                # the earliest safe point, their buffers being disjoint
+                # from every other item by learn()'s analysis — and are
+                # joined in wait(); everything else replays inline at
+                # its recorded position. A dispatch the plan declines
+                # (off/observe mode, overlap.start chaos) returns None
+                # and that collective stays inline: degradation serial,
+                # never lost.
+                skip = ()
+                oplan = self._overlap_plan
+                if oplan is not None:
+                    tasks = []
+                    for idx in sorted(oplan.early):
+                        t = oplan.dispatch(idx, self._program[idx][1])
+                        if t is not None:
+                            tasks.append(t)
+                    self._overlap_tasks = tasks
+                    skip = {t.index for t in tasks}
                 dispatched = 0
-                for item in self._program:
+                for i, item in enumerate(self._program):
                     if item[0] == "plans":
                         durs = []
                         for plan, strat, binding in item[1]:
@@ -483,6 +570,8 @@ class PersistentStep:
                         if men:
                             prof.append(("plans", durs))
                     elif item[0] == "coll":
+                        if i in skip:
+                            continue  # in flight on the overlap worker
                         pcoll = item[1]
                         tp = time.monotonic() if men else 0.0
                         pcoll.start()
@@ -506,6 +595,9 @@ class PersistentStep:
                 replays=ctr.counters.step.num_replays)
         self._started = True
         self._active = True
+        if concurrent:
+            ctr.counters.step.num_concurrent_replays += 1
+        reg.append(self)
 
     def _start_eager(self) -> None:
         """Re-issue the recorded step through the normal engine (caller
@@ -547,9 +639,18 @@ class PersistentStep:
         if not self._active:
             raise RuntimeError("wait() on an inactive persistent step")
         try:
+            tasks, self._overlap_tasks = self._overlap_tasks, []
+            if tasks:
+                # join the early-started collectives; the plan degrades
+                # a failed task to a serial re-run here and records the
+                # realized overlap (obs/metrics.note_overlap)
+                self._overlap_plan.join(tasks)
             p2p._sync_bufs(self._bufs, deadline=p2p._deadline())
         finally:
             self._active = False
+            reg = _inflight.get(self.comm.uid)
+            if reg is not None:
+                reg[:] = [s for s in reg if s is not self]
             if obsmetrics.ENABLED:
                 obsmetrics.round_end(self.comm.uid, "step.replay")
 
@@ -560,6 +661,8 @@ class PersistentStep:
             raise RuntimeError("test() on a freed persistent step")
         if not self._active:
             raise RuntimeError("test() on an inactive persistent step")
+        if any(not t.done() for t in self._overlap_tasks):
+            return False  # an early-started collective is still in flight
         if not all(p2p._buf_ready(b) for b in self._bufs):
             return False
         self.wait()
@@ -573,6 +676,10 @@ class PersistentStep:
         if self._active:
             raise RuntimeError("free() on an active persistent step "
                                "(wait() it first)")
+        reg = _inflight.get(self.comm.uid)
+        if reg is not None:
+            reg[:] = [s for s in reg if s is not self]
+        self._overlap_plan = None
         self._program = []
         self._entries = []
         self._bufs = []

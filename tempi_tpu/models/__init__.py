@@ -1,3 +1,4 @@
 from . import halo3d  # noqa: F401
 from . import kv_serving  # noqa: F401
 from . import ring_attention  # noqa: F401
+from . import zero_dp  # noqa: F401

@@ -1,0 +1,112 @@
+"""Seeded deterministic data-parallel model for the ZeRO overlap engine.
+
+The byte-exactness contract (tempi_tpu/train/zero.py, ISSUE 20) needs a
+workload whose every number is reproducible to the bit: the property
+tests assert that one :class:`~..train.zero.ZeroShardedStep` run under
+``TEMPI_OVERLAP=on`` lands on EXACTLY the bytes the ``off`` run and the
+pure-numpy reference land on. So this model is numpy-only and
+integer-valued by construction — parameters and gradients are small
+integers stored in float32, and with a power-of-two learning rate and
+world size every SGD update stays exactly representable: no rounding,
+no accumulation-order sensitivity, nothing for overlap timing to hide
+behind.
+
+It also carries the compute half of the overlap story:
+:meth:`ZeroDPModel.busywork` models the accelerator-resident
+forward/backward compute a training step interleaves between gradient
+arrivals — as host-idle time (``time.sleep``), because that is what
+device compute IS from the host's point of view: while the TPU runs
+the fused step the host thread is parked, and that idle window is
+exactly what the overlap worker's communication hides inside. On this
+repo's single-core CPU containers the distinction is load-bearing:
+host-CPU busywork (matmul, python spin) and the reduction's own host
+CPU are zero-sum on one core — total CPU is conserved, so "overlap"
+against host compute merely interleaves and the wall clock does not
+move. Idle-window busywork is the honest model AND the measurable one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+class ZeroDPModel:
+    """A stack of ``layer_sizes`` parameter tensors, created first-layer
+    first (so REVERSE creation order — the bucket assignment order — is
+    last-layer first, the order backward produces gradients)."""
+
+    def __init__(self, layer_sizes: Sequence[int], seed: int = 0,
+                 compute_iters: int = 0):
+        if not layer_sizes:
+            raise ValueError("ZeroDPModel needs at least one layer")
+        self.layer_sizes = [int(n) for n in layer_sizes]
+        if any(n <= 0 for n in self.layer_sizes):
+            raise ValueError(f"non-positive layer size in {layer_sizes}")
+        self.seed = int(seed)
+        self.compute_iters = int(compute_iters)
+        self.names = [f"layer{i}" for i in range(len(self.layer_sizes))]
+
+    # -- parameter / gradient generation --------------------------------------
+
+    def params_spec(self) -> List[Tuple[str, int]]:
+        """``(name, nelems)`` in CREATION order (the ZeroShardedStep /
+        GradBucketScheduler constructor argument)."""
+        return list(zip(self.names, self.layer_sizes))
+
+    def init_values(self) -> dict:
+        """Deterministic integer-valued float32 initial parameters."""
+        out = {}
+        for li, (name, n) in enumerate(self.params_spec()):
+            rng = np.random.default_rng(self.seed * 1009 + li)
+            out[name] = rng.integers(-8, 9, size=n).astype(np.float32)
+        return out
+
+    def grad_rows(self, step: int, size: int
+                  ) -> Iterator[Tuple[str, List[np.ndarray]]]:
+        """One step's gradients: per parameter, ``size`` per-rank rows of
+        small integers, yielded LAST layer first — the ready order a
+        backward pass produces, which is what makes reverse-creation
+        bucketing fill front buckets first."""
+        for li in reversed(range(len(self.layer_sizes))):
+            name = self.names[li]
+            n = self.layer_sizes[li]
+            rows = []
+            for r in range(size):
+                rng = np.random.default_rng(
+                    (self.seed * 7919 + step) * 65537 + li * 257 + r)
+                rows.append(rng.integers(-4, 5, size=n).astype(np.float32))
+            if self.compute_iters:
+                self.busywork()
+            yield name, rows
+
+    def busywork(self) -> float:
+        """One layer's worth of emulated device compute:
+        ``compute_iters`` x 100us of host-idle time, standing in for
+        the accelerator-resident backward work between gradient
+        arrivals (see the module docstring for why idle time — not
+        host CPU — is the faithful stand-in). Returns the seconds
+        slept."""
+        dur = self.compute_iters * 1e-4
+        if dur > 0:
+            time.sleep(dur)
+        return dur
+
+    # -- pure-numpy reference -------------------------------------------------
+
+    def reference_step(self, values: dict, step: int, size: int,
+                       lr: float = 0.5, average: bool = True) -> dict:
+        """The arithmetic the distributed step must match bitwise: sum
+        the per-rank gradient rows, scale by ``lr/size`` (float32
+        throughout, the same dtype path the wire takes), subtract."""
+        out = {}
+        grads = {name: rows for name, rows in self.grad_rows(step, size)}
+        scale = np.float32(lr) / np.float32(size if average else 1)
+        for name in self.names:
+            g = grads[name][0].copy()
+            for row in grads[name][1:]:
+                g += row
+            out[name] = (values[name] - scale * g).astype(np.float32)
+        return out

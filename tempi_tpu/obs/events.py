@@ -105,4 +105,10 @@ EVENTS = (
                          # and the autopilot SLO gate via WATCH_SPANS
     "serving.stream",    # span: one KV page pushed prefill -> decode
                          # (rid, page, nbytes, replay)
+    # tempi_tpu/train/ — training overlap engine (ISSUE 20)
+    "overlap.schedule",  # one overlap scheduling decision (bucket or
+                         # captured-step collective): action=early|
+                         # deferred|observed|barrier, with the bucket/
+                         # item coordinates — the trace twin of the
+                         # overlap decision ledger
 )

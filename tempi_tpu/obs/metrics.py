@@ -81,6 +81,10 @@ _stragglers: Dict[Tuple[str, str], "_Straggler"] = {}
 # arrival stamps every open window (it belongs to both replays)
 _windows: Dict[int, List["_Window"]] = {}
 _steps: Dict[int, dict] = {}
+# per-communicator realized-overlap accounting fed by the training
+# overlap engine (tempi_tpu/train/, ISSUE 20): total collective seconds
+# vs the seconds the step-end barrier actually blocked
+_overlap: Dict[int, dict] = {}
 _dropped_keys = 0
 
 _OTHER_KEY = ("(other)", "-", "-")
@@ -170,6 +174,7 @@ def configure(mode: Optional[str] = None) -> None:
         _stragglers.clear()
         _windows.clear()
         _steps.clear()
+        _overlap.clear()
         global _dropped_keys
         _dropped_keys = 0
     # outside the metrics lock: the recorder takes its own lock to swap
@@ -193,6 +198,7 @@ def finalize() -> None:
         _stragglers.clear()
         _windows.clear()
         _steps.clear()
+        _overlap.clear()
         _dropped_keys = 0
 
 
@@ -358,6 +364,34 @@ def note_step_replay(comm_uid: int, profile: List[tuple]) -> None:
         st["chain"] = chain
 
 
+def note_overlap(comm_uid: int, comm_s: float, exposed_s: float) -> None:
+    """One overlap-accounted training step (or captured-step replay) from
+    ``tempi_tpu/train/``: ``comm_s`` is the total collective wall time
+    the step performed, ``exposed_s`` the part the step-end barrier (or
+    inline serial starts) actually blocked on — the rest was hidden
+    behind compute. The realized ``overlap_fraction`` is
+    ``1 - exposed/comm`` (clamped), surfaced per communicator and as the
+    snapshot's top-level aggregate."""
+    if not ENABLED:
+        return
+    exposed_s = min(max(exposed_s, 0.0), max(comm_s, 0.0))
+    with _lock:
+        ov = _overlap.get(comm_uid)
+        if ov is None:
+            if len(_overlap) >= MAX_KEYS:
+                global _dropped_keys
+                _dropped_keys += 1
+                return
+            ov = _overlap[comm_uid] = dict(steps=0, comm_s=0.0,
+                                           exposed_s=0.0,
+                                           last_fraction=0.0)
+        ov["steps"] += 1
+        ov["comm_s"] += comm_s
+        ov["exposed_s"] += exposed_s
+        ov["last_fraction"] = (1.0 - exposed_s / comm_s) if comm_s > 0 \
+            else 0.0
+
+
 # -- surfaces ------------------------------------------------------------------
 
 
@@ -448,12 +482,21 @@ def snapshot() -> dict:
                            max_critical_path_s=st["max_s"],
                            chain=[dict(c) for c in st["chain"]])
                  for uid, st in _steps.items()}
+        overlap = {uid: dict(ov) for uid, ov in _overlap.items()}
+        # aggregate realized overlap across communicators: the fraction
+        # of all collective time hidden behind compute (0.0 when the
+        # overlap engine recorded nothing)
+        tot_comm = sum(ov["comm_s"] for ov in _overlap.values())
+        tot_exp = sum(ov["exposed_s"] for ov in _overlap.values())
+        frac = (1.0 - tot_exp / tot_comm) if tot_comm > 0 else 0.0
         return dict(mode=MODE, enabled=ENABLED,
                     bucket_edges_us=bucket_edges_us(),
                     histograms=sorted(hists,
                                       key=lambda d: -d["count"]),
                     stragglers=sorted(strag, key=lambda d: -d["rounds"]),
                     steps=steps,
+                    overlap=overlap,
+                    overlap_fraction=frac,
                     open_windows=sum(len(s) for s in _windows.values()),
                     dropped_keys=_dropped_keys)
 
@@ -502,6 +545,17 @@ def report() -> str:
         lines.append(f"tempi_step_critical_path_seconds{{{lbl}}} "
                      f"{_fmt(st['last_critical_path_s'])}")
         lines.append(f"tempi_step_replays_total{{{lbl}}} {st['replays']}")
+    if snap["overlap"]:
+        lines.append("# TYPE tempi_overlap_fraction gauge")
+        for uid, ov in sorted(snap["overlap"].items()):
+            lbl = f'comm="{uid}"'
+            lines.append(f"tempi_overlap_fraction{{{lbl}}} "
+                         f"{_fmt(ov['last_fraction'])}")
+            lines.append(f"tempi_overlap_steps_total{{{lbl}}} "
+                         f"{ov['steps']}")
+        lines.append(
+            f"tempi_overlap_fraction_aggregate "
+            f"{_fmt(snap['overlap_fraction'])}")
     if snap["dropped_keys"]:
         lines.append(
             f"tempi_metrics_dropped_keys_total {snap['dropped_keys']}")

@@ -218,6 +218,15 @@ SITES = (
                           # producer; wedge refused like every non-engine
                           # site — the dispatch runs under the progress
                           # lock)
+    "overlap.start",      # one bucket/collective early start in the
+                          # training overlap engine (tempi_tpu/train/,
+                          # ISSUE 20 — fires BEFORE the start dispatches
+                          # to the overlap worker, so a raise defers
+                          # that bucket's start to the step-end barrier:
+                          # degradation is serial, the reduction is
+                          # never lost and never runs twice; delay slows
+                          # the scheduling caller; wedge refused like
+                          # every non-engine site)
 )
 
 KINDS = ("raise", "delay", "wedge", "corrupt")

@@ -1,0 +1,265 @@
+"""Training overlap engine (ISSUE 20).
+
+The async_operation layer exists to hide communication behind compute,
+but every workload in this repo ran compute and communication as
+strictly serial phases — ``PersistentStep`` replays a step's exchanges
+as one fused drain with the math idle (ROADMAP item 3). Three modules
+compose the existing persistent handles into the two canonical training
+shapes plus learned replay windows:
+
+  * :mod:`buckets` — reverse-creation-order gradient buckets of
+    ``TEMPI_OVERLAP_BUCKET_BYTES``, one persistent allreduce per bucket,
+    started in READY order as each bucket's gradients land while later
+    buckets are still being produced, with one wait barrier at step end
+    (PyTorch DDP's bucketing shape, Li et al. VLDB 2020);
+  * :mod:`zero`    — a ZeRO-1-style sharded-optimizer data-parallel step
+    (reduce_scatter grads -> rank-local sharded update -> allgather
+    params, exactly the ``api.reduce_scatter_init``/``allgather_init``
+    handles; Rajbhandari et al. SC 2020);
+  * :mod:`windows` — learned overlap windows for ``api.capture_step``:
+    analyze a compiled ``PersistentStep``'s program for embedded
+    collectives whose buffers are disjoint from every other item, and
+    replay those via early async starts instead of the original inline
+    call site.
+
+``TEMPI_OVERLAP=off`` (the default) is inert: every start happens
+serially at the original call site / the step-end barrier, the
+``overlap.*`` counters stay pinned at zero, and no existing path changes
+byte-for-byte (``TEMPI_DISABLE`` forces off). ``observe`` stays serial
+too but records every would-start decision in the bounded ledger behind
+``api.overlap_snapshot()`` — the exposed-baseline measurement mode.
+``on`` dispatches early starts.
+
+Why a dedicated worker thread: the reduction round plans execute
+synchronously on the HOST (``coll/persistent._RoundsReduceLowering``
+stages device -> host, applies rounds as numpy, stages back), so a
+``start()`` on the training thread overlaps nothing — it blocks the
+caller for the whole reduction. Early starts therefore run on the
+module's single overlap worker; the training thread's backward compute
+(numpy/XLA, both GIL-releasing) proceeds in parallel, and the step-end
+barrier joins the worker's tasks. A task failure parks its exception
+for the barrier, which degrades that bucket to a serial re-start —
+``PersistentReduce`` leaves the device input untouched until a
+reduction completes, so a failed early start is safely restartable.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..utils import env as envmod
+from ..utils import locks
+
+MODES = ("off", "observe", "on")
+
+#: Module-level fast-path flags (the runtime/faults.py pattern):
+#: ``ENABLED`` is True iff mode is ``on`` (early starts dispatch);
+#: ``MODE`` distinguishes ``observe`` (serial + ledger) from ``off``
+#: (inert, counters pinned).
+ENABLED = False
+MODE = "off"
+
+#: Decision-ledger bound (the obs/trace failure-ring precedent): enough
+#: evidence to read a bench phase's scheduling without growing in a soak.
+_KEEP = 256
+
+_lock = locks.named_lock("overlap")
+_ledger: List[dict] = []
+_ndecisions = 0
+
+_worker: Optional["_Worker"] = None
+
+
+class _Task:
+    """One early start on the overlap worker: runs ``fn`` off the
+    training thread, records its wall time, and parks any exception for
+    the step-end barrier to degrade on (serial re-start, never lost)."""
+
+    __slots__ = ("fn", "label", "done", "error", "dur_s")
+
+    def __init__(self, fn: Callable[[], None], label: str):
+        self.fn = fn
+        self.label = label
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.dur_s = 0.0
+
+    def wait(self) -> float:
+        """Block until the task finished; returns the seconds THIS call
+        actually blocked (the exposed time — zero when the worker beat
+        the barrier here)."""
+        t0 = time.perf_counter()
+        self.done.wait()
+        return time.perf_counter() - t0
+
+
+class _Worker:
+    """The single background thread early starts run on. A plain
+    daemon thread draining a queue — deliberately not the progress
+    pump, which services p2p engines and cannot run arbitrary closures.
+    One worker serializes early starts against each other (matching the
+    one-outstanding-drain contract most handles assume) while still
+    overlapping them with the training thread's compute."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Optional[_Task]]" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="tempi-overlap-worker", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                task.fn()
+            except BaseException as exc:  # parked for the barrier
+                task.error = exc
+            task.dur_s = time.perf_counter() - t0
+            task.done.set()
+
+    def submit(self, fn: Callable[[], None], label: str) -> _Task:
+        task = _Task(fn, label)
+        self._q.put(task)
+        return task
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=timeout_s)
+
+
+def worker() -> _Worker:
+    """The lazily started module worker (one per process; restarted by
+    the next submit after :func:`configure` stopped it)."""
+    global _worker
+    with _lock:
+        if _worker is None or not _worker._thread.is_alive():
+            _worker = _Worker()
+        return _worker
+
+
+def configure(mode: Optional[str] = None) -> None:
+    """(Re)arm from the parsed env (``mode=None`` reads
+    ``env.overlap_mode`` — call after ``read_environment``); an explicit
+    argument overrides (test convenience). Clears the decision ledger
+    and stops the worker: scheduling decisions are session evidence,
+    and a mode flip must never leave an early start from the previous
+    configuration in flight."""
+    global ENABLED, MODE, _ledger, _ndecisions, _worker
+    m = mode if mode is not None else \
+        getattr(envmod.env, "overlap_mode", "off")
+    if m not in MODES:
+        raise ValueError(
+            f"bad overlap mode {m!r}: want off | observe | on")
+    with _lock:
+        w, _worker = _worker, None
+        MODE = m
+        ENABLED = m == "on"
+        _ledger = []
+        _ndecisions = 0
+    # outside the overlap lock: join blocks on the worker thread, which
+    # may itself be inside collective machinery taking its own locks
+    if w is not None:
+        w.stop()
+
+
+def disarm() -> None:
+    """Back to inert (conftest teardown symmetry with configure())."""
+    configure("off")
+
+
+def bucket_bytes() -> int:
+    """The parsed ``TEMPI_OVERLAP_BUCKET_BYTES`` (loud parse happened in
+    ``read_environment``; positive by contract)."""
+    return getattr(envmod.env, "overlap_bucket_bytes", 1 << 20)
+
+
+def note_decision(action: str, **fields) -> None:
+    """One scheduling decision into the bounded ledger: ``action`` is
+    ``early`` (start dispatched to the worker), ``deferred``
+    (overlap.start chaos or worker failure pushed it to the barrier),
+    ``observed`` (observe-mode would-start), or ``barrier`` (serial
+    start at step end). No-op at ``off`` — the ledger is part of the
+    counter-pinned inert surface."""
+    global _ndecisions
+    if MODE == "off":
+        return
+    entry = dict(action=action, **fields)
+    with _lock:
+        _ndecisions += 1
+        entry["seq"] = _ndecisions
+        _ledger.append(entry)
+        if len(_ledger) > _KEEP:
+            del _ledger[: len(_ledger) - _KEEP]
+
+
+def schedule_start(start_fn: Callable[[], None], what: str,
+                   **coords):
+    """Mode-dispatched scheduling of one collective start (the shared
+    policy of buckets.py / zero.py / windows.py). Returns ``(task,
+    deferred)``: ``off`` -> ``(None, False)`` with nothing recorded (the
+    counter pin); ``observe`` -> ``(None, False)`` after recording the
+    would-start decision; ``on`` -> the ``overlap.start`` fault site
+    fires BEFORE dispatch, so an injected raise returns ``(None, True)``
+    (the caller runs the start serially at its barrier — degradation is
+    serial, never lost) and otherwise the start is in flight on the
+    worker as ``(task, False)``."""
+    from ..obs import trace as obstrace
+    from ..runtime import faults
+    from ..utils import counters as ctr
+
+    if MODE == "off":
+        return None, False
+    if MODE == "observe":
+        ctr.counters.overlap.num_observed += 1
+        note_decision("observed", what=what, **coords)
+        if obstrace.ENABLED:
+            obstrace.emit("overlap.schedule", action="observed",
+                          what=what, **coords)
+        return None, False
+    if faults.ENABLED:
+        try:
+            faults.check("overlap.start")
+        except faults.InjectedFault as exc:
+            ctr.counters.overlap.num_deferred += 1
+            note_decision("deferred", what=what, reason=str(exc),
+                          **coords)
+            if obstrace.ENABLED:
+                obstrace.emit("overlap.schedule", action="deferred",
+                              what=what, reason=str(exc), **coords)
+            return None, True
+    task = worker().submit(start_fn, what)
+    ctr.counters.overlap.num_early_starts += 1
+    note_decision("early", what=what, **coords)
+    if obstrace.ENABLED:
+        obstrace.emit("overlap.schedule", action="early", what=what,
+                      **coords)
+    return task, False
+
+
+def decisions() -> List[dict]:
+    """Copies of the bounded decision ledger, oldest first."""
+    with _lock:
+        return [dict(e) for e in _ledger]
+
+
+def snapshot() -> dict:
+    """Mode/config plus the decision ledger — the data behind
+    ``api.overlap_snapshot()``. Pure data, safe to serialize; callable
+    before init and after finalize (reads inert)."""
+    with _lock:
+        return dict(mode=MODE, enabled=ENABLED,
+                    bucket_bytes=bucket_bytes(),
+                    decisions=[dict(e) for e in _ledger],
+                    num_decisions=_ndecisions,
+                    worker_alive=bool(
+                        _worker is not None
+                        and _worker._thread.is_alive()))
+
+
+from . import buckets, windows, zero  # noqa: E402,F401

@@ -1,0 +1,271 @@
+"""Reverse-creation-order gradient buckets with ready-order early starts.
+
+The DDP bucketing shape (Li et al., VLDB 2020): parameters are assigned
+to buckets of ``TEMPI_OVERLAP_BUCKET_BYTES`` in REVERSE creation order —
+backward produces gradients roughly last-layer-first, so the first
+buckets to fill are the first the optimizer could reduce — and each
+bucket gets ONE persistent allreduce handle compiled up front. Per step,
+as each bucket's gradients land (ready order, not declaration order —
+ragged production overlaps maximally), the scheduler dispatches that
+bucket's ``start()``+``wait()`` to the overlap worker while later
+buckets are still being produced; ``finish_step()`` is the single wait
+barrier.
+
+Degradation ladder (never lost, never twice): an ``overlap.start``
+chaos raise or a worker-task failure defers that bucket's reduction to
+the barrier, where it re-runs serially — ``PersistentReduce`` leaves
+the device input untouched until a reduction completes, so a failed
+early start is restartable. ``observe`` records every would-start in
+the decision ledger but stays serial; ``off`` is byte-for-byte the
+serial path with every ``overlap.*`` counter pinned at zero. The
+handles ride the shared invalidation generation exactly like any other
+``PersistentReduce`` (a breaker/remap epoch revalidates or refuses on
+the next start).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..coll import persistent as pcoll
+from ..obs import metrics as obsmetrics
+from ..utils import counters as ctr
+
+from . import bucket_bytes as _default_bucket_bytes
+from . import note_decision, schedule_start
+
+
+def _mode() -> str:
+    # read the package flag live (configure() may flip it between steps)
+    from . import MODE
+    return MODE
+
+
+def put_matrix(comm, buf, mat: np.ndarray) -> None:
+    """Batch-write one per-application-rank host matrix into ``buf``:
+    one ``device_put``, rows permuted to library order (the
+    ``_stage_out`` pattern — ``DistBuffer.set_rank`` would pay a full
+    device round trip per rank)."""
+    import jax
+    host = np.empty((comm.size, buf.nbytes), np.uint8)
+    for ar in range(comm.size):
+        row = np.ascontiguousarray(mat[ar]).view(np.uint8)
+        host[comm.library_rank(ar), : row.size] = row
+        host[comm.library_rank(ar), row.size:] = 0
+    buf.data = jax.device_put(host, comm.sharding())
+
+
+def assign_buckets(params: Sequence[Tuple[str, int]], cap_bytes: int,
+                   itemsize: int) -> List[List[Tuple[str, int]]]:
+    """Greedy reverse-creation-order assignment: walk ``params`` (name,
+    nelems) last-created first, packing into buckets of at most
+    ``cap_bytes``; a parameter larger than the cap gets its own bucket.
+    ``cap_bytes`` is positive by the env contract (loud parse)."""
+    if cap_bytes <= 0:
+        raise ValueError(
+            f"bucket capacity must be positive, got {cap_bytes}")
+    buckets: List[List[Tuple[str, int]]] = []
+    cur: List[Tuple[str, int]] = []
+    cur_bytes = 0
+    for name, nelems in reversed(list(params)):
+        if nelems <= 0:
+            raise ValueError(
+                f"parameter {name!r} has non-positive size {nelems}")
+        nb = int(nelems) * itemsize
+        if cur and cur_bytes + nb > cap_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((name, int(nelems)))
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+class _Bucket:
+    __slots__ = ("index", "params", "offsets", "nelems", "buf", "pr",
+                 "stage", "written", "task", "deferred")
+
+    def __init__(self, index: int, params: List[Tuple[str, int]]):
+        self.index = index
+        self.params = params
+        self.offsets: Dict[str, Tuple[int, int]] = {}
+        off = 0
+        for name, n in params:
+            self.offsets[name] = (off, n)
+            off += n
+        self.nelems = off
+        self.buf = None
+        self.pr = None
+        self.stage: Optional[np.ndarray] = None
+        self.written: set = set()
+        self.task = None
+        self.deferred = False
+
+
+class GradBucketScheduler:
+    """Per-step driver: ``begin_step()``, one ``write_grad`` per
+    parameter (any order — READY order drives the schedule), then
+    ``finish_step()`` as the single barrier. ``reduced(name)`` reads the
+    allreduced gradient back out. Handles are compiled once in
+    ``__init__`` and replayed every step (the persistent-collective
+    amortization); ``free()`` releases them."""
+
+    def __init__(self, comm, params: Sequence[Tuple[str, int]],
+                 dtype=np.float32, op: str = "sum",
+                 cap_bytes: Optional[int] = None):
+        self.comm = comm
+        self.dtype = np.dtype(dtype)
+        cap = int(cap_bytes) if cap_bytes is not None \
+            else _default_bucket_bytes()
+        names = [n for n, _ in params]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate parameter names")
+        self._by_name: Dict[str, _Bucket] = {}
+        self.buckets: List[_Bucket] = []
+        for i, group in enumerate(
+                assign_buckets(params, cap, self.dtype.itemsize)):
+            b = _Bucket(i, group)
+            b.buf = comm.alloc(b.nelems * self.dtype.itemsize)
+            b.pr = pcoll.allreduce_init(comm, b.buf, dtype=self.dtype,
+                                        op=op)
+            self.buckets.append(b)
+            for name, _ in group:
+                self._by_name[name] = b
+        self._freed = False
+        self._in_step = False
+
+    def begin_step(self) -> None:
+        if self._freed:
+            raise RuntimeError("begin_step() on a freed scheduler")
+        if self._in_step:
+            raise RuntimeError("begin_step() inside an open step "
+                               "(finish_step() it first)")
+        self._in_step = True
+        for b in self.buckets:
+            b.stage = np.zeros((self.comm.size, b.nelems), self.dtype)
+            b.written.clear()
+            b.task = None
+            b.deferred = False
+
+    def write_grad(self, name: str, rows: Sequence[np.ndarray]) -> None:
+        """One parameter's per-rank gradient rows (application-rank
+        order). The parameter's bucket becomes READY when its last
+        member lands — and in ``on`` mode its allreduce dispatches to
+        the overlap worker right here, while the caller keeps producing
+        later gradients."""
+        if not self._in_step:
+            raise RuntimeError("write_grad() outside begin_step()/"
+                               "finish_step()")
+        b = self._by_name.get(name)
+        if b is None:
+            raise KeyError(f"unknown parameter {name!r}")
+        if name in b.written:
+            raise ValueError(f"parameter {name!r} written twice this step")
+        if len(rows) != self.comm.size:
+            raise ValueError(f"want {self.comm.size} gradient rows, "
+                             f"got {len(rows)}")
+        off, n = b.offsets[name]
+        for r, row in enumerate(rows):
+            v = np.asarray(row, dtype=self.dtype).reshape(-1)
+            if v.size != n:
+                raise ValueError(
+                    f"gradient for {name!r} rank {r}: want {n} elements, "
+                    f"got {v.size}")
+            b.stage[r, off: off + n] = v
+        b.written.add(name)
+        if len(b.written) == len(b.params):
+            self._flush(b)
+            self._schedule(b)
+
+    def _flush(self, b: _Bucket) -> None:
+        put_matrix(self.comm, b.buf, b.stage)
+        b.stage = None
+
+    def _schedule(self, b: _Bucket) -> None:
+        pr = b.pr
+
+        def _run():
+            pr.start()
+            pr.wait()
+
+        b.task, b.deferred = schedule_start(
+            _run, f"bucket-{b.index}", bucket=b.index, nelems=b.nelems)
+
+    def finish_step(self) -> dict:
+        """The single step-end barrier: joins every early task, runs
+        every not-yet-started bucket serially (bucket order), degrades
+        failed early starts to a serial re-run, and returns the step's
+        overlap accounting (``comm_s``, ``exposed_s``,
+        ``overlap_fraction``)."""
+        if not self._in_step:
+            raise RuntimeError("finish_step() without begin_step()")
+        mode = _mode()
+        comm_s = 0.0
+        exposed_s = 0.0
+        for b in self.buckets:
+            if len(b.written) != len(b.params):
+                missing = [n for n, _ in b.params if n not in b.written]
+                raise RuntimeError(
+                    f"finish_step() with unwritten gradients: {missing}")
+            if b.task is not None:
+                blocked = b.task.wait()
+                if b.task.error is not None:
+                    # worker failure: serial re-run, counted as deferred
+                    t0 = time.perf_counter()
+                    b.pr.start()
+                    b.pr.wait()
+                    dur = time.perf_counter() - t0
+                    comm_s += dur
+                    exposed_s += blocked + dur
+                    ctr.counters.overlap.num_deferred += 1
+                    note_decision("barrier", bucket=b.index,
+                                  reason=repr(b.task.error))
+                else:
+                    comm_s += b.task.dur_s
+                    exposed_s += blocked
+                b.task = None
+                continue
+            t0 = time.perf_counter()
+            b.pr.start()
+            b.pr.wait()
+            dur = time.perf_counter() - t0
+            comm_s += dur
+            exposed_s += dur
+            if mode != "off":
+                ctr.counters.overlap.num_barrier_starts += 1
+                note_decision("barrier", bucket=b.index,
+                              deferred=b.deferred)
+        self._in_step = False
+        # clamped: queueing can make a task's blocked join exceed its
+        # run time, and a negative "fraction hidden" reads as nonsense
+        frac = max(0.0, 1.0 - exposed_s / comm_s) if comm_s > 0 else 0.0
+        if mode != "off":
+            ov = ctr.counters.overlap
+            ov.num_steps += 1
+            ov.overlapped_us += int(max(comm_s - exposed_s, 0.0) * 1e6)
+            ov.exposed_us += int(exposed_s * 1e6)
+            obsmetrics.note_overlap(self.comm.uid, comm_s, exposed_s)
+        return dict(comm_s=comm_s, exposed_s=exposed_s,
+                    overlap_fraction=frac)
+
+    def reduced(self, name: str, rank: int = 0) -> np.ndarray:
+        """The allreduced gradient for ``name`` (identical on every
+        rank's row — ``rank`` picks which row to read)."""
+        b = self._by_name[name]
+        off, n = b.offsets[name]
+        it = self.dtype.itemsize
+        row = b.buf.get_rank(rank)
+        return row[off * it: (off + n) * it].view(self.dtype).copy()
+
+    def free(self) -> None:
+        if self._freed:
+            return
+        for b in self.buckets:
+            if b.pr is not None:
+                b.pr.free()
+                b.pr = None
+        self._freed = True

@@ -1,0 +1,214 @@
+"""Learned overlap windows for compiled persistent steps.
+
+A :class:`~..coll.step.PersistentStep` replays its program items in
+recorded order, and an embedded persistent collective normally runs
+inline at its recorded position — start() then wait(), fully exposed.
+But the compiled program is a closed world: every buffer every item
+touches is known at compile time, so WHERE a collective may safely run
+is a static property, not a runtime guess. :func:`learn` walks the
+program once and proves, per embedded collective, whether its send and
+recv buffers are identity-disjoint from every OTHER item's buffers; a
+proven-disjoint collective can start at the earliest point of the
+replay — no program item before or after it can race its bytes — and
+be joined at the step's single wait() barrier. That analysis is the
+"learned window": derived from the step itself, re-derived (via the
+plan-drop in ``PersistentStep._build``) whenever an invalidation
+rebuild renumbers the program.
+
+Replay semantics by mode: ``on`` dispatches eligible collectives to the
+overlap worker up front (``PersistentStep.start`` skips them inline) and
+``wait()`` joins them; ``observe`` records every would-start in the
+decision ledger but replays serially; ``off`` is untouched serial
+replay. Degradation is the house ladder: an ``overlap.start`` chaos
+raise or a worker failure re-runs that collective serially at the
+barrier — the reduction is never lost and never runs twice
+(``PersistentReduce`` leaves its input intact until it completes).
+
+The realized overlap — collective seconds hidden behind the rest of the
+replay — lands in ``overlap_fraction`` via ``obs/metrics.note_overlap``
+and in the ``overlap.*`` counters; every decision (early, deferred,
+barrier, invalidated) is a row in the bounded ledger behind
+``api.overlap_snapshot()``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..obs import metrics as obsmetrics
+from ..utils import counters as ctr
+
+from . import note_decision, schedule_start
+
+
+def _mode() -> str:
+    from . import MODE
+    return MODE
+
+
+class _ItemTask:
+    """An early-started program item in flight on the overlap worker:
+    the inner worker task plus the coordinates wait()'s join needs (the
+    program index and the collective handle, for the serial re-run on
+    failure)."""
+
+    __slots__ = ("index", "pcoll", "_task")
+
+    def __init__(self, index: int, pcoll, task):
+        self.index = index
+        self.pcoll = pcoll
+        self._task = task
+
+    def wait(self) -> float:
+        return self._task.wait()
+
+    def done(self) -> bool:
+        return self._task.done.is_set()
+
+    @property
+    def error(self):
+        return self._task.error
+
+    @property
+    def dur_s(self) -> float:
+        return self._task.dur_s
+
+
+class OverlapWindows:
+    """The learned plan for one compiled step: ``early`` holds the
+    program indices of collectives proven safe to start up front;
+    ``ineligible`` names the ones that were not, with the reason (the
+    diagnostics half of the ledger). Install onto the step with
+    :meth:`install`; the step calls :meth:`dispatch` per early index at
+    start() and :meth:`join` at wait()."""
+
+    def __init__(self, step, early: frozenset, ineligible: List[dict]):
+        self.step = step
+        self.early = early
+        self.ineligible = ineligible
+        self._installed = False
+
+    def install(self) -> "OverlapWindows":
+        """Arm the plan onto its step (``PersistentStep.install_overlap``)
+        and count the learned windows."""
+        self.step.install_overlap(self)
+        self._installed = True
+        if _mode() != "off":  # the off-mode counter pin covers these too
+            ctr.counters.overlap.num_windows_learned += len(self.early)
+            note_decision("learned", step=self.step.name,
+                          early=sorted(self.early),
+                          ineligible=len(self.ineligible))
+        return self
+
+    # -- step-side surface (duck-typed; see PersistentStep) -------------------
+
+    def dispatch(self, index: int, pcoll) -> Optional[_ItemTask]:
+        """Called by ``PersistentStep.start`` per early index. Returns a
+        task when the collective went to the overlap worker, None when
+        policy declined (off/observe mode, chaos defer) — the step then
+        replays it inline at its recorded position."""
+
+        def _run():
+            pcoll.start()
+            pcoll.wait()
+
+        task, _deferred = schedule_start(
+            _run, f"{self.step.name}#item{index}", step=self.step.name,
+            item=index)
+        if task is None:
+            return None
+        return _ItemTask(index, pcoll, task)
+
+    def join(self, tasks: List[_ItemTask]) -> dict:
+        """Called by ``PersistentStep.wait``: join every early task,
+        degrade failures to a serial re-run, and record the realized
+        overlap (counters + ``obs/metrics.note_overlap``)."""
+        comm_s = 0.0
+        exposed_s = 0.0
+        for t in tasks:
+            blocked = t.wait()
+            if t.error is None:
+                comm_s += t.dur_s
+                exposed_s += blocked
+                continue
+            # worker failure: the collective never completed, its input
+            # is intact — re-run serially here, counted as deferred
+            t0 = time.perf_counter()
+            t.pcoll.start()
+            t.pcoll.wait()
+            dur = time.perf_counter() - t0
+            comm_s += dur
+            exposed_s += blocked + dur
+            ctr.counters.overlap.num_deferred += 1
+            note_decision("barrier", step=self.step.name, item=t.index,
+                          reason=repr(t.error))
+        frac = max(0.0, 1.0 - exposed_s / comm_s) if comm_s > 0 else 0.0
+        if _mode() != "off":
+            ov = ctr.counters.overlap
+            ov.num_steps += 1
+            ov.overlapped_us += int(max(comm_s - exposed_s, 0.0) * 1e6)
+            ov.exposed_us += int(exposed_s * 1e6)
+            obsmetrics.note_overlap(self.step.comm.uid, comm_s, exposed_s)
+        return dict(comm_s=comm_s, exposed_s=exposed_s,
+                    overlap_fraction=frac)
+
+    def invalidated(self) -> None:
+        """The step rebuilt (or replaced this plan): the program indices
+        this plan was learned against are stale. Counted and ledgered;
+        re-run :func:`learn` against the fresh program to re-arm."""
+        self._installed = False
+        if _mode() != "off":  # the off-mode counter pin covers these too
+            ctr.counters.overlap.num_windows_invalidated += 1
+            note_decision("invalidated", step=self.step.name,
+                          early=sorted(self.early))
+
+
+def _item_bufs(item) -> list:
+    """Every distinct buffer one program item touches."""
+    bufs: list = []
+    if item[0] == "coll":
+        cand = [item[1].sendbuf, item[1].recvbuf]
+    else:  # ("plans", plans, calls) — read the recorded envelopes, which
+        # survive eager-only compiles (plans is empty there)
+        cand = [env[2] for envs, _pin in item[2] for env in envs]
+    for b in cand:
+        if all(b is not x for x in bufs):
+            bufs.append(b)
+    return bufs
+
+
+def learn(step) -> OverlapWindows:
+    """Analyze ``step``'s compiled program and return the learned
+    windows (NOT yet installed — call :meth:`OverlapWindows.install`).
+    An embedded collective is eligible for an early start iff its send
+    and recv buffers are identity-disjoint from every other program
+    item's buffers: no earlier item can still be writing its input, no
+    later item can read its output before the barrier, so the earliest
+    safe start point is the top of the replay."""
+    program = getattr(step, "_program", None)
+    if not program:
+        raise ValueError(
+            f"learn() on step '{step.name}': no compiled program "
+            "(freed step?)")
+    per_item = [_item_bufs(it) for it in program]
+    early = set()
+    ineligible: List[dict] = []
+    for i, item in enumerate(program):
+        if item[0] != "coll":
+            continue
+        mine = per_item[i]
+        clash = None
+        for j, other in enumerate(per_item):
+            if j == i:
+                continue
+            if any(b is x for b in mine for x in other):
+                clash = j
+                break
+        if clash is None:
+            early.add(i)
+        else:
+            ineligible.append(dict(
+                item=i, kind=item[1].kind,
+                reason=f"shares a buffer with program item {clash}"))
+    return OverlapWindows(step, frozenset(early), ineligible)

@@ -1,0 +1,292 @@
+"""ZeRO-1-style sharded-optimizer data-parallel step.
+
+The second canonical overlap shape (Rajbhandari et al., SC 2020): per
+reverse-creation-order bucket, gradients reduce_scatter so rank ``r``
+receives only its owned block (``redsched.partition_elems`` shards),
+rank ``r`` applies the optimizer update to that block alone, and the
+updated shards allgather back into the full parameter vector — exactly
+the ``reduce_scatter_init``/``allgather_init`` persistent handles,
+compiled once and replayed per step.
+
+Overlap legs under ``TEMPI_OVERLAP=on``: each bucket's reduce_scatter
+dispatches to the overlap worker as soon as its gradients land (while
+later buckets are still being produced), and each bucket's allgather
+dispatches as soon as ITS sharded update finishes (hidden behind the
+remaining buckets' updates). ``observe`` records the would-starts but
+stays serial; ``off`` is the byte-for-byte serial baseline with the
+``overlap.*`` counters pinned. Degradation mirrors buckets.py: an
+``overlap.start`` raise or worker failure re-runs that collective
+serially at the barrier — never lost, never twice.
+
+Determinism contract (what the byte-exact property tests pin): the
+round plans, shard partition, and update arithmetic are identical
+across modes — only WHEN a start is issued changes — so ``on`` ==
+``observe`` == ``off`` bitwise, and with integer-valued gradients and a
+power-of-two ``lr``/world size the result equals the pure-numpy
+reference exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..coll import persistent as pcoll
+from ..coll import reduce as redsched
+from ..obs import metrics as obsmetrics
+from ..utils import counters as ctr
+
+from . import bucket_bytes as _default_bucket_bytes
+from . import note_decision, schedule_start
+from .buckets import assign_buckets, put_matrix
+
+
+def _mode() -> str:
+    from . import MODE
+    return MODE
+
+
+class _ZBucket:
+    __slots__ = ("index", "params", "offsets", "nelems", "counts",
+                 "width", "master", "gstage", "written",
+                 "gbuf", "sbuf", "psend", "pfull", "rs", "ag",
+                 "rs_task", "ag_task")
+
+    def __init__(self, index: int, params: List[Tuple[str, int]]):
+        self.index = index
+        self.params = params
+        self.offsets: Dict[str, Tuple[int, int]] = {}
+        off = 0
+        for name, n in params:
+            self.offsets[name] = (off, n)
+            off += n
+        self.nelems = off
+        self.counts: List[int] = []
+        self.width = 0
+        self.master: Optional[np.ndarray] = None
+        self.gstage: Optional[np.ndarray] = None
+        self.written: set = set()
+        self.gbuf = self.sbuf = self.psend = self.pfull = None
+        self.rs = self.ag = None
+        self.rs_task = self.ag_task = None
+
+
+class ZeroShardedStep:
+    """Driver: construct once with the parameter spec and initial
+    values, call :meth:`step` with a gradient stream per training step,
+    read :meth:`params` back. One reduce_scatter + one allgather handle
+    per bucket, compiled in ``__init__`` and replayed every step; the
+    post-step parameters are ALWAYS the allgathered wire result (what a
+    real ZeRO rank adopts), so the tests pin the communicated bytes,
+    not a host-side shortcut."""
+
+    def __init__(self, comm, params: Sequence[Tuple[str, int]],
+                 values: Dict[str, np.ndarray], lr: float = 0.5,
+                 dtype=np.float32, cap_bytes: Optional[int] = None,
+                 average: bool = True):
+        self.comm = comm
+        self.dtype = np.dtype(dtype)
+        self.lr = float(lr)
+        self.average = average
+        cap = int(cap_bytes) if cap_bytes is not None \
+            else _default_bucket_bytes()
+        it = self.dtype.itemsize
+        names = [n for n, _ in params]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate parameter names")
+        missing = [n for n in names if n not in values]
+        if missing:
+            raise ValueError(f"missing initial values for {missing}")
+        self._by_name: Dict[str, _ZBucket] = {}
+        self.buckets: List[_ZBucket] = []
+        for i, group in enumerate(assign_buckets(params, cap, it)):
+            b = _ZBucket(i, group)
+            b.counts = redsched.partition_elems(b.nelems, comm.size)
+            b.width = max(max(b.counts), 1)
+            b.master = np.empty(b.nelems, self.dtype)
+            for name, n in group:
+                off, _ = b.offsets[name]
+                v = np.asarray(values[name], dtype=self.dtype).reshape(-1)
+                if v.size != n:
+                    raise ValueError(
+                        f"initial value for {name!r}: want {n} elements, "
+                        f"got {v.size}")
+                b.master[off: off + n] = v
+            b.gbuf = comm.alloc(b.nelems * it)
+            b.sbuf = comm.alloc(b.width * it)
+            b.psend = comm.alloc(b.width * it)
+            b.pfull = comm.alloc(b.nelems * it)
+            b.rs = pcoll.reduce_scatter_init(comm, b.gbuf, b.counts,
+                                             b.sbuf, dtype=self.dtype,
+                                             op="sum")
+            b.ag = pcoll.allgather_init(comm, b.psend, b.counts,
+                                        b.pfull, dtype=self.dtype)
+            self.buckets.append(b)
+            for name, _ in group:
+                self._by_name[name] = b
+        self._freed = False
+        self._stats: dict = {}
+
+    # -- per-step driver ------------------------------------------------------
+
+    def step(self, grads: Iterable[Tuple[str, Sequence[np.ndarray]]]
+             ) -> dict:
+        """One training step. ``grads`` yields ``(name, rows)`` — the
+        per-rank gradient rows for one parameter — in ANY order (ready
+        order drives the reduce_scatter schedule). Returns the step's
+        overlap accounting."""
+        if self._freed:
+            raise RuntimeError("step() on a freed ZeroShardedStep")
+        comm_s = 0.0
+        exposed_s = 0.0
+        mode = _mode()
+        for b in self.buckets:
+            # empty, not zeros: the flush is gated on every parameter
+            # having been written, and each write covers its full
+            # (rank, span) block — no element is ever read unwritten
+            b.gstage = np.empty((self.comm.size, b.nelems), self.dtype)
+            b.written.clear()
+            b.rs_task = b.ag_task = None
+        # gradient production: buckets early-start their reduce_scatter
+        # in READY order while the caller keeps producing
+        for name, rows in grads:
+            b = self._by_name.get(name)
+            if b is None:
+                raise KeyError(f"unknown parameter {name!r}")
+            if name in b.written:
+                raise ValueError(
+                    f"parameter {name!r} written twice this step")
+            if len(rows) != self.comm.size:
+                raise ValueError(f"want {self.comm.size} gradient rows, "
+                                 f"got {len(rows)}")
+            off, n = b.offsets[name]
+            for r, row in enumerate(rows):
+                v = np.asarray(row, dtype=self.dtype).reshape(-1)
+                if v.size != n:
+                    raise ValueError(
+                        f"gradient for {name!r} rank {r}: want {n} "
+                        f"elements, got {v.size}")
+                b.gstage[r, off: off + n] = v
+            b.written.add(name)
+            if len(b.written) == len(b.params):
+                put_matrix(self.comm, b.gbuf, b.gstage)
+                b.gstage = None
+                rs = b.rs
+
+                def _run_rs(rs=rs):
+                    rs.start()
+                    rs.wait()
+
+                b.rs_task, _ = schedule_start(
+                    _run_rs, f"zero-rs-{b.index}", bucket=b.index,
+                    coll="reduce_scatter", nelems=b.nelems)
+        # barrier + pipelined update: per bucket, join/run the
+        # reduce_scatter, apply the rank-local sharded update, and
+        # launch the allgather — in ``on`` mode the allgather hides
+        # behind the REMAINING buckets' updates
+        for b in self.buckets:
+            if len(b.written) != len(b.params):
+                miss = [n for n, _ in b.params if n not in b.written]
+                raise RuntimeError(
+                    f"step() with unwritten gradients: {miss}")
+            c, e = self._join_or_run(b.rs_task, b.rs, f"zero-rs-{b.index}",
+                                     mode)
+            comm_s += c
+            exposed_s += e
+            b.rs_task = None
+            self._sharded_update(b)
+            ag = b.ag
+
+            def _run_ag(ag=ag):
+                ag.start()
+                ag.wait()
+
+            b.ag_task, _ = schedule_start(
+                _run_ag, f"zero-ag-{b.index}", bucket=b.index,
+                coll="allgather", nelems=b.nelems)
+        # final barrier: every allgather done, adopt the wire result
+        it = self.dtype.itemsize
+        for b in self.buckets:
+            c, e = self._join_or_run(b.ag_task, b.ag, f"zero-ag-{b.index}",
+                                     mode)
+            comm_s += c
+            exposed_s += e
+            b.ag_task = None
+            row = b.pfull.get_rank(0)
+            b.master = row[: b.nelems * it].view(self.dtype).copy()
+        frac = max(0.0, 1.0 - exposed_s / comm_s) if comm_s > 0 else 0.0
+        if mode != "off":
+            ov = ctr.counters.overlap
+            ov.num_steps += 1
+            ov.overlapped_us += int(max(comm_s - exposed_s, 0.0) * 1e6)
+            ov.exposed_us += int(exposed_s * 1e6)
+            obsmetrics.note_overlap(self.comm.uid, comm_s, exposed_s)
+        self._stats = dict(comm_s=comm_s, exposed_s=exposed_s,
+                           overlap_fraction=frac)
+        return dict(self._stats)
+
+    def _join_or_run(self, task, pr, what: str, mode: str):
+        """Join an in-flight early start, or run the collective serially
+        here (the barrier path / the degradation path). Returns
+        ``(comm_s, exposed_s)`` for the accounting."""
+        if task is not None:
+            blocked = task.wait()
+            if task.error is None:
+                return task.dur_s, blocked
+            # worker failure: serial re-run, counted as deferred
+            t0 = time.perf_counter()
+            pr.start()
+            pr.wait()
+            dur = time.perf_counter() - t0
+            ctr.counters.overlap.num_deferred += 1
+            note_decision("barrier", what=what, reason=repr(task.error))
+            return dur, blocked + dur
+        t0 = time.perf_counter()
+        pr.start()
+        pr.wait()
+        dur = time.perf_counter() - t0
+        if mode != "off":
+            ctr.counters.overlap.num_barrier_starts += 1
+            note_decision("barrier", what=what)
+        return dur, dur
+
+    def _sharded_update(self, b: _ZBucket) -> None:
+        """Rank-local optimizer: rank ``r`` updates ONLY its owned block
+        from its reduce_scatter result, then the updated shards are
+        staged for the allgather. Plain SGD — deterministic host numpy,
+        the simplest update that makes byte-exactness checkable."""
+        it = self.dtype.itemsize
+        size = self.comm.size
+        scale = self.lr / (size if self.average else 1)
+        send = np.zeros((size, b.width), self.dtype)
+        off = 0
+        for r in range(size):
+            c = b.counts[r]
+            if c:
+                shard = b.sbuf.get_rank(r)[: c * it].view(self.dtype)
+                send[r, :c] = b.master[off: off + c] - scale * shard
+            off += c
+        put_matrix(self.comm, b.psend, send)
+
+    # -- surfaces -------------------------------------------------------------
+
+    def params(self, name: str) -> np.ndarray:
+        """The current (post-allgather) value of parameter ``name``."""
+        b = self._by_name[name]
+        off, n = b.offsets[name]
+        return b.master[off: off + n].copy()
+
+    def last_stats(self) -> dict:
+        return dict(self._stats)
+
+    def free(self) -> None:
+        if self._freed:
+            return
+        for b in self.buckets:
+            for h in (b.rs, b.ag):
+                if h is not None:
+                    h.free()
+            b.rs = b.ag = None
+        self._freed = True

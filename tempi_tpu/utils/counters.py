@@ -195,6 +195,10 @@ class StepCounters:
     num_plan_dispatches: int = 0  # exchange plans dispatched by replays
     num_eager_fallbacks: int = 0  # start() re-issued through the engine
                                   # (pending eager traffic / TEMPI_STEP=off)
+    num_concurrent_replays: int = 0  # start() with another independent
+                                     # step already in flight on the same
+                                     # communicator (disjoint buffers —
+                                     # shared buffers refuse, ISSUE 20)
 
 
 @dataclass
@@ -276,6 +280,29 @@ class CompressCounters:
 
 
 @dataclass
+class OverlapCounters:
+    # training overlap engine (ISSUE 20; tempi_tpu/train/): pinned at
+    # zero with TEMPI_OVERLAP=off — the counter-based byte-for-byte
+    # guard that the off path schedules, defers, observes, and
+    # measures nothing
+    num_steps: int = 0           # overlap-accounted training steps
+    num_early_starts: int = 0    # collective starts issued before the
+                                 # step-end barrier (on the worker)
+    num_deferred: int = 0        # early starts deferred to the barrier
+                                 # (overlap.start chaos or a worker
+                                 # failure — degradation serial, never
+                                 # lost)
+    num_barrier_starts: int = 0  # starts issued serially at the barrier
+    num_observed: int = 0        # observe-mode would-start decisions
+    num_windows_learned: int = 0     # learned window plans installed
+                                     # on captured steps
+    num_windows_invalidated: int = 0  # window plans dropped by a step
+                                      # rebuild/invalidation
+    overlapped_us: int = 0       # collective time hidden behind compute
+    exposed_us: int = 0          # collective time the barrier blocked on
+
+
+@dataclass
 class PlanCacheCounters:
     # per-communicator plan/program cache (parallel/plan.cache_get/put):
     # the compile-amortization evidence benches print per run (ISSUE 5)
@@ -309,6 +336,7 @@ class Counters:
     integrity: IntegrityCounters = field(default_factory=IntegrityCounters)
     serving: ServingCounters = field(default_factory=ServingCounters)
     compress: CompressCounters = field(default_factory=CompressCounters)
+    overlap: OverlapCounters = field(default_factory=OverlapCounters)
 
     def as_dict(self) -> dict:
         out = {}

@@ -451,6 +451,34 @@ Inference-serving knobs (ISSUE 18; serving/engine.py, serving/kv_stream.py):
                          anomaly observed at request N reproduces from
                          the same knobs.
 
+Training overlap knobs (ISSUE 20; tempi_tpu/train/ and the README
+"Training overlap" section):
+  TEMPI_OVERLAP        off (default) | observe | on. ``on`` arms the
+                         training overlap engine: gradient-bucket and
+                         ZeRO-sharded steps start their persistent
+                         collectives as each bucket becomes ready (on
+                         the overlap worker, hidden behind the
+                         remaining backward compute) with one wait
+                         barrier at step end, and captured
+                         PersistentStep replays issue learned early
+                         starts. ``observe`` stays byte-for-byte
+                         serial but records every would-start decision
+                         in the overlap ledger and measures the fully
+                         exposed baseline. Off is inert: starts happen
+                         serially at the barrier and the overlap.*
+                         counter group stays pinned at zero (the
+                         counter-based byte-for-byte guard).
+                         TEMPI_DISABLE forces off.
+  TEMPI_OVERLAP_BUCKET_BYTES  gradient bucket capacity in bytes
+                         (default 1 MiB): parameters are assigned to
+                         reverse-creation-order buckets of this size,
+                         one persistent allreduce/reduce_scatter per
+                         bucket. Zero/negative rejected loudly — a
+                         zero-byte bucket can hold no parameter, so
+                         assignment would silently degenerate to one
+                         collective per parameter and the amortization
+                         the knob exists to buy would be gone.
+
 Per-call boolean/integer escape hatches read OUTSIDE read_environment
 (consulted at call time so tests and benches can flip them mid-session;
 loud-parsed via bool_env/int_env below):
@@ -590,6 +618,9 @@ KNOWN_KNOBS = (
     "TEMPI_SERVE_PAGE_BYTES",
     "TEMPI_SERVE_QPS",
     "TEMPI_SERVE_SEED",
+    # training overlap (ISSUE 20)
+    "TEMPI_OVERLAP",
+    "TEMPI_OVERLAP_BUCKET_BYTES",
     # multi-host world coordinates (parallel/multihost.py)
     "TEMPI_COORDINATOR",
     "TEMPI_NUM_PROCESSES",
@@ -778,6 +809,9 @@ class Environment:
     serve_page_bytes: int = 4096   # fixed KV page size in bytes
     serve_qps: float = 32.0        # default open-loop arrival rate
     serve_seed: int = 0            # request-generator seed
+    # training overlap (ISSUE 20) — see tempi_tpu/train/
+    overlap_mode: str = "off"      # off | observe | on
+    overlap_bucket_bytes: int = 1 << 20  # gradient bucket capacity
 
     @staticmethod
     def from_environ(environ=None) -> "Environment":
@@ -1278,6 +1312,31 @@ class Environment:
                 "(requests/second)")
         e.serve_seed = _pos_int_env("TEMPI_SERVE_SEED", 0)
 
+        # overlap knobs parse loudly too: a typo'd TEMPI_OVERLAP silently
+        # staying off would run the serial fallback in the one training
+        # job that asked to hide its allreduces — and the bench would
+        # "measure" an overlap engine that never engaged
+        ov = (getenv("TEMPI_OVERLAP") or "off").lower()
+        if ov not in ("off", "observe", "on"):
+            raise ValueError(
+                f"bad TEMPI_OVERLAP={ov!r}: want off | observe | on")
+        e.overlap_mode = ov
+        v = getenv("TEMPI_OVERLAP_BUCKET_BYTES")
+        try:
+            bb = int(v) if v else 1 << 20
+        except ValueError as exc:
+            raise ValueError(
+                f"bad TEMPI_OVERLAP_BUCKET_BYTES={v!r}: want a positive "
+                "integer (bytes)") from exc
+        if bb <= 0:
+            # no silent clamp: a zero-byte bucket holds no parameter, so
+            # assignment would silently degenerate to one collective per
+            # parameter — loud refusal, like TEMPI_SERVE_PAGE_BYTES
+            raise ValueError(
+                f"bad TEMPI_OVERLAP_BUCKET_BYTES={v!r}: want a positive "
+                "integer (bytes)")
+        e.overlap_bucket_bytes = bb
+
         if e.no_tempi:
             # TEMPI_DISABLE is the reference's global bail-out: every
             # interposed entry point forwards to the underlying library
@@ -1340,6 +1399,10 @@ class Environment:
             # ...and the serving subsystem: its KV streams and routing
             # ride the persistent machinery the bail-out turns off
             e.serve_mode = "off"
+            # ...and the training overlap engine: early starts exist to
+            # hide the framework's own persistent collectives, which the
+            # bail-out replaces with the library's fused lowerings
+            e.overlap_mode = "off"
             # TEMPI_LOCKCHECK deliberately survives the bail-out: the
             # lock-order checker observes the framework's own locks (which
             # exist regardless of interposition) and is developer tooling,

@@ -89,6 +89,11 @@ def pytest_configure(config):
         "compress: compressed-collective tests — codec properties, "
         "error-feedback numerics, costed-arm choice, quantized-wire "
         "integrity (the <30s smoke is `pytest -m compress`)")
+    config.addinivalue_line(
+        "markers",
+        "overlap: training-overlap-engine tests — byte-exact mode "
+        "equivalence, bucketed/ZeRO schedulers, learned step windows, "
+        "overlap.start chaos (the <30s smoke is `pytest -m overlap`)")
 
 
 @pytest.fixture(autouse=True)
@@ -102,6 +107,7 @@ def _reset_globals():
     from tempi_tpu.parallel import replacement
     from tempi_tpu.runtime import (autopilot, elastic, faults, health,
                                    integrity, liveness, qos)
+    from tempi_tpu import train
     from tempi_tpu.serving import engine as serving_engine
     from tempi_tpu.tune import online as tune_online
     from tempi_tpu.utils import counters, env, locks
@@ -121,6 +127,7 @@ def _reset_globals():
     integrity.configure()
     serving_engine.configure()
     compress_arms.configure()
+    train.configure()
     counters.init()
     health.reset()
     yield
@@ -140,4 +147,5 @@ def _reset_globals():
     autopilot.disarm()
     integrity.configure("off")
     serving_engine.disarm()
+    train.disarm()
     locks.configure("off")
